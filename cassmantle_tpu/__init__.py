@@ -1,0 +1,34 @@
+"""cassmantle_tpu — a TPU-native real-time generative guessing-game framework.
+
+A ground-up JAX/Flax/Pallas re-design of the capability surface of
+SnowCheetos/CassMantle (see SURVEY.md): a multiplayer web game whose content
+loop — LLM prompt generation, diffusion image generation, descriptive-word
+masking, guess-similarity scoring, progressive image reveal — is served
+entirely from TPU VMs, with no GPU and no external inference API.
+
+Where the reference (``/root/reference``) delegates model compute to the
+HuggingFace hosted Inference API (backend.py:24-25, 240-295) and scores
+guesses with a CPU word2vec model (backend.py:45, 303-317), this framework
+runs everything locally as jit/shard_map'd XLA graphs:
+
+- ``models/``   Flax model zoo: CLIP text encoder, SD UNet, VAE, GPT-2, MiniLM.
+- ``ops/``      TPU compute ops: Pallas flash attention, DDIM scan sampler,
+                KV-cached greedy decode, batched cosine scorer, device blur.
+- ``parallel/`` Mesh construction, shardings, ring attention, collectives,
+                distributed train/serve steps.
+- ``engine/``   Game engine: state store, sessions, rounds, scoring, masking.
+- ``serving/``  Continuous-batching queue + async device dispatch.
+- ``server/``   HTTP/WS API surface (aiohttp) + static frontend.
+- ``utils/``    Codec, text, logging/metrics, profiling.
+"""
+
+__version__ = "0.1.0"
+
+from cassmantle_tpu.config import (  # noqa: F401
+    FrameworkConfig,
+    GameConfig,
+    MeshConfig,
+    ModelZooConfig,
+    SamplerConfig,
+    ServingConfig,
+)

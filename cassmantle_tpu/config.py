@@ -1,0 +1,222 @@
+"""Typed configuration for the whole framework.
+
+The reference scatters its tunables across constructor kwargs and hardcoded
+constants (server.py:15-24, backend.py:20-26, 47-50, 319; SURVEY.md §5.6).
+Here everything lives in one tree of frozen dataclasses so a single
+``FrameworkConfig`` names the model zoo, samplers, parallelism mesh, serving
+queue, and game constants, and can be overridden per-test or per-deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipTextConfig:
+    """SD1.5's text tower (OpenAI CLIP ViT-L/14 text model) dimensions."""
+
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_positions: int = 77
+    # SDXL adds a second, bigger text tower (OpenCLIP ViT-bigG); same module,
+    # different dims.
+    @staticmethod
+    def sdxl_big() -> "ClipTextConfig":
+        return ClipTextConfig(
+            vocab_size=49408,
+            hidden_size=1280,
+            intermediate_size=5120,
+            num_layers=32,
+            num_heads=20,
+            max_positions=77,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    """Diffusion UNet. Defaults = SD1.5; ``sdxl()`` = SDXL-base geometry."""
+
+    sample_channels: int = 4
+    base_channels: int = 320
+    channel_mults: Tuple[int, ...] = (1, 2, 4, 4)
+    # Per-level: whether the level's resnet blocks carry transformer
+    # (self+cross attention) blocks.
+    attention_levels: Tuple[bool, ...] = (True, True, True, False)
+    # Transformer depth per level (SDXL uses 2/10 at its two attn levels).
+    transformer_depth: Tuple[int, ...] = (1, 1, 1, 1)
+    blocks_per_level: int = 2
+    num_heads: int = 8
+    context_dim: int = 768
+    time_embed_dim: int = 1280
+    # SDXL micro-conditioning (added time-embedding channels); 0 disables.
+    addition_embed_dim: int = 0
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def sdxl() -> "UNetConfig":
+        return UNetConfig(
+            base_channels=320,
+            channel_mults=(1, 2, 4),
+            attention_levels=(False, True, True),
+            transformer_depth=(0, 2, 10),
+            num_heads=None,  # SDXL uses fixed head_dim 64 -> heads = ch // 64
+            context_dim=2048,
+            time_embed_dim=1280,
+            addition_embed_dim=2816,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    """SD autoencoder (decoder is the serving hot path)."""
+
+    latent_channels: int = 4
+    base_channels: int = 128
+    channel_mults: Tuple[int, ...] = (1, 2, 4, 4)
+    blocks_per_level: int = 2
+    scaling_factor: float = 0.18215  # SD1.5; SDXL uses 0.13025
+    dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    """GPT-2-small for prompt/hint generation (greedy decode)."""
+
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_positions: int = 1024
+    dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass(frozen=True)
+class MiniLMConfig:
+    """all-MiniLM-L6-v2-class sentence encoder for guess scoring."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 6
+    num_heads: int = 12
+    max_positions: int = 512
+    dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelZooConfig:
+    clip_text: ClipTextConfig = dataclasses.field(default_factory=ClipTextConfig)
+    unet: UNetConfig = dataclasses.field(default_factory=UNetConfig)
+    vae: VAEConfig = dataclasses.field(default_factory=VAEConfig)
+    gpt2: GPT2Config = dataclasses.field(default_factory=GPT2Config)
+    minilm: MiniLMConfig = dataclasses.field(default_factory=MiniLMConfig)
+    # Directory holding safetensors checkpoints; None -> deterministic
+    # random-init (fixed PRNG) so the full pipeline runs without artifacts.
+    weights_dir: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    """DDIM image sampler + greedy text decode settings."""
+
+    num_steps: int = 50
+    guidance_scale: float = 7.5
+    eta: float = 0.0
+    image_size: int = 512
+    # Text decode (reference decodes 32-96 new tokens, backend.py:250-255).
+    min_new_tokens: int = 32
+    max_new_tokens: int = 96
+    prompt_pad_len: int = 77
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axes follow the scaling-book convention:
+
+    - ``dp``: data parallel (batch sharding) — rides ICI within a slice.
+    - ``tp``: tensor parallel (attention heads / MLP columns).
+    - ``sp``: sequence/context parallel (ring attention over image tokens).
+    Sizes of -1 mean "fill with remaining devices".
+    """
+
+    dp: int = -1
+    tp: int = 1
+    sp: int = 1
+    # Axis names, in mesh order.
+    axis_names: Tuple[str, ...] = ("dp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Continuous-batching queue bounds (fixed shapes; no recompile storms)."""
+
+    image_batch_sizes: Tuple[int, ...] = (1, 4, 8)
+    score_batch_sizes: Tuple[int, ...] = (8, 64, 256, 1024)
+    max_queue_delay_ms: float = 25.0
+    max_pending: int = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class GameConfig:
+    """Round/game constants (reference values cited in SURVEY.md §2/§5.6)."""
+
+    min_score: float = 0.01          # server.py:17
+    time_per_prompt: float = 900.0   # main.py:23 (15 min)
+    buffer_at_fraction: float = 0.7  # server.py:162
+    num_masked: int = 2              # backend.py:49
+    episodes_per_story: int = 20     # backend.py:50
+    min_blur: float = 0.0            # backend.py:319
+    max_blur: float = 15.0           # backend.py:319
+    lock_timeout: float = 120.0      # backend.py:47
+    acquire_timeout: float = 2.0     # backend.py:48
+    max_retries: int = 5             # server.py:19
+    rate_limit_default: float = 3.0  # req/s per IP, main.py:19
+    rate_limit_api: float = 2.0      # main.py:48 etc.
+
+
+@dataclasses.dataclass(frozen=True)
+class FrameworkConfig:
+    models: ModelZooConfig = dataclasses.field(default_factory=ModelZooConfig)
+    sampler: SamplerConfig = dataclasses.field(default_factory=SamplerConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    game: GameConfig = dataclasses.field(default_factory=GameConfig)
+    seed: int = 0
+
+    def replace(self, **kw) -> "FrameworkConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def test_config() -> FrameworkConfig:
+    """A tiny config for CPU tests: small models, fast rounds, 64px images."""
+
+    return FrameworkConfig(
+        models=ModelZooConfig(
+            clip_text=ClipTextConfig(
+                vocab_size=1024, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, max_positions=16,
+            ),
+            unet=UNetConfig(
+                base_channels=32, channel_mults=(1, 2), num_heads=4,
+                attention_levels=(True, False), transformer_depth=(1, 0),
+                blocks_per_level=1, context_dim=64, time_embed_dim=128,
+                dtype="float32",
+            ),
+            vae=VAEConfig(base_channels=32, channel_mults=(1, 2),
+                          blocks_per_level=1),
+            gpt2=GPT2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, max_positions=64, dtype="float32"),
+            minilm=MiniLMConfig(vocab_size=512, hidden_size=64,
+                                intermediate_size=128, num_layers=2,
+                                num_heads=4, max_positions=32),
+        ),
+        sampler=SamplerConfig(num_steps=4, image_size=64, max_new_tokens=8,
+                              min_new_tokens=2, prompt_pad_len=16),
+        game=GameConfig(time_per_prompt=2.0, lock_timeout=5.0,
+                        acquire_timeout=0.5),
+    )

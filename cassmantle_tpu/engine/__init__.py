@@ -1,0 +1,5 @@
+from cassmantle_tpu.engine.store import (  # noqa: F401
+    LockTimeout,
+    MemoryStore,
+    StateStore,
+)

@@ -1,0 +1,275 @@
+"""Async game-state store: the framework's coordination plane.
+
+The reference keeps ALL shared state in Redis — session hashes, round
+content hashes, the countdown-as-TTL clock, player set, and the
+startup/buffer/promotion distributed locks (SURVEY.md §1 L0, §5.8;
+backend.py:70-71, server.py:139-147). That buys it two properties the
+framework must keep:
+
+1. **Resume-on-restart**: a worker reboot re-attaches to the in-flight round
+   (backend.py:93-97).
+2. **Multi-worker exclusion**: generation/promotion run once per round even
+   with N workers (locks, backend.py:83-87, 155-159, 206-210).
+
+This module defines the abstract :class:`StateStore` contract (the redis
+subset the game actually uses) and two implementations:
+
+- :class:`MemoryStore` — in-process asyncio store with real TTL semantics and
+  lock timeouts; the default for single-host serving and all tests. Supports
+  snapshot/restore to disk for the resume property.
+- a client for the native C++ store lives in ``cassmantle_tpu/native``
+  (optional, same contract) for multi-process deployments.
+
+Keys hold either a string/bytes value, a hash (dict), or a set. TTLs follow
+redis semantics: ``ttl`` returns -2 for missing keys, -1 for keys without
+expiry. All times come from an injectable monotonic clock so round-lifecycle
+tests can run at 2 s/round (SURVEY.md §4 "clock seam").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import pickle
+import time
+import uuid
+from typing import AsyncIterator, Callable, Dict, Iterable, Optional, Set, Union
+
+Value = Union[str, bytes, int, float]
+
+
+class LockTimeout(Exception):
+    """Raised when a distributed lock cannot be acquired in time."""
+
+
+class StateStore:
+    """Abstract async KV/hash/set store with TTLs and distributed locks."""
+
+    # -- plain keys -------------------------------------------------------
+    async def set(self, key: str, value: Value) -> None: raise NotImplementedError
+    async def get(self, key: str) -> Optional[bytes]: raise NotImplementedError
+    async def setex(self, key: str, ttl: float, value: Value) -> None: raise NotImplementedError
+    async def delete(self, *keys: str) -> None: raise NotImplementedError
+    async def exists(self, key: str) -> bool: raise NotImplementedError
+    async def expire(self, key: str, ttl: float) -> None: raise NotImplementedError
+    async def ttl(self, key: str) -> float: raise NotImplementedError
+
+    # -- hashes -----------------------------------------------------------
+    async def hset(self, key: str, field: Optional[str] = None,
+                   value: Optional[Value] = None,
+                   mapping: Optional[Dict[str, Value]] = None) -> None:
+        raise NotImplementedError
+
+    async def hget(self, key: str, field: str) -> Optional[bytes]: raise NotImplementedError
+    async def hgetall(self, key: str) -> Dict[str, bytes]: raise NotImplementedError
+    async def hdel(self, key: str, *fields: str) -> None: raise NotImplementedError
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        raise NotImplementedError
+
+    # -- sets -------------------------------------------------------------
+    async def sadd(self, key: str, *members: str) -> None: raise NotImplementedError
+    async def srem(self, key: str, *members: str) -> None: raise NotImplementedError
+    async def smembers(self, key: str) -> Set[str]: raise NotImplementedError
+    async def sismember(self, key: str, member: str) -> bool: raise NotImplementedError
+
+    # -- locks ------------------------------------------------------------
+    def lock(self, name: str, timeout: float = 120.0,
+             blocking_timeout: float = 2.0):
+        """Async context manager; raises LockTimeout if not acquired."""
+        raise NotImplementedError
+
+    async def close(self) -> None:  # pragma: no cover - trivial
+        pass
+
+
+def _to_bytes(v: Value) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    return str(v).encode()
+
+
+class MemoryStore(StateStore):
+    """In-process store with redis-like TTL + lock semantics."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None) -> None:
+        self._data: Dict[str, object] = {}
+        self._deadlines: Dict[str, float] = {}
+        self._clock = clock or time.monotonic
+        # Lock table: name -> (owner token, expiry deadline).
+        self._locks: Dict[str, tuple] = {}
+        self._lock_cond = asyncio.Condition()
+
+    # -- expiry helpers ---------------------------------------------------
+    def _alive(self, key: str) -> bool:
+        if key not in self._data:
+            return False
+        deadline = self._deadlines.get(key)
+        if deadline is not None and self._clock() >= deadline:
+            del self._data[key]
+            del self._deadlines[key]
+            return False
+        return True
+
+    # -- plain keys -------------------------------------------------------
+    async def set(self, key: str, value: Value) -> None:
+        self._data[key] = _to_bytes(value)
+        self._deadlines.pop(key, None)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        if not self._alive(key):
+            return None
+        v = self._data[key]
+        return v if isinstance(v, bytes) else None
+
+    async def setex(self, key: str, ttl: float, value: Value) -> None:
+        self._data[key] = _to_bytes(value)
+        self._deadlines[key] = self._clock() + ttl
+
+    async def delete(self, *keys: str) -> None:
+        for key in keys:
+            self._data.pop(key, None)
+            self._deadlines.pop(key, None)
+
+    async def exists(self, key: str) -> bool:
+        return self._alive(key)
+
+    async def expire(self, key: str, ttl: float) -> None:
+        if self._alive(key):
+            self._deadlines[key] = self._clock() + ttl
+
+    async def ttl(self, key: str) -> float:
+        if not self._alive(key):
+            return -2.0
+        deadline = self._deadlines.get(key)
+        if deadline is None:
+            return -1.0
+        return max(0.0, deadline - self._clock())
+
+    # -- hashes -----------------------------------------------------------
+    def _hash(self, key: str, create: bool = False) -> Optional[Dict[str, bytes]]:
+        if not self._alive(key):
+            if not create:
+                return None
+            self._data[key] = {}
+        h = self._data[key]
+        assert isinstance(h, dict), f"{key} is not a hash"
+        return h
+
+    async def hset(self, key: str, field: Optional[str] = None,
+                   value: Optional[Value] = None,
+                   mapping: Optional[Dict[str, Value]] = None) -> None:
+        h = self._hash(key, create=True)
+        if field is not None:
+            h[field] = _to_bytes(value)
+        if mapping:
+            for k, v in mapping.items():
+                h[k] = _to_bytes(v)
+
+    async def hget(self, key: str, field: str) -> Optional[bytes]:
+        h = self._hash(key)
+        return None if h is None else h.get(field)
+
+    async def hgetall(self, key: str) -> Dict[str, bytes]:
+        h = self._hash(key)
+        return {} if h is None else dict(h)
+
+    async def hdel(self, key: str, *fields: str) -> None:
+        h = self._hash(key)
+        if h is not None:
+            for f in fields:
+                h.pop(f, None)
+
+    async def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        h = self._hash(key, create=True)
+        new = int(h.get(field, b"0")) + amount
+        h[field] = str(new).encode()
+        return new
+
+    # -- sets -------------------------------------------------------------
+    def _set(self, key: str, create: bool = False) -> Optional[Set[str]]:
+        if not self._alive(key):
+            if not create:
+                return None
+            self._data[key] = set()
+        s = self._data[key]
+        assert isinstance(s, set), f"{key} is not a set"
+        return s
+
+    async def sadd(self, key: str, *members: str) -> None:
+        self._set(key, create=True).update(members)
+
+    async def srem(self, key: str, *members: str) -> None:
+        s = self._set(key)
+        if s is not None:
+            s.difference_update(members)
+
+    async def smembers(self, key: str) -> Set[str]:
+        s = self._set(key)
+        return set() if s is None else set(s)
+
+    async def sismember(self, key: str, member: str) -> bool:
+        s = self._set(key)
+        return s is not None and member in s
+
+    # -- locks ------------------------------------------------------------
+    @contextlib.asynccontextmanager
+    async def lock(self, name: str, timeout: float = 120.0,
+                   blocking_timeout: float = 2.0) -> AsyncIterator[None]:
+        """Mutual exclusion with hold-timeout (a crashed holder's lock
+        self-expires after ``timeout``, like a redis lock's TTL)."""
+        token = uuid.uuid4().hex
+        deadline = self._clock() + blocking_timeout
+        acquired = False
+        while True:
+            async with self._lock_cond:
+                held = self._locks.get(name)
+                if held is None or self._clock() >= held[1]:
+                    self._locks[name] = (token, self._clock() + timeout)
+                    acquired = True
+                    break
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    break
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(
+                        self._lock_cond.wait(), timeout=min(remaining, 0.05)
+                    )
+        if not acquired:
+            raise LockTimeout(name)
+        try:
+            yield
+        finally:
+            async with self._lock_cond:
+                held = self._locks.get(name)
+                if held is not None and held[0] == token:
+                    del self._locks[name]
+                self._lock_cond.notify_all()
+
+    # -- durability (the reference gets this from redis persistence) ------
+    def snapshot(self, path: str) -> None:
+        """Persist non-expired state so a restart resumes the round."""
+        now = self._clock()
+        state = {
+            "data": {k: v for k, v in self._data.items() if self._alive(k)},
+            "ttl_remaining": {
+                k: self._deadlines[k] - now
+                for k in self._deadlines
+                if k in self._data
+            },
+        }
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        now = self._clock()
+        self._data = state["data"]
+        self._deadlines = {
+            k: now + rem
+            for k, rem in state["ttl_remaining"].items()
+            if rem > 0
+        }
+        for k, rem in state["ttl_remaining"].items():
+            if rem <= 0:
+                self._data.pop(k, None)

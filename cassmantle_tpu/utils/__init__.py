@@ -1,0 +1,10 @@
+from cassmantle_tpu.utils.codec import (  # noqa: F401
+    decode_jpeg,
+    encode_jpeg,
+    image_to_base64,
+)
+from cassmantle_tpu.utils.text import (  # noqa: F401
+    detokenize,
+    format_clock,
+    tokenize_words,
+)

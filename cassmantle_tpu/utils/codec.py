@@ -1,0 +1,34 @@
+"""Host-side image codec: device arrays <-> JPEG bytes <-> base64.
+
+The reference stores round images as JPEG bytes in Redis and re-encodes per
+request (utils.py:12-16, main.py:100-107). We keep JPEG-in-store for the same
+resume-on-restart property, but the blur happens on device (ops/blur.py), so
+the codec boundary is uint8 HWC arrays.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+from PIL import Image
+
+
+def encode_jpeg(image: np.ndarray, quality: int = 90) -> bytes:
+    """uint8 HWC RGB array -> JPEG bytes."""
+    arr = np.asarray(image)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def decode_jpeg(data: bytes) -> np.ndarray:
+    """JPEG bytes -> uint8 HWC RGB array."""
+    return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+
+
+def image_to_base64(image: np.ndarray, quality: int = 90) -> str:
+    return base64.b64encode(encode_jpeg(image, quality)).decode()

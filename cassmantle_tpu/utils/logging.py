@@ -1,0 +1,89 @@
+"""Structured logging + metrics counters.
+
+The reference's only observability is print statements with [INFO]/[ERROR]
+prefixes (SURVEY.md §5.1/§5.5). Here: stdlib logging with a single namespaced
+logger tree, plus a tiny in-process metrics registry (counters/gauges/latency
+histograms) surfaced by the server's /metrics route — the north-star metric is
+images/sec/chip, so the serving path increments these at every stage.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(f"cassmantle.{name}")
+    if not logging.getLogger("cassmantle").handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s %(message)s"
+            )
+        )
+        root = logging.getLogger("cassmantle")
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+    return logger
+
+
+class Metrics:
+    """Thread-safe counters/gauges/timers. One global registry per process."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._timings: Dict[str, List[float]] = defaultdict(list)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            samples = self._timings[name]
+            samples.append(seconds)
+            if len(samples) > 1024:  # bounded memory
+                del samples[: len(samples) - 1024]
+
+    @contextmanager
+    def timer(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - start)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            timings = {}
+            for name, samples in self._timings.items():
+                if not samples:
+                    continue
+                ordered = sorted(samples)
+                timings[name] = {
+                    "count": len(ordered),
+                    "mean_s": sum(ordered) / len(ordered),
+                    "p50_s": ordered[len(ordered) // 2],
+                    "p99_s": ordered[min(len(ordered) - 1,
+                                         int(len(ordered) * 0.99))],
+                }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timings": timings,
+            }
+
+
+metrics = Metrics()

@@ -1,0 +1,50 @@
+"""JAX profiler helpers: trace capture + per-stage device timing.
+
+The reference has no tracing at all (SURVEY.md §5.1). We wrap
+``jax.profiler`` so any serving stage can be captured to a TensorBoard trace
+directory, and provide a ``block_timer`` that synchronizes on device results
+so timings measure device work, not dispatch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("profiling")
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace if log_dir is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+    log.info("profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a region in the device trace (shows up in TensorBoard)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def block_timer(name: str, *results) -> Iterator[list]:
+    """Time a region to metrics, blocking on listed device arrays at exit."""
+    sink: list = []
+    start = time.perf_counter()
+    try:
+        yield sink
+    finally:
+        for r in list(results) + sink:
+            jax.block_until_ready(r)
+        metrics.observe(name, time.perf_counter() - start)

@@ -1,0 +1,58 @@
+"""Word-level tokenization, detokenization, and clock formatting.
+
+The reference tokenizes prompts with nltk.word_tokenize for mask selection
+(utils.py:83) and ships a (buggy, unused) detokenizer (utils.py:18-26 — its
+article-skip condition is always true; see SURVEY.md §2.4). We implement a
+self-contained regex tokenizer with a correct inverse so the framework has no
+runtime NLTK-download dependency and prompt round-tripping is testable.
+"""
+
+from __future__ import annotations
+
+import re
+import string
+from typing import List
+
+_TOKEN_RE = re.compile(
+    r"[A-Za-z]+(?:['’-][A-Za-z]+)*"  # words incl. contractions/hyphens
+    r"|\d+(?:\.\d+)?"                      # numbers
+    r"|[^\sA-Za-z\d]"                      # single punctuation marks
+)
+
+_NO_SPACE_BEFORE = set(".,!?;:)]}%") | {"'", "’", '"'}
+_NO_SPACE_AFTER = set("([{$#") | {'"'}
+
+
+def tokenize_words(text: str) -> List[str]:
+    """Split text into word/punctuation tokens (word indices are stable)."""
+    return _TOKEN_RE.findall(text)
+
+
+def detokenize(tokens: List[str]) -> str:
+    """Inverse of :func:`tokenize_words`, with sane punctuation spacing."""
+    out: List[str] = []
+    no_space_next = False
+    for tok in tokens:
+        if not out:
+            out.append(tok)
+        elif no_space_next or tok in _NO_SPACE_BEFORE or (
+            len(tok) > 1 and tok[0] in {"'", "’"}
+        ):
+            out.append(tok)
+        else:
+            out.append(" " + tok)
+        no_space_next = tok in _NO_SPACE_AFTER
+    return "".join(out)
+
+
+def format_clock(seconds: float) -> str:
+    """Seconds -> mm:ss, clamped at zero (reference utils.py:28-30)."""
+    seconds = max(0, int(seconds))
+    minutes, rem = divmod(seconds, 60)
+    return f"{minutes:02d}:{rem:02d}"
+
+
+def is_wordlike(token: str) -> bool:
+    return bool(token) and token[0] not in string.punctuation and any(
+        c.isalpha() for c in token
+    )

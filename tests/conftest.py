@@ -1,0 +1,44 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+This is the multi-device-without-a-cluster strategy from SURVEY.md §4: all
+collective/sharding tests exercise real XLA collectives on 8 host devices; the
+real-chip path is covered by bench.py and the driver's dryrun.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+from cassmantle_tpu.config import test_config  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (no pytest-asyncio here)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (built-in runner)")
+
+
+@pytest.fixture(scope="session")
+def cfg():
+    return test_config()

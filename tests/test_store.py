@@ -1,0 +1,139 @@
+import asyncio
+
+import pytest
+
+from cassmantle_tpu.engine.store import LockTimeout, MemoryStore
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return MemoryStore(clock=clock)
+
+
+def run(coro):
+    return asyncio.get_event_loop().run_until_complete(coro)
+
+
+@pytest.mark.asyncio
+async def test_plain_keys_and_ttl(store, clock):
+    await store.setex("countdown", 10.0, "active")
+    assert await store.exists("countdown")
+    assert await store.ttl("countdown") == pytest.approx(10.0)
+    clock.t = 5.0
+    assert await store.ttl("countdown") == pytest.approx(5.0)
+    clock.t = 10.0
+    assert not await store.exists("countdown")
+    assert await store.ttl("countdown") == -2.0
+
+
+@pytest.mark.asyncio
+async def test_ttl_semantics_no_expiry(store):
+    await store.set("k", "v")
+    assert await store.ttl("k") == -1.0
+    assert await store.get("k") == b"v"
+
+
+@pytest.mark.asyncio
+async def test_hash_ops(store):
+    await store.hset("session", mapping={"max": 0.01, "won": 0, "attempts": 0})
+    await store.hset("session", "3", "0.5")
+    assert await store.hget("session", "max") == b"0.01"
+    all_ = await store.hgetall("session")
+    assert set(all_) == {"max", "won", "attempts", "3"}
+    assert await store.hincrby("session", "attempts") == 1
+    assert await store.hincrby("session", "attempts", 2) == 3
+    await store.hdel("session", "3")
+    assert await store.hget("session", "3") is None
+
+
+@pytest.mark.asyncio
+async def test_hash_expiry(store, clock):
+    await store.hset("session", "won", 0)
+    await store.expire("session", 2.0)
+    clock.t = 3.0
+    assert await store.hgetall("session") == {}
+
+
+@pytest.mark.asyncio
+async def test_set_ops(store):
+    await store.sadd("sessions", "a", "b")
+    assert await store.sismember("sessions", "a")
+    assert not await store.sismember("sessions", "c")
+    await store.srem("sessions", "a")
+    assert await store.smembers("sessions") == {"b"}
+
+
+@pytest.mark.asyncio
+async def test_lock_mutual_exclusion(store):
+    order = []
+
+    async def holder():
+        async with store.lock("l", timeout=5.0, blocking_timeout=1.0):
+            order.append("h-in")
+            await asyncio.sleep(0.1)
+            order.append("h-out")
+
+    async def waiter():
+        await asyncio.sleep(0.01)
+        async with store.lock("l", timeout=5.0, blocking_timeout=1.0):
+            order.append("w-in")
+
+    await asyncio.gather(holder(), waiter())
+    assert order == ["h-in", "h-out", "w-in"]
+
+
+@pytest.mark.asyncio
+async def test_lock_acquire_timeout():
+    store = MemoryStore()  # real clock: blocking_timeout is wall time
+    async def holder():
+        async with store.lock("l", timeout=5.0, blocking_timeout=0.5):
+            await asyncio.sleep(0.3)
+
+    async def contender():
+        await asyncio.sleep(0.01)
+        with pytest.raises(LockTimeout):
+            async with store.lock("l", timeout=5.0, blocking_timeout=0.05):
+                pass
+
+    await asyncio.gather(holder(), contender())
+
+
+@pytest.mark.asyncio
+async def test_lock_hold_timeout_self_expires(store, clock):
+    """A crashed holder's lock must self-expire (redis-TTL semantics)."""
+    mgr = store.lock("l", timeout=2.0, blocking_timeout=0.1)
+    await mgr.__aenter__()  # never exited: simulated crash
+    clock.t = 3.0
+    async with store.lock("l", timeout=2.0, blocking_timeout=0.1):
+        pass  # acquired because the stale lock expired
+
+
+@pytest.mark.asyncio
+async def test_snapshot_restore(tmp_path, store, clock):
+    await store.hset("prompt", "current", '{"tokens": []}')
+    await store.setex("countdown", 10.0, "active")
+    await store.sadd("sessions", "s1")
+    clock.t = 4.0
+    path = str(tmp_path / "snap.pkl")
+    store.snapshot(path)
+
+    clock2 = FakeClock()
+    clock2.t = 100.0
+    store2 = MemoryStore(clock=clock2)
+    store2.restore(path)
+    assert await store2.hget("prompt", "current") == b'{"tokens": []}'
+    assert await store2.ttl("countdown") == pytest.approx(6.0)
+    assert await store2.smembers("sessions") == {"s1"}

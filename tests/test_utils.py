@@ -1,0 +1,59 @@
+import numpy as np
+
+from cassmantle_tpu.utils.codec import decode_jpeg, encode_jpeg, image_to_base64
+from cassmantle_tpu.utils.text import (
+    detokenize,
+    format_clock,
+    is_wordlike,
+    tokenize_words,
+)
+
+
+def test_tokenize_roundtrip():
+    text = "A lone lighthouse, battered by storms, glows faintly."
+    tokens = tokenize_words(text)
+    assert "lighthouse" in tokens and "," in tokens
+    assert detokenize(tokens) == text
+
+
+def test_tokenize_contractions():
+    tokens = tokenize_words("It wasn't the captain's fault.")
+    assert "wasn't" in tokens
+    assert "captain's" in tokens
+
+
+def test_token_indices_stable():
+    tokens = tokenize_words("red fox, red sky")
+    assert tokens == ["red", "fox", ",", "red", "sky"]
+    # duplicate words keep distinct indices (fixes reference utils.py:102
+    # first-occurrence bug noted in SURVEY.md §2 #9)
+    assert tokens.index("red") == 0 and tokens[3] == "red"
+
+
+def test_format_clock():
+    assert format_clock(899) == "14:59"
+    assert format_clock(0) == "00:00"
+    assert format_clock(-3) == "00:00"
+
+
+def test_is_wordlike():
+    assert is_wordlike("storm")
+    assert not is_wordlike(",")
+    assert not is_wordlike("")
+
+
+def test_jpeg_roundtrip():
+    # smooth gradient: JPEG should round-trip it nearly losslessly
+    y, x = np.mgrid[0:64, 0:64]
+    img = np.stack([x * 4, y * 4, (x + y) * 2], axis=-1).astype(np.uint8)
+    data = encode_jpeg(img, quality=95)
+    back = decode_jpeg(data)
+    assert back.shape == (64, 64, 3)
+    assert back.dtype == np.uint8
+    assert np.abs(back.astype(int) - img.astype(int)).mean() < 8
+
+
+def test_base64():
+    img = np.zeros((8, 8, 3), dtype=np.uint8)
+    s = image_to_base64(img)
+    assert isinstance(s, str) and len(s) > 0
