@@ -1,0 +1,77 @@
+"""Content backends: the generation seam.
+
+All model compute funnels through :class:`ContentBackend.generate` — the
+same seam the reference exposes via ``generate_prompt``/``generate_image``
+(backend.py:240-295, SURVEY.md §4 "inference seam"). Production wires in
+:class:`TPUContentBackend` (serving/pipeline.py); tests and the model-free
+engine stage use :class:`FakeContentBackend`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import numpy as np
+
+from cassmantle_tpu.engine.rounds import ContentBackend, RoundContent
+
+_FAKE_SENTENCES = [
+    "The {adj} {noun} drifted across the {place} under a {color} sky.",
+    "A {adj} {noun} waited near the {place}, humming a {color} tune.",
+    "Nobody expected the {adj} {noun} to appear beside the {place} at dusk.",
+]
+_ADJ = ["ancient", "glowing", "crooked", "silent", "restless", "gilded"]
+_NOUN = ["lighthouse", "caravan", "automaton", "orchard", "archive", "comet"]
+_PLACE = ["harbor", "observatory", "market", "glacier", "station", "canyon"]
+_COLOR = ["crimson", "violet", "amber", "teal", "silver", "emerald"]
+
+
+class FakeContentBackend(ContentBackend):
+    """Deterministic, instant content: text from a seed-hash template, image
+    = a solid-pattern gradient keyed by the text. Lets the full game run
+    with zero model compute (engine stage 1, SURVEY.md §7.1)."""
+
+    def __init__(self, image_size: int = 64, delay_s: float = 0.0) -> None:
+        self.image_size = image_size
+        self.delay_s = delay_s
+        self.calls = 0
+
+    async def generate(self, seed: str, is_seed: bool) -> RoundContent:
+        self.calls += 1
+        if self.delay_s:
+            await asyncio.sleep(self.delay_s)
+        digest = hashlib.sha256(seed.encode()).digest()
+        pick = lambda options, i: options[digest[i] % len(options)]  # noqa: E731
+        text = _FAKE_SENTENCES[digest[0] % len(_FAKE_SENTENCES)].format(
+            adj=pick(_ADJ, 1), noun=pick(_NOUN, 2),
+            place=pick(_PLACE, 3), color=pick(_COLOR, 4),
+        )
+        size = self.image_size
+        y, x = np.mgrid[0:size, 0:size]
+        r = (x * int(digest[5]) // size) % 256
+        g = (y * int(digest[6]) // size) % 256
+        b = ((x + y) * int(digest[7]) // (2 * size)) % 256
+        image = np.stack([r, g, b], axis=-1).astype(np.uint8)
+        return RoundContent(prompt_text=text, image=image)
+
+
+def hash_embed(words, dim: int = 32) -> np.ndarray:
+    """Deterministic stub embedding for tests: word -> unit vector derived
+    from its sha256. Similar only to itself; stable across runs."""
+    out = np.zeros((len(words), dim), dtype=np.float32)
+    for i, w in enumerate(words):
+        h = hashlib.sha256(w.lower().encode()).digest()
+        vec = np.frombuffer((h * ((dim * 4) // len(h) + 1))[: dim * 4],
+                            dtype=np.uint32).astype(np.float32)
+        vec = (vec / np.float32(2**32)) - 0.5
+        out[i] = vec / (np.linalg.norm(vec) + 1e-8)
+    return out
+
+
+async def hash_similarity(pairs) -> np.ndarray:
+    """Stub similarity: cosine of hash_embed vectors (≈0 for distinct
+    words, 1 for identical)."""
+    guesses = hash_embed([g for g, _ in pairs])
+    answers = hash_embed([a for _, a in pairs])
+    return np.sum(guesses * answers, axis=-1)

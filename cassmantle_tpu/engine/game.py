@@ -1,0 +1,187 @@
+"""Game facade: the engine API the HTTP layer talks to.
+
+Composes sessions + rounds + scoring over one state store — the same public
+surface the reference's ``Server`` class exposes to its FastAPI routes
+(SURVEY.md §1 L3: init_client, add_client, remove_connection, player_count,
+fetch_clock, fetch_client_scores, fetch_masked_image, fetch_prompt_json,
+fetch_story, compute_client_scores) but composed instead of inherited, and
+with the blur applied on device (ops/blur.py) instead of per-request PIL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.engine.masking import EmbedFn
+from cassmantle_tpu.engine.rounds import ContentBackend, RoundManager
+from cassmantle_tpu.engine.scoring import GuessScorer, SimilarityFn, score_to_blur
+from cassmantle_tpu.engine.sessions import SessionManager
+from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.utils.logging import metrics
+from cassmantle_tpu.utils.text import format_clock
+
+# (image uint8 HWC, blur_radius) -> blurred uint8 HWC
+BlurFn = Callable[[np.ndarray, float], np.ndarray]
+
+
+def _pil_blur(image: np.ndarray, radius: float) -> np.ndarray:
+    """Host fallback blur; production injects the TPU blur op."""
+    from PIL import Image, ImageFilter
+
+    if radius <= 0:
+        return image
+    pil = Image.fromarray(image).filter(ImageFilter.GaussianBlur(radius))
+    return np.asarray(pil)
+
+
+class Game:
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        store: StateStore,
+        backend: ContentBackend,
+        embed: EmbedFn,
+        similarity: SimilarityFn,
+        blur_fn: Optional[BlurFn] = None,
+    ) -> None:
+        game_cfg = cfg.game
+        self.cfg = cfg
+        self.store = store
+        self.sessions = SessionManager(
+            store, game_cfg.min_score, game_cfg.time_per_prompt
+        )
+        self.scorer = GuessScorer(similarity, game_cfg.min_score)
+        self.rounds = RoundManager(
+            store,
+            backend,
+            embed,
+            seeds=self._load_seeds(),
+            time_per_prompt=game_cfg.time_per_prompt,
+            buffer_at_fraction=game_cfg.buffer_at_fraction,
+            num_masked=game_cfg.num_masked,
+            episodes_per_story=game_cfg.episodes_per_story,
+            lock_timeout=game_cfg.lock_timeout,
+            acquire_timeout=game_cfg.acquire_timeout,
+            on_promote=self._reset_sessions,
+        )
+        self.blur_fn = blur_fn or _pil_blur
+
+    def _load_seeds(self) -> list:
+        from cassmantle_tpu.server.assets import load_seeds
+
+        return load_seeds()
+
+    async def _reset_sessions(self) -> None:
+        await self.sessions.reset_all(await self.rounds.current_masks())
+
+    # -- lifecycle --------------------------------------------------------
+    async def startup(self) -> None:
+        await self.rounds.startup()
+
+    def start_timer(self, tick: float = 1.0) -> asyncio.Task:
+        return self.rounds.start(tick)
+
+    async def shutdown(self) -> None:
+        await self.rounds.stop()
+        await self.store.close()
+
+    # -- client API -------------------------------------------------------
+    async def init_client(self, session: str) -> None:
+        await self.sessions.init_client(
+            session, await self.rounds.current_masks()
+        )
+
+    async def client_status(self, session: Optional[str]) -> Dict[str, object]:
+        if not session or not await self.sessions.exists(session):
+            return {"needInitialization": True}
+        scores = await self.sessions.fetch_scores(session)
+        return {
+            "won": int(scores.get("won", 0) or 0),
+            "needInitialization": False,
+        }
+
+    async def ensure_client(self, session: str) -> None:
+        if not await self.sessions.exists(session):
+            await self.init_client(session)
+
+    async def fetch_masked_image(self, session: str) -> np.ndarray:
+        """Per-session progressive reveal (server.py:129-133)."""
+        scores = await self.sessions.fetch_scores(session)
+        image = await self.rounds.fetch_current_image()
+        best = float(scores.get("max", self.cfg.game.min_score))
+        radius = score_to_blur(
+            best, self.cfg.game.min_blur, self.cfg.game.max_blur
+        )
+        with metrics.timer("game.blur_s"):
+            return self.blur_fn(image, radius)
+
+    async def fetch_prompt_json(self, session: str) -> Dict[str, object]:
+        """Client-visible prompt state (server.py:96-123): solved masks are
+        flagged -1 + listed in ``correct``; unsolved mask tokens are '*'."""
+        prompt = await self.rounds.fetch_current_prompt()
+        await self.ensure_client(session)
+        scores = await self.sessions.fetch_scores(session)
+        attempts = int(scores.get("attempts", 0) or 0)
+        prompt = {
+            "tokens": list(prompt["tokens"]),
+            "masks": list(prompt["masks"]),
+            "correct": [],
+        }
+        if int(scores.get("won", 0) or 0) == 1:
+            prompt["masks"] = []
+        else:
+            for i, mask in enumerate(list(prompt["masks"])):
+                score = scores.get(str(mask))
+                if score is not None and float(score) == 1.0:
+                    prompt["masks"][i] = -1
+                    prompt["correct"].append(mask)
+                else:
+                    prompt["tokens"][mask] = "*"
+        prompt["scores"] = scores
+        prompt["attempts"] = attempts
+        return prompt
+
+    async def fetch_story(self) -> Dict[str, str]:
+        return await self.rounds.fetch_story()
+
+    async def compute_client_scores(
+        self, session: str, inputs: Dict[str, str]
+    ) -> Dict[str, object]:
+        """Guess path (server.py:63-76): score inputs against the masked
+        answer tokens, update the session, bump attempts."""
+        await self.ensure_client(session)
+        prompt = await self.rounds.fetch_current_prompt()
+        tokens = prompt["tokens"]
+        valid_masks = {str(m) for m in prompt["masks"]}
+        pairs = {}
+        for mask_idx, guess in inputs.items():
+            if str(mask_idx) not in valid_masks:
+                continue  # stale or hostile input; reference would KeyError
+            pairs[str(mask_idx)] = {
+                "input": str(guess),
+                "answer": tokens[int(mask_idx)],
+            }
+        if not pairs:
+            return {"won": 0}
+        with metrics.timer("game.score_s"):
+            scores = await self.scorer.score_pairs(pairs)
+        result = await self.sessions.set_scores(session, scores)
+        await self.sessions.increment_attempt(session)
+        metrics.inc("game.guesses", len(pairs))
+        return result
+
+    # -- clock / presence -------------------------------------------------
+    async def fetch_clock(self) -> str:
+        return format_clock(await self.rounds.remaining())
+
+    async def clock_payload(self) -> Dict[str, object]:
+        """One WS /clock tick (main.py:61-67)."""
+        return {
+            "time": await self.fetch_clock(),
+            "reset": await self.rounds.reset_flag(),
+            "conns": await self.sessions.player_count(),
+        }

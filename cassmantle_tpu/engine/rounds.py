@@ -1,0 +1,263 @@
+"""Round lifecycle: story line, content double-buffer, global clock.
+
+Keeps the reference's control loop shape (SURVEY.md §3.2):
+
+- the countdown is a store key with a TTL; reading the clock = reading the
+  TTL (server.py:139-147);
+- at 70% of the round, the *next* round's content is generated into a buffer
+  (server.py:162-163, backend.py:152-202);
+- at 0, the buffer is atomically promoted, sessions reset, the clock
+  restarts, and a 1 s ``reset`` flag tells clients to refetch
+  (server.py:166-170, backend.py:204-238);
+- every story runs ``episodes_per_story`` episodes, each episode's prompt
+  continuing from the previous one, then a fresh seed starts a new story
+  (backend.py:137-150);
+- all generation/promotion runs under store locks with skip-don't-crash
+  semantics: if generation fails, the old round silently replays
+  (backend.py:211-215 — promotion is a no-op when the buffer is empty).
+
+Generation itself is behind the :class:`ContentBackend` protocol — the TPU
+serving pipeline in production, a deterministic fake in tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import random
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from cassmantle_tpu.engine.masking import EmbedFn, build_prompt_state
+from cassmantle_tpu.engine.store import LockTimeout, StateStore
+from cassmantle_tpu.utils.codec import decode_jpeg, encode_jpeg
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("rounds")
+
+PROMPT_KEY = "prompt"
+IMAGE_KEY = "image"
+STORY_KEY = "story"
+COUNTDOWN_KEY = "countdown"
+RESET_KEY = "reset"
+
+
+@dataclasses.dataclass
+class RoundContent:
+    """One round's generated content."""
+
+    prompt_text: str          # the two-sentence episode text
+    image: np.ndarray         # uint8 HWC RGB
+
+
+class ContentBackend:
+    """Produces round content. ``seed`` is the story-so-far (or a fresh
+    title when ``is_seed``); returns the episode text + rendered image."""
+
+    async def generate(self, seed: str, is_seed: bool) -> RoundContent:
+        raise NotImplementedError
+
+
+class RoundManager:
+    def __init__(
+        self,
+        store: StateStore,
+        backend: ContentBackend,
+        embed: EmbedFn,
+        *,
+        seeds: Sequence[str],
+        time_per_prompt: float = 900.0,
+        buffer_at_fraction: float = 0.7,
+        num_masked: int = 2,
+        episodes_per_story: int = 20,
+        lock_timeout: float = 120.0,
+        acquire_timeout: float = 2.0,
+        rng: Optional[random.Random] = None,
+        on_promote: Optional[Callable[[], object]] = None,
+    ) -> None:
+        self.store = store
+        self.backend = backend
+        self.embed = embed
+        self.seeds = list(seeds)
+        self.time_per_prompt = time_per_prompt
+        self.buffer_at_fraction = buffer_at_fraction
+        self.num_masked = num_masked
+        self.episodes_per_story = episodes_per_story
+        self.lock_timeout = lock_timeout
+        self.acquire_timeout = acquire_timeout
+        self.rng = rng or random.Random()
+        # async callback run after each promotion (the game layer resets
+        # sessions there, mirroring server.py:168).
+        self.on_promote = on_promote
+        self._timer_task: Optional[asyncio.Task] = None
+
+    # -- story ------------------------------------------------------------
+    def select_seed(self) -> str:
+        return self.rng.choice(self.seeds)
+
+    async def init_story(self, title: str) -> None:
+        await self.store.hset(STORY_KEY, mapping={"title": title, "episode": 0})
+
+    async def fetch_story(self) -> Dict[str, str]:
+        raw = await self.store.hgetall(STORY_KEY)
+        return {k: v.decode() for k, v in raw.items()}
+
+    async def _next_seed(self) -> tuple:
+        """(is_seed, seed): continue the story or start a new one
+        (reference ``random_seed``, backend.py:137-150)."""
+        eps_raw = await self.store.hget(STORY_KEY, "episode")
+        episodes = int(eps_raw or 0)
+        if episodes < self.episodes_per_story:
+            prev = await self.store.hget(PROMPT_KEY, "seed")
+            if prev is not None:
+                return False, prev.decode()
+        return True, self.select_seed()
+
+    # -- content helpers --------------------------------------------------
+    async def _store_content(self, slot: str, content: RoundContent) -> None:
+        prompt_state = build_prompt_state(
+            content.prompt_text, self.embed, self.num_masked
+        )
+        await self.store.hset(PROMPT_KEY, "seed", content.prompt_text)
+        await self.store.hset(PROMPT_KEY, slot, json.dumps(prompt_state))
+        await self.store.hset(IMAGE_KEY, slot, encode_jpeg(content.image))
+
+    async def fetch_current_prompt(self) -> Dict[str, object]:
+        raw = await self.store.hget(PROMPT_KEY, "current")
+        assert raw is not None, "no current prompt available"
+        return json.loads(raw.decode())
+
+    async def fetch_current_image(self) -> np.ndarray:
+        raw = await self.store.hget(IMAGE_KEY, "current")
+        assert raw is not None, "no current image available"
+        return decode_jpeg(raw)
+
+    async def current_masks(self) -> list:
+        return list((await self.fetch_current_prompt())["masks"])
+
+    # -- lifecycle --------------------------------------------------------
+    async def startup(self) -> None:
+        """Generate initial content unless a live round survives in the
+        store (resume-on-restart, backend.py:93-97)."""
+        await self.store.hset(PROMPT_KEY, "status", "idle")
+        await self.store.hset(IMAGE_KEY, "status", "idle")
+        try:
+            async with self.store.lock(
+                "startup_lock", timeout=self.lock_timeout,
+                blocking_timeout=self.acquire_timeout,
+            ):
+                if await self.store.hget(PROMPT_KEY, "current") is not None \
+                        and await self.store.hget(IMAGE_KEY, "current") is not None:
+                    log.info("resuming in-flight round from store")
+                    return
+                title = self.select_seed()
+                await self.init_story(title)
+                with metrics.timer("round.generate_s"):
+                    content = await self.backend.generate(title, is_seed=True)
+                await self._store_content("current", content)
+                await self.store.hincrby(STORY_KEY, "episode", 1)
+                metrics.inc("rounds.generated")
+                log.info("content initialization complete")
+        except LockTimeout:
+            log.info("startup lock held elsewhere; waiting for content")
+
+    async def buffer_contents(self) -> None:
+        """Pre-generate next round into the buffer (backend.py:152-202)."""
+        try:
+            async with self.store.lock(
+                "buffer_lock", timeout=self.lock_timeout,
+                blocking_timeout=self.acquire_timeout,
+            ):
+                if await self.store.hget(PROMPT_KEY, "next") is not None:
+                    return
+                is_seed, seed = await self._next_seed()
+                if is_seed:
+                    log.info("restarting storyline")
+                    await self.store.hset(STORY_KEY, "next", seed)
+                with metrics.timer("round.generate_s"):
+                    content = await self.backend.generate(seed, is_seed)
+                await self._store_content("next", content)
+                metrics.inc("rounds.buffered")
+                log.info("content buffering complete")
+        except LockTimeout:
+            log.info("buffer lock held elsewhere; skipping")
+        except Exception:
+            log.exception("buffering failed; old round will replay")
+            metrics.inc("rounds.buffer_failures")
+
+    async def promote_buffer(self) -> None:
+        """Swap next→current if a buffer exists (backend.py:204-238)."""
+        try:
+            async with self.store.lock(
+                "promotion_lock", timeout=self.lock_timeout,
+                blocking_timeout=self.acquire_timeout,
+            ):
+                prompt_next = await self.store.hget(PROMPT_KEY, "next")
+                image_next = await self.store.hget(IMAGE_KEY, "next")
+                if prompt_next is None or image_next is None:
+                    log.warning("no buffered content; replaying round")
+                    metrics.inc("rounds.replays")
+                    return
+                await self.store.hset(PROMPT_KEY, "current", prompt_next)
+                await self.store.hset(IMAGE_KEY, "current", image_next)
+                await self.store.hdel(PROMPT_KEY, "next")
+                await self.store.hdel(IMAGE_KEY, "next")
+                next_story = await self.store.hget(STORY_KEY, "next")
+                if next_story is not None:
+                    await self.init_story(next_story.decode())
+                    await self.store.hdel(STORY_KEY, "next")
+                await self.store.hincrby(STORY_KEY, "episode", 1)
+                metrics.inc("rounds.promoted")
+                log.info("buffer promotion complete")
+        except LockTimeout:
+            log.info("promotion lock held elsewhere; skipping")
+
+    # -- clock ------------------------------------------------------------
+    async def start_countdown(self) -> None:
+        await self.store.setex(COUNTDOWN_KEY, self.time_per_prompt, "active")
+
+    async def remaining(self) -> float:
+        return max(0.0, await self.store.ttl(COUNTDOWN_KEY))
+
+    async def reset_flag(self) -> bool:
+        return await self.store.exists(RESET_KEY)
+
+    async def rollover(self) -> None:
+        """End-of-round sequence (server.py:166-170)."""
+        await self.promote_buffer()
+        if self.on_promote is not None:
+            await self.on_promote()
+        await self.start_countdown()
+        await self.store.setex(RESET_KEY, 1.0, 1)
+
+    async def global_timer(self, tick: float = 1.0) -> None:
+        """1 Hz drive loop (server.py:152-172). Cancel the task to stop."""
+        await self.start_countdown()
+        buffer_trigger = self.time_per_prompt * self.buffer_at_fraction
+        buffered_this_round = False
+        while True:
+            await asyncio.sleep(tick)
+            remaining = await self.store.ttl(COUNTDOWN_KEY)
+            metrics.gauge("round.remaining_s", remaining)
+            if remaining <= 0:
+                await self.rollover()
+                buffered_this_round = False
+                continue
+            if remaining <= buffer_trigger and not buffered_this_round:
+                buffered_this_round = True
+                asyncio.ensure_future(self.buffer_contents())
+
+    def start(self, tick: float = 1.0) -> asyncio.Task:
+        self._timer_task = asyncio.ensure_future(self.global_timer(tick))
+        return self._timer_task
+
+    async def stop(self) -> None:
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+            try:
+                await self._timer_task
+            except asyncio.CancelledError:
+                pass
+            self._timer_task = None

@@ -1,0 +1,62 @@
+"""Story seeds and art styles (original content, reference-shaped).
+
+The reference ships 17 one-line story seed titles and 7 style names as text
+files (data/seeds.txt, data/styles.txt; SURVEY.md §2 #13). We keep the same
+file format and loading contract but ship our own content, and fall back to
+built-ins when the data files are absent.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "data")
+
+_DEFAULT_SEEDS = [
+    "The Cartographer of Drowned Cities",
+    "A Winter Without Clocks",
+    "The Orchard at the Edge of the Map",
+    "Letters from the Glass Lighthouse",
+    "The Night the Trains Sang",
+    "Keeper of the Paper Storms",
+    "The Astronomer's Unsent Telegrams",
+    "Salt Roads and Silver Rivers",
+    "The Museum of Almost-Forgotten Sounds",
+    "A Harbor for Runaway Shadows",
+    "The Clockmaker's Second Moon",
+    "Embers over the Quiet Canyon",
+    "The Librarian Who Collected Horizons",
+    "Caravan of the Painted Comets",
+    "The Garden Below the Ice",
+    "Signals from the Tin Observatory",
+    "The Last Ferry to the Floating Market",
+]
+
+_DEFAULT_STYLES = [
+    "Watercolor",
+    "Art deco",
+    "Ukiyo-e woodblock",
+    "Low-poly 3D render",
+    "Charcoal sketch",
+    "Stained glass",
+    "Vaporwave",
+]
+
+
+def _load_lines(path: str, fallback: List[str]) -> List[str]:
+    try:
+        with open(path, "r") as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        return lines or list(fallback)
+    except OSError:
+        return list(fallback)
+
+
+def load_seeds() -> List[str]:
+    return _load_lines(os.path.join(DATA_DIR, "seeds.txt"), _DEFAULT_SEEDS)
+
+
+def load_styles() -> List[str]:
+    return _load_lines(os.path.join(DATA_DIR, "styles.txt"), _DEFAULT_STYLES)

@@ -1,0 +1,213 @@
+"""Engine integration: full round lifecycle on a fast clock with the fake
+backend (SURVEY.md §4 test pyramid tier 4, at time_per_prompt=2s scaled
+down further via the injectable store clock)."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.engine.content import (
+    FakeContentBackend,
+    hash_embed,
+    hash_similarity,
+)
+from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.engine.store import MemoryStore
+
+
+def make_game(time_per_prompt=2.0):
+    cfg = _tiny_config()
+    cfg = cfg.replace(game=dataclasses.replace(
+        cfg.game, time_per_prompt=time_per_prompt,
+    ))
+    store = MemoryStore()
+    backend = FakeContentBackend(image_size=32)
+    game = Game(cfg, store, backend, hash_embed, hash_similarity)
+    return game, backend
+
+
+@pytest.mark.asyncio
+async def test_startup_creates_round():
+    game, backend = make_game()
+    await game.startup()
+    prompt = await game.rounds.fetch_current_prompt()
+    assert prompt["tokens"] and len(prompt["masks"]) == 2
+    image = await game.rounds.fetch_current_image()
+    assert image.shape == (32, 32, 3)
+    story = await game.fetch_story()
+    assert story["episode"] == "1" and story["title"]
+
+
+@pytest.mark.asyncio
+async def test_startup_resumes_existing_round():
+    game, backend = make_game()
+    await game.startup()
+    assert backend.calls == 1
+    # second worker startup on the same store: no regeneration
+    await game.startup()
+    assert backend.calls == 1
+
+
+@pytest.mark.asyncio
+async def test_client_session_and_status():
+    game, _ = make_game()
+    await game.startup()
+    assert (await game.client_status(None))["needInitialization"]
+    await game.init_client("s1")
+    status = await game.client_status("s1")
+    assert status == {"won": 0, "needInitialization": False}
+    assert await game.sessions.player_count() == 1
+
+
+@pytest.mark.asyncio
+async def test_prompt_json_masks_hidden():
+    game, _ = make_game()
+    await game.startup()
+    await game.init_client("s1")
+    prompt = await game.fetch_prompt_json("s1")
+    for mask in prompt["masks"]:
+        assert prompt["tokens"][mask] == "*"
+    assert prompt["correct"] == []
+    assert prompt["attempts"] == 0
+
+
+@pytest.mark.asyncio
+async def test_guess_flow_wrong_then_win():
+    game, _ = make_game()
+    await game.startup()
+    await game.init_client("s1")
+    current = await game.rounds.fetch_current_prompt()
+    masks = current["masks"]
+    answers = {str(m): current["tokens"][m] for m in masks}
+
+    wrong = {str(m): "zzzz" for m in masks}
+    result = await game.compute_client_scores("s1", wrong)
+    assert result["won"] == 0
+
+    result = await game.compute_client_scores("s1", answers)
+    assert result["won"] == 1
+    status = await game.client_status("s1")
+    assert status["won"] == 1
+    prompt = await game.fetch_prompt_json("s1")
+    assert prompt["masks"] == []  # won -> nothing masked
+    assert prompt["attempts"] == 2
+
+
+@pytest.mark.asyncio
+async def test_partial_solve_reveals_one_mask():
+    game, _ = make_game()
+    await game.startup()
+    await game.init_client("s1")
+    current = await game.rounds.fetch_current_prompt()
+    m0, m1 = current["masks"]
+    await game.compute_client_scores(
+        "s1", {str(m0): current["tokens"][m0], str(m1): "zzzz"}
+    )
+    prompt = await game.fetch_prompt_json("s1")
+    assert -1 in prompt["masks"]
+    assert m0 in prompt["correct"]
+    assert prompt["tokens"][m1] == "*"
+    # solved token is visible again
+    assert prompt["tokens"][m0] == current["tokens"][m0]
+
+
+@pytest.mark.asyncio
+async def test_masked_image_blur_decreases_with_score():
+    game, _ = make_game()
+    await game.startup()
+    await game.init_client("s1")
+    blurred = await game.fetch_masked_image("s1")
+    current = await game.rounds.fetch_current_prompt()
+    answers = {str(m): current["tokens"][m] for m in current["masks"]}
+    await game.compute_client_scores("s1", answers)
+    clear = await game.fetch_masked_image("s1")
+    raw = await game.rounds.fetch_current_image()
+    # winning -> zero blur -> identical to stored image
+    assert (clear == raw).all()
+    assert not (blurred == raw).all()
+
+
+@pytest.mark.asyncio
+async def test_stale_mask_input_ignored():
+    game, _ = make_game()
+    await game.startup()
+    await game.init_client("s1")
+    result = await game.compute_client_scores("s1", {"999": "anything"})
+    assert result == {"won": 0}
+
+
+@pytest.mark.asyncio
+async def test_round_lifecycle_buffer_promote_reset():
+    game, backend = make_game(time_per_prompt=1.0)
+    await game.startup()
+    await game.init_client("s1")
+    current0 = await game.rounds.fetch_current_prompt()
+    # win before rollover; rollover must reset the session
+    answers = {str(m): current0["tokens"][m] for m in current0["masks"]}
+    await game.compute_client_scores("s1", answers)
+    assert (await game.client_status("s1"))["won"] == 1
+
+    task = game.start_timer(tick=0.1)
+    try:
+        deadline = asyncio.get_event_loop().time() + 8.0
+        promoted = False
+        while asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.1)
+            story = await game.fetch_story()
+            if int(story.get("episode", 0)) >= 2:
+                promoted = True
+                break
+        assert promoted, "round never promoted"
+    finally:
+        await game.rounds.stop()
+        task.cancel()
+
+    assert backend.calls >= 2  # startup + at least one buffer
+    # session reset by rollover
+    status = await game.client_status("s1")
+    assert status["needInitialization"] or status["won"] == 0
+    # clock restarted and reset flag behavior: countdown live again
+    assert await game.rounds.remaining() > 0
+
+
+@pytest.mark.asyncio
+async def test_promote_without_buffer_replays_round():
+    game, _ = make_game()
+    await game.startup()
+    before = await game.rounds.fetch_current_prompt()
+    await game.rounds.promote_buffer()  # no buffer staged
+    after = await game.rounds.fetch_current_prompt()
+    assert before == after
+
+
+@pytest.mark.asyncio
+async def test_story_continuation_uses_prompt_seed():
+    game, backend = make_game()
+    await game.startup()
+    seeds_seen = []
+
+    class SpyBackend(FakeContentBackend):
+        async def generate(self, seed, is_seed):
+            seeds_seen.append((seed, is_seed))
+            return await super().generate(seed, is_seed)
+
+    game.rounds.backend = SpyBackend(image_size=32)
+    await game.rounds.buffer_contents()
+    await game.rounds.promote_buffer()
+    assert len(seeds_seen) == 1
+    seed, is_seed = seeds_seen[0]
+    assert not is_seed  # continues the story, not a fresh seed
+    prev = await game.store.hget("prompt", "seed")
+    assert prev is not None
+
+
+@pytest.mark.asyncio
+async def test_clock_payload_shape():
+    game, _ = make_game()
+    await game.startup()
+    await game.rounds.start_countdown()
+    payload = await game.clock_payload()
+    assert set(payload) == {"time", "reset", "conns"}
+    assert ":" in payload["time"]

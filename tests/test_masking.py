@@ -1,0 +1,62 @@
+import numpy as np
+
+from cassmantle_tpu.engine.content import hash_embed
+from cassmantle_tpu.engine.masking import (
+    build_prompt_state,
+    candidate_indices,
+    select_masks,
+)
+from cassmantle_tpu.utils.text import tokenize_words
+
+
+def test_candidates_exclude_stopwords_and_punct():
+    tokens = tokenize_words("The ancient lighthouse glows over a dark sea.")
+    cands = candidate_indices(tokens)
+    words = [tokens[i] for i in cands]
+    assert "The" not in words and "a" not in words and "." not in words
+    assert "ancient" in words and "lighthouse" in words
+
+
+def test_select_masks_count_and_sorted():
+    tokens = tokenize_words(
+        "A restless caravan crossed the silver canyon before dawn."
+    )
+    masks = select_masks(tokens, hash_embed, num_masked=2)
+    assert len(masks) == 2
+    assert masks == sorted(masks)
+    for m in masks:
+        assert tokens[m][0].isalpha()
+
+
+def test_select_masks_duplicate_words_distinct_positions():
+    # "crimson" appears twice; masks must never point at the same index and
+    # must prefer distinct words.
+    tokens = tokenize_words("crimson sky over the crimson harbor tonight")
+    masks = select_masks(tokens, hash_embed, num_masked=2)
+    assert len(set(masks)) == 2
+    assert len({tokens[m].lower() for m in masks}) == 2
+
+
+def test_select_masks_degenerate_prompt():
+    tokens = tokenize_words("a of to in")
+    masks = select_masks(tokens, hash_embed, num_masked=2)
+    assert isinstance(masks, list)
+
+
+def test_build_prompt_state():
+    state = build_prompt_state(
+        "The gilded automaton hummed beside the frozen orchard.",
+        hash_embed,
+        num_masked=2,
+    )
+    assert set(state) == {"tokens", "masks"}
+    assert len(state["masks"]) == 2
+    for m in state["masks"]:
+        assert 0 <= m < len(state["tokens"])
+
+
+def test_hash_embed_deterministic_unit():
+    v1 = hash_embed(["storm", "storm", "calm"])
+    assert np.allclose(v1[0], v1[1])
+    assert not np.allclose(v1[0], v1[2])
+    assert np.allclose(np.linalg.norm(v1, axis=1), 1.0, atol=1e-5)

@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from cassmantle_tpu.engine.content import hash_similarity
+from cassmantle_tpu.engine.scoring import GuessScorer, score_to_blur
+
+
+@pytest.mark.asyncio
+async def test_exact_match_scores_one():
+    scorer = GuessScorer(hash_similarity, min_score=0.01)
+    scores = await scorer.score_pairs(
+        {"3": {"input": "Lighthouse", "answer": "lighthouse"}}
+    )
+    assert scores["3"] == 1.0
+
+
+@pytest.mark.asyncio
+async def test_mismatch_floored_and_below_one():
+    scorer = GuessScorer(hash_similarity, min_score=0.01)
+    scores = await scorer.score_pairs(
+        {"3": {"input": "boat", "answer": "lighthouse"},
+         "7": {"input": "tower", "answer": "lighthouse"}}
+    )
+    for v in scores.values():
+        assert 0.01 <= v < 1.0
+
+
+@pytest.mark.asyncio
+async def test_batched_call_single_similarity_invocation():
+    calls = []
+
+    async def spy_similarity(pairs):
+        calls.append(len(pairs))
+        return np.zeros(len(pairs), dtype=np.float32)
+
+    scorer = GuessScorer(spy_similarity, min_score=0.05)
+    scores = await scorer.score_pairs(
+        {str(i): {"input": f"w{i}", "answer": "target"} for i in range(10)}
+    )
+    assert calls == [10]
+    assert all(v == 0.05 for v in scores.values())
+
+
+def test_score_to_blur_curve():
+    assert score_to_blur(1.0) == 0.0
+    assert score_to_blur(0.0) == 15.0
+    mid = score_to_blur(0.5)
+    assert mid == pytest.approx(15.0 * 0.75)
+    # monotone decreasing
+    xs = np.linspace(0, 1, 11)
+    blurs = [score_to_blur(x) for x in xs]
+    assert all(b1 >= b2 for b1, b2 in zip(blurs, blurs[1:]))
