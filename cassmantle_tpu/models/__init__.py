@@ -1,0 +1,5 @@
+from cassmantle_tpu.models.clip_text import ClipTextEncoder  # noqa: F401
+from cassmantle_tpu.models.gpt2 import GPT2LM  # noqa: F401
+from cassmantle_tpu.models.minilm import MiniLMEncoder  # noqa: F401
+from cassmantle_tpu.models.unet import UNet  # noqa: F401
+from cassmantle_tpu.models.vae import VAEDecoder, VAEEncoder  # noqa: F401

@@ -1,0 +1,80 @@
+"""CLIP text encoder (SD's text tower), Flax.
+
+Replaces the text-conditioning half of the remote SDXL call the reference
+makes (backend.py:270-295): prompts are tokenized on host, encoded here on
+TPU, and the hidden states feed the UNet's cross-attention.
+
+Architecture: pre-LN causal transformer with learned positional embeddings
+and quick-GELU, matching CLIP ViT-L/14's text model so real SD1.5 weights
+load via models/weights.py. SDXL's second tower (OpenCLIP bigG) is the same
+module at ClipTextConfig.sdxl_big() dims.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cassmantle_tpu.config import ClipTextConfig
+from cassmantle_tpu.models.layers import (
+    MultiHeadAttention,
+    TransformerMLP,
+    quick_gelu,
+)
+
+
+class ClipBlock(nn.Module):
+    cfg: ClipTextConfig
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, mask):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = MultiHeadAttention(
+            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+        )(h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = TransformerMLP(
+            intermediate=self.cfg.intermediate_size,
+            activation=quick_gelu,
+            dtype=self.dtype,
+            name="mlp",
+        )(h)
+        return x + h
+
+
+class ClipTextEncoder(nn.Module):
+    cfg: ClipTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> dict:
+        """input_ids: (B, S) int32 -> {hidden: (B,S,D), pooled: (B,D)}."""
+        _, seq = input_ids.shape
+        tok = nn.Embed(
+            self.cfg.vocab_size, self.cfg.hidden_size,
+            dtype=self.dtype, name="token_embedding",
+        )(input_ids)
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.01),
+            (self.cfg.max_positions, self.cfg.hidden_size),
+        )
+        x = tok + pos[None, :seq].astype(self.dtype)
+
+        causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))[None, None]
+        for i in range(self.cfg.num_layers):
+            x = ClipBlock(self.cfg, self.dtype, name=f"block_{i}")(x, causal)
+
+        hidden = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # CLIP pools at the EOT token = argmax of ids (highest id is EOT).
+        eot = jnp.argmax(input_ids, axis=-1)
+        pooled = jnp.take_along_axis(
+            hidden, eot[:, None, None], axis=1
+        ).squeeze(1)
+        return {"hidden": hidden.astype(self.dtype),
+                "pooled": pooled.astype(self.dtype)}

@@ -1,0 +1,154 @@
+"""Shared Flax building blocks for the model zoo.
+
+TPU-first conventions used throughout:
+- channels-last NHWC for all image tensors (XLA's native TPU conv layout);
+- matmuls sized to MXU tiles (model dims are all multiples of 128 at
+  production scale) and computed in the module dtype (bf16 on TPU) with
+  fp32 softmax/normalization accumulations;
+- attention goes through ops.attention so the Pallas flash kernel applies
+  everywhere at once.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cassmantle_tpu.ops.attention import multi_head_attention
+
+
+def timestep_embedding(
+    timesteps: jax.Array, dim: int, max_period: float = 10000.0
+) -> jax.Array:
+    """Sinusoidal diffusion-timestep embedding, fp32. (B,) -> (B, dim)."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = timesteps.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class MultiHeadAttention(nn.Module):
+    """Projection + ops.attention + out-projection.
+
+    Self-attention when ``context`` is None, cross-attention otherwise.
+    """
+
+    num_heads: int
+    head_dim: Optional[int] = None
+    out_dim: Optional[int] = None
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, context=None, mask=None, kv_cache=None,
+                 return_kv: bool = False):
+        """Attention with optional KV-cache decode.
+
+        - Full mode: returns out, or (out, (k, v)) if ``return_kv`` (used by
+          prefill to seed a decode cache).
+        - Decode mode (``kv_cache=(cache_k, cache_v, index)``): writes this
+          call's k/v into the cache at ``index`` along the sequence axis and
+          attends over the whole cache; the caller supplies ``mask`` marking
+          valid cache positions. Returns (out, (new_k, new_v)).
+        """
+        features = x.shape[-1]
+        head_dim = self.head_dim or features // self.num_heads
+        inner = self.num_heads * head_dim
+        out_dim = self.out_dim or features
+        ctx = x if context is None else context
+
+        dense = lambda name: nn.Dense(  # noqa: E731
+            inner, use_bias=self.use_bias, dtype=self.dtype, name=name
+        )
+        q = dense("q")(x)
+        k = dense("k")(ctx)
+        v = dense("v")(ctx)
+
+        split = lambda t: t.reshape(  # noqa: E731
+            t.shape[:-1] + (self.num_heads, head_dim)
+        )
+        q, k, v = split(q), split(k), split(v)
+
+        kv_out = None
+        if kv_cache is not None:
+            cache_k, cache_v, index = kv_cache
+            cache_k = jax.lax.dynamic_update_slice_in_dim(
+                cache_k, k.astype(cache_k.dtype), index, axis=-3
+            )
+            cache_v = jax.lax.dynamic_update_slice_in_dim(
+                cache_v, v.astype(cache_v.dtype), index, axis=-3
+            )
+            k, v = cache_k, cache_v
+            kv_out = (cache_k, cache_v)
+        elif return_kv:
+            kv_out = (k, v)
+
+        out = multi_head_attention(q, k, v, mask=mask)
+        out = out.reshape(out.shape[:-2] + (inner,))
+        out = nn.Dense(
+            out_dim, use_bias=self.use_bias, dtype=self.dtype, name="out"
+        )(out)
+        if kv_out is not None:
+            return out, kv_out
+        return out
+
+
+class TransformerMLP(nn.Module):
+    """Standard 2-layer MLP with configurable activation."""
+
+    intermediate: int
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        features = x.shape[-1]
+        h = nn.Dense(self.intermediate, dtype=self.dtype, name="fc1")(x)
+        h = self.activation(h)
+        return nn.Dense(features, dtype=self.dtype, name="fc2")(h)
+
+
+class GEGLU(nn.Module):
+    """Gated-GELU feed-forward used by SD's transformer blocks."""
+
+    intermediate: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        features = x.shape[-1]
+        h = nn.Dense(self.intermediate * 2, dtype=self.dtype, name="proj")(x)
+        h, gate = jnp.split(h, 2, axis=-1)
+        h = h * nn.gelu(gate)
+        return nn.Dense(features, dtype=self.dtype, name="out")(h)
+
+
+def quick_gelu(x):
+    """CLIP's activation: x * sigmoid(1.702 x)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class GroupNorm32(nn.Module):
+    """GroupNorm computed in fp32 regardless of module dtype (diffusion
+    UNets are numerically sensitive here)."""
+
+    num_groups: int = 32
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        orig_dtype = x.dtype
+        out = nn.GroupNorm(
+            num_groups=self.num_groups, epsilon=self.epsilon,
+            dtype=jnp.float32, name="norm",
+        )(x.astype(jnp.float32))
+        return out.astype(orig_dtype)

@@ -1,0 +1,69 @@
+"""MiniLM-class sentence encoder for guess-similarity scoring.
+
+Replaces the reference's CPU word2vec scorer (backend.py:45, 303-317;
+artifact from download_model.py:9-10) with a BERT-style bidirectional
+encoder + masked mean pooling + L2 normalization — the all-MiniLM-L6-v2
+recipe — so guess/answer similarity is an embedding cosine computed in
+batches on TPU (1k concurrent guesses coalesce into one device call,
+BASELINE.json config #1).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cassmantle_tpu.config import MiniLMConfig
+from cassmantle_tpu.models.layers import MultiHeadAttention, TransformerMLP
+
+
+class BertBlock(nn.Module):
+    """Post-LN transformer block (BERT convention)."""
+
+    cfg: MiniLMConfig
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, mask):
+        a = MultiHeadAttention(
+            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+        )(x, mask=mask)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + a)
+        h = TransformerMLP(
+            intermediate=self.cfg.intermediate_size, dtype=self.dtype,
+            name="mlp",
+        )(x)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + h)
+
+
+class MiniLMEncoder(nn.Module):
+    cfg: MiniLMConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array,
+                 attention_mask: jax.Array) -> jax.Array:
+        """(B, S) ids + (B, S) 0/1 mask -> (B, D) unit-norm embeddings."""
+        dtype = jnp.dtype(self.cfg.dtype)
+        _, s = input_ids.shape
+        x = nn.Embed(self.cfg.vocab_size, self.cfg.hidden_size,
+                     dtype=dtype, name="word_embeddings")(input_ids)
+        pos = self.param(
+            "position_embeddings", nn.initializers.normal(0.02),
+            (self.cfg.max_positions, self.cfg.hidden_size),
+        )
+        x = x + pos[None, :s].astype(dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x)
+
+        attend = attention_mask.astype(bool)[:, None, None, :]
+        for i in range(self.cfg.num_layers):
+            x = BertBlock(self.cfg, dtype, name=f"block_{i}")(x, attend)
+
+        # masked mean pooling
+        weights = attention_mask.astype(jnp.float32)[..., None]
+        pooled = (x.astype(jnp.float32) * weights).sum(axis=1) / (
+            weights.sum(axis=1) + 1e-9
+        )
+        return pooled / (
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True) + 1e-9
+        )

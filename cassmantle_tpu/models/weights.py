@@ -1,0 +1,372 @@
+"""Checkpoint loading: safetensors (torch naming) -> Flax param trees.
+
+The reference fetches its "weights" by pointing at hosted HF endpoints
+(backend.py:24-25) plus a one-shot gensim artifact download
+(download_model.py:9-10). Here model weights are first-class: each model in
+the zoo has a converter mapping the published safetensors naming (diffusers
+for UNet/VAE, transformers for CLIP/GPT-2/BERT-MiniLM) onto our module tree,
+with layout fixes (torch conv OIHW -> flax HWIO, linear (out,in) ->
+(in,out)). When no checkpoint is on disk, ``init_params`` gives
+deterministic random params (fixed PRNG) so the full pipeline runs — shapes,
+jit, sharding, and benchmarks are weight-independent.
+
+Conversion fidelity is SURVEY.md §7 hard part (a); converters are exercised
+by tests that fabricate synthetic torch-layout checkpoints and assert
+numerical equality after mapping.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cassmantle_tpu.utils.logging import get_logger
+
+log = get_logger("weights")
+
+Tensors = Dict[str, np.ndarray]
+
+
+def load_safetensors(path: str) -> Tensors:
+    from safetensors import numpy as st_numpy
+
+    return dict(st_numpy.load_file(path))
+
+
+def _t(w: np.ndarray) -> np.ndarray:
+    """torch linear (out, in) -> flax dense kernel (in, out)."""
+    return np.ascontiguousarray(w.T)
+
+
+def _conv(w: np.ndarray) -> np.ndarray:
+    """torch conv OIHW -> flax HWIO."""
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+def _conv1x1_to_dense(w: np.ndarray) -> np.ndarray:
+    """torch 1x1 conv (O, I, 1, 1) -> dense kernel (I, O)."""
+    return np.ascontiguousarray(w[:, :, 0, 0].T)
+
+
+def set_in_tree(tree: dict, path: str, value: np.ndarray) -> None:
+    keys = path.split("/")
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+
+
+class Converter:
+    """Accumulates {flax_path: array} then materializes a param tree."""
+
+    def __init__(self, tensors: Tensors, model_name: str) -> None:
+        self.src = tensors
+        self.model_name = model_name
+        self.out: Dict[str, np.ndarray] = {}
+        self.used = set()
+
+    def take(self, key: str) -> np.ndarray:
+        self.used.add(key)
+        return self.src[key]
+
+    def has(self, key: str) -> bool:
+        return key in self.src
+
+    def put(self, path: str, value: np.ndarray) -> None:
+        self.out[path] = value
+
+    def dense(self, src: str, dst: str) -> None:
+        self.put(f"{dst}/kernel", _t(self.take(f"{src}.weight")))
+        if self.has(f"{src}.bias"):
+            self.put(f"{dst}/bias", self.take(f"{src}.bias"))
+
+    def conv(self, src: str, dst: str) -> None:
+        self.put(f"{dst}/kernel", _conv(self.take(f"{src}.weight")))
+        if self.has(f"{src}.bias"):
+            self.put(f"{dst}/bias", self.take(f"{src}.bias"))
+
+    def conv1x1_dense(self, src: str, dst: str) -> None:
+        w = self.take(f"{src}.weight")
+        if w.ndim == 4:
+            self.put(f"{dst}/kernel", _conv1x1_to_dense(w))
+        else:
+            self.put(f"{dst}/kernel", _t(w))
+        if self.has(f"{src}.bias"):
+            self.put(f"{dst}/bias", self.take(f"{src}.bias"))
+
+    def norm(self, src: str, dst: str) -> None:
+        self.put(f"{dst}/scale", self.take(f"{src}.weight"))
+        self.put(f"{dst}/bias", self.take(f"{src}.bias"))
+
+    def groupnorm(self, src: str, dst: str) -> None:
+        # GroupNorm32 nests an nn.GroupNorm called "norm"
+        self.norm(src, f"{dst}/norm")
+
+    def embed(self, src: str, dst: str) -> None:
+        self.put(f"{dst}/embedding", self.take(f"{src}.weight"))
+
+    def tree(self) -> dict:
+        unused = set(self.src) - self.used
+        if unused:
+            log.warning("%s: %d source tensors unused (e.g. %s)",
+                        self.model_name, len(unused),
+                        sorted(unused)[:3])
+        tree: dict = {}
+        for path, value in self.out.items():
+            set_in_tree(tree, path, value)
+        return {"params": tree}
+
+
+# ---------------------------------------------------------------------------
+# CLIP text encoder (transformers naming, prefix "text_model.")
+# ---------------------------------------------------------------------------
+
+def convert_clip_text(tensors: Tensors, num_layers: int) -> dict:
+    c = Converter(tensors, "clip_text")
+    p = "text_model."
+    c.embed(f"{p}embeddings.token_embedding", "token_embedding")
+    c.put("position_embedding",
+          c.take(f"{p}embeddings.position_embedding.weight"))
+    for i in range(num_layers):
+        src = f"{p}encoder.layers.{i}"
+        dst = f"block_{i}"
+        c.norm(f"{src}.layer_norm1", f"{dst}/ln1")
+        c.dense(f"{src}.self_attn.q_proj", f"{dst}/attn/q")
+        c.dense(f"{src}.self_attn.k_proj", f"{dst}/attn/k")
+        c.dense(f"{src}.self_attn.v_proj", f"{dst}/attn/v")
+        c.dense(f"{src}.self_attn.out_proj", f"{dst}/attn/out")
+        c.norm(f"{src}.layer_norm2", f"{dst}/ln2")
+        c.dense(f"{src}.mlp.fc1", f"{dst}/mlp/fc1")
+        c.dense(f"{src}.mlp.fc2", f"{dst}/mlp/fc2")
+    c.norm(f"{p}final_layer_norm", "ln_final")
+    return c.tree()
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 (transformers naming; Conv1D stores (in, out) -> no transpose)
+# ---------------------------------------------------------------------------
+
+def convert_gpt2(tensors: Tensors, num_layers: int, hidden: int) -> dict:
+    c = Converter(tensors, "gpt2")
+
+    def conv1d(src: str, dst: str) -> None:
+        c.put(f"{dst}/kernel", c.take(f"{src}.weight"))
+        c.put(f"{dst}/bias", c.take(f"{src}.bias"))
+
+    c.embed("wte", "wte")
+    c.embed("wpe", "wpe")
+    for i in range(num_layers):
+        src, dst = f"h.{i}", f"block_{i}"
+        c.norm(f"{src}.ln_1", f"{dst}/ln1")
+        qkv_w = c.take(f"{src}.attn.c_attn.weight")  # (in, 3*hidden)
+        qkv_b = c.take(f"{src}.attn.c_attn.bias")
+        for j, name in enumerate(("q", "k", "v")):
+            c.put(f"{dst}/attn/{name}/kernel",
+                  qkv_w[:, j * hidden:(j + 1) * hidden])
+            c.put(f"{dst}/attn/{name}/bias",
+                  qkv_b[j * hidden:(j + 1) * hidden])
+        conv1d(f"{src}.attn.c_proj", f"{dst}/attn/out")
+        c.norm(f"{src}.ln_2", f"{dst}/ln2")
+        conv1d(f"{src}.mlp.c_fc", f"{dst}/mlp/fc1")
+        conv1d(f"{src}.mlp.c_proj", f"{dst}/mlp/fc2")
+    c.norm("ln_f", "ln_f")
+    return c.tree()
+
+
+# ---------------------------------------------------------------------------
+# MiniLM / BERT encoder (sentence-transformers all-MiniLM-L6-v2 naming)
+# ---------------------------------------------------------------------------
+
+def convert_minilm(tensors: Tensors, num_layers: int) -> dict:
+    c = Converter(tensors, "minilm")
+    c.embed("embeddings.word_embeddings", "word_embeddings")
+    pos = c.take("embeddings.position_embeddings.weight")
+    if c.has("embeddings.token_type_embeddings.weight"):
+        # token_type_ids are all zero at inference -> fold type-0 row into
+        # the position table (exactly equivalent pre-LayerNorm sum).
+        pos = pos + c.take("embeddings.token_type_embeddings.weight")[0]
+    c.put("position_embeddings", pos)
+    c.norm("embeddings.LayerNorm", "embed_ln")
+    for i in range(num_layers):
+        src = f"encoder.layer.{i}"
+        dst = f"block_{i}"
+        c.dense(f"{src}.attention.self.query", f"{dst}/attn/q")
+        c.dense(f"{src}.attention.self.key", f"{dst}/attn/k")
+        c.dense(f"{src}.attention.self.value", f"{dst}/attn/v")
+        c.dense(f"{src}.attention.output.dense", f"{dst}/attn/out")
+        c.norm(f"{src}.attention.output.LayerNorm", f"{dst}/ln1")
+        c.dense(f"{src}.intermediate.dense", f"{dst}/mlp/fc1")
+        c.dense(f"{src}.output.dense", f"{dst}/mlp/fc2")
+        c.norm(f"{src}.output.LayerNorm", f"{dst}/ln2")
+    return c.tree()
+
+
+# ---------------------------------------------------------------------------
+# SD UNet (diffusers naming)
+# ---------------------------------------------------------------------------
+
+def _convert_resblock(c: Converter, src: str, dst: str) -> None:
+    c.groupnorm(f"{src}.norm1", f"{dst}/norm1")
+    c.conv(f"{src}.conv1", f"{dst}/conv1")
+    c.dense(f"{src}.time_emb_proj", f"{dst}/time_proj")
+    c.groupnorm(f"{src}.norm2", f"{dst}/norm2")
+    c.conv(f"{src}.conv2", f"{dst}/conv2")
+    if c.has(f"{src}.conv_shortcut.weight"):
+        c.conv(f"{src}.conv_shortcut", f"{dst}/skip")  # ours: 1x1 Conv
+
+
+def _convert_spatial_transformer(c: Converter, src: str, dst: str,
+                                 depth: int) -> None:
+    c.groupnorm(f"{src}.norm", f"{dst}/norm")
+    c.conv1x1_dense(f"{src}.proj_in", f"{dst}/proj_in")
+    for k in range(depth):
+        tsrc = f"{src}.transformer_blocks.{k}"
+        tdst = f"{dst}/block_{k}"
+        c.norm(f"{tsrc}.norm1", f"{tdst}/ln1")
+        c.dense(f"{tsrc}.attn1.to_q", f"{tdst}/self_attn/q")
+        c.dense(f"{tsrc}.attn1.to_k", f"{tdst}/self_attn/k")
+        c.dense(f"{tsrc}.attn1.to_v", f"{tdst}/self_attn/v")
+        c.dense(f"{tsrc}.attn1.to_out.0", f"{tdst}/self_attn/out")
+        c.norm(f"{tsrc}.norm2", f"{tdst}/ln2")
+        c.dense(f"{tsrc}.attn2.to_q", f"{tdst}/cross_attn/q")
+        c.dense(f"{tsrc}.attn2.to_k", f"{tdst}/cross_attn/k")
+        c.dense(f"{tsrc}.attn2.to_v", f"{tdst}/cross_attn/v")
+        c.dense(f"{tsrc}.attn2.to_out.0", f"{tdst}/cross_attn/out")
+        c.norm(f"{tsrc}.norm3", f"{tdst}/ln3")
+        c.dense(f"{tsrc}.ff.net.0.proj", f"{tdst}/ff/proj")
+        c.dense(f"{tsrc}.ff.net.2", f"{tdst}/ff/out")
+    c.conv1x1_dense(f"{src}.proj_out", f"{dst}/proj_out")
+
+
+def convert_unet(tensors: Tensors, cfg) -> dict:
+    """diffusers UNet2DConditionModel -> our UNet tree."""
+    c = Converter(tensors, "unet")
+    c.conv("conv_in", "conv_in")
+    c.dense("time_embedding.linear_1", "time_fc1")
+    c.dense("time_embedding.linear_2", "time_fc2")
+    if c.has("add_embedding.linear_1.weight"):
+        c.dense("add_embedding.linear_1", "add_fc1")
+        c.dense("add_embedding.linear_2", "add_fc2")
+
+    levels = len(cfg.channel_mults)
+    for lvl in range(levels):
+        for blk in range(cfg.blocks_per_level):
+            _convert_resblock(
+                c, f"down_blocks.{lvl}.resnets.{blk}",
+                f"down_{lvl}_res_{blk}")
+            if cfg.attention_levels[lvl] and cfg.transformer_depth[lvl]:
+                _convert_spatial_transformer(
+                    c, f"down_blocks.{lvl}.attentions.{blk}",
+                    f"down_{lvl}_attn_{blk}", cfg.transformer_depth[lvl])
+        if lvl != levels - 1:
+            c.conv(f"down_blocks.{lvl}.downsamplers.0.conv",
+                   f"down_{lvl}_downsample")
+
+    _convert_resblock(c, "mid_block.resnets.0", "mid_res_0")
+    mid_depth = max(
+        [d for lvl, d in enumerate(cfg.transformer_depth)
+         if cfg.attention_levels[lvl]] or [1])
+    _convert_spatial_transformer(c, "mid_block.attentions.0", "mid_attn",
+                                 mid_depth)
+    _convert_resblock(c, "mid_block.resnets.1", "mid_res_1")
+
+    for i in range(levels):
+        lvl = levels - 1 - i  # diffusers up_blocks[0] = lowest resolution
+        for blk in range(cfg.blocks_per_level + 1):
+            _convert_resblock(
+                c, f"up_blocks.{i}.resnets.{blk}", f"up_{lvl}_res_{blk}")
+            if cfg.attention_levels[lvl] and cfg.transformer_depth[lvl]:
+                _convert_spatial_transformer(
+                    c, f"up_blocks.{i}.attentions.{blk}",
+                    f"up_{lvl}_attn_{blk}", cfg.transformer_depth[lvl])
+        if lvl != 0:
+            c.conv(f"up_blocks.{i}.upsamplers.0.conv", f"up_{lvl}_upsample")
+
+    c.groupnorm("conv_norm_out", "norm_out")
+    c.conv("conv_out", "conv_out")
+    return c.tree()
+
+
+# ---------------------------------------------------------------------------
+# VAE decoder (diffusers AutoencoderKL naming)
+# ---------------------------------------------------------------------------
+
+def _convert_vae_resblock(c: Converter, src: str, dst: str) -> None:
+    c.groupnorm(f"{src}.norm1", f"{dst}/norm1")
+    c.conv(f"{src}.conv1", f"{dst}/conv1")
+    c.groupnorm(f"{src}.norm2", f"{dst}/norm2")
+    c.conv(f"{src}.conv2", f"{dst}/conv2")
+    if c.has(f"{src}.conv_shortcut.weight"):
+        c.conv(f"{src}.conv_shortcut", f"{dst}/skip")
+
+
+def _convert_vae_attn(c: Converter, src: str, dst: str) -> None:
+    c.groupnorm(f"{src}.group_norm", f"{dst}/norm")
+    c.dense(f"{src}.to_q", f"{dst}/attn/q")
+    c.dense(f"{src}.to_k", f"{dst}/attn/k")
+    c.dense(f"{src}.to_v", f"{dst}/attn/v")
+    c.dense(f"{src}.to_out.0", f"{dst}/attn/out")
+
+
+def convert_vae_decoder(tensors: Tensors, cfg) -> dict:
+    c = Converter(tensors, "vae_decoder")
+    c.conv("post_quant_conv", "post_quant_conv")  # ours: 1x1 Conv
+    c.conv("decoder.conv_in", "conv_in")
+    _convert_vae_resblock(c, "decoder.mid_block.resnets.0", "mid_res_0")
+    _convert_vae_attn(c, "decoder.mid_block.attentions.0", "mid_attn")
+    _convert_vae_resblock(c, "decoder.mid_block.resnets.1", "mid_res_1")
+    levels = len(cfg.channel_mults)
+    for i in range(levels):
+        lvl = levels - 1 - i
+        for blk in range(cfg.blocks_per_level + 1):
+            _convert_vae_resblock(
+                c, f"decoder.up_blocks.{i}.resnets.{blk}",
+                f"up_{lvl}_res_{blk}")
+        if lvl != 0:
+            c.conv(f"decoder.up_blocks.{i}.upsamplers.0.conv",
+                   f"up_{lvl}_upsample")
+    c.groupnorm("decoder.conv_norm_out", "norm_out")
+    c.conv("decoder.conv_out", "conv_out")
+    return c.tree()
+
+
+# ---------------------------------------------------------------------------
+# Init + loading entry points
+# ---------------------------------------------------------------------------
+
+def init_params(model, rng_seed: int, *sample_args, method=None) -> dict:
+    """Deterministic random init (fixed PRNG) for any zoo model."""
+    rng = jax.random.PRNGKey(rng_seed)
+    kwargs = {"method": method} if method is not None else {}
+    return model.init(rng, *sample_args, **kwargs)
+
+
+def maybe_load(
+    weights_dir: Optional[str], filename: str, converter, model_name: str
+) -> Optional[dict]:
+    """Load+convert a checkpoint if present, else None (random init)."""
+    if not weights_dir:
+        return None
+    path = os.path.join(weights_dir, filename)
+    if not os.path.exists(path):
+        log.info("%s: no checkpoint at %s; using random init",
+                 model_name, path)
+        return None
+    log.info("%s: loading %s", model_name, path)
+    tensors = load_safetensors(path)
+    params = converter(tensors)
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def tree_shapes(tree) -> Dict[str, tuple]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(re.sub(r"[\[\]'.]", "", str(p)) for p in path)
+        out[key] = tuple(leaf.shape)
+    return out

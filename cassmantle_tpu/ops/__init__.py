@@ -1,0 +1,1 @@
+from cassmantle_tpu.ops.attention import multi_head_attention  # noqa: F401

@@ -1,0 +1,77 @@
+"""Attention dispatch: XLA reference path + Pallas flash-attention kernel.
+
+Every attention site in the model zoo (UNet spatial transformers, CLIP/GPT-2
+/MiniLM text blocks) funnels through :func:`multi_head_attention`, so the
+Pallas kernel swap happens in exactly one place. The reference has no
+attention code at all — its models live behind the HF Inference API
+(reference backend.py:240-295) — so this op is the heart of the "replace the
+remote API with local TPU compute" north star.
+
+Dispatch policy:
+- TPU + no mask + seq long enough to tile → Pallas flash attention
+  (blockwise online-softmax, O(N) memory; ops/flash_attention.py);
+- otherwise → jnp.einsum attention, which XLA fuses well on its own.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention. q: (..., Sq, H, D), k/v: (..., Sk, H, D).
+
+    ``mask`` broadcasts against (..., H, Sq, Sk); True = attend.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    if mask is not None:
+        big_neg = jnp.finfo(logits.dtype).min
+        logits = jnp.where(mask, logits, big_neg)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", weights, v)
+
+
+# Pallas kernel lands in ops/flash_attention.py; until then this alias keeps
+# the dispatch seam stable.
+def multi_head_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Attention entry point used by all models.
+
+    Shapes: q (..., Sq, H, D); k, v (..., Sk, H, D); returns (..., Sq, H, D).
+    """
+    if use_flash is None:
+        use_flash = _on_tpu() and mask is None
+    if use_flash and mask is None:
+        from cassmantle_tpu.ops.flash_attention import flash_attention_ok
+
+        if flash_attention_ok(q, k):
+            from cassmantle_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, scale=scale)
+    return xla_attention(q, k, v, mask=mask, scale=scale)

@@ -7,12 +7,18 @@ real-chip path is covered by bench.py and the driver's dryrun.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The environment may pin JAX_PLATFORMS to a TPU plugin (e.g. axon); the
+# config override below beats the env var and forces the 8 virtual CPU
+# devices for every test.
+jax.config.update("jax_platform_name", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,412 @@
+"""Weight-conversion tests: fabricate torch/diffusers-layout checkpoints for
+the tiny configs, convert, and require the result to load into the Flax
+models with exactly matching tree structure + shapes, plus numeric layout
+checks for the dense/conv transposes."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cassmantle_tpu.models import (
+    ClipTextEncoder,
+    GPT2LM,
+    MiniLMEncoder,
+    UNet,
+    VAEDecoder,
+)
+from cassmantle_tpu.models.weights import (
+    convert_clip_text,
+    convert_gpt2,
+    convert_minilm,
+    convert_unet,
+    convert_vae_decoder,
+    init_params,
+    tree_shapes,
+)
+
+
+def _fill(shape, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _flat(tree):
+    return {
+        "/".join(str(k.key) for k in path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+def assert_same_structure(converted, reference):
+    got, want = _flat(converted), _flat(reference)
+    missing = set(want) - set(got)
+    extra = set(got) - set(want)
+    assert not missing, f"converted tree missing params: {sorted(missing)[:8]}"
+    assert not extra, f"converted tree has extra params: {sorted(extra)[:8]}"
+    for key in want:
+        assert got[key].shape == want[key].shape, (
+            f"{key}: {got[key].shape} != {want[key].shape}"
+        )
+
+
+# --------------------------------------------------------------------------
+# Reverse mapping: flax init tree -> fabricated torch checkpoint.
+# Written independently of models/weights.py so the two directions
+# cross-check each other.
+# --------------------------------------------------------------------------
+
+def _torch_dense(flax_kernel):
+    return np.ascontiguousarray(np.asarray(flax_kernel).T)
+
+
+def _torch_conv(flax_kernel):
+    return np.ascontiguousarray(
+        np.transpose(np.asarray(flax_kernel), (3, 2, 0, 1))
+    )
+
+
+def fabricate_clip(params, num_layers):
+    p = params["params"]
+    out = {
+        "text_model.embeddings.token_embedding.weight":
+            np.asarray(p["token_embedding"]["embedding"]),
+        "text_model.embeddings.position_embedding.weight":
+            np.asarray(p["position_embedding"]),
+        "text_model.final_layer_norm.weight":
+            np.asarray(p["ln_final"]["scale"]),
+        "text_model.final_layer_norm.bias":
+            np.asarray(p["ln_final"]["bias"]),
+    }
+    for i in range(num_layers):
+        b = p[f"block_{i}"]
+        src = f"text_model.encoder.layers.{i}"
+        out[f"{src}.layer_norm1.weight"] = np.asarray(b["ln1"]["scale"])
+        out[f"{src}.layer_norm1.bias"] = np.asarray(b["ln1"]["bias"])
+        out[f"{src}.layer_norm2.weight"] = np.asarray(b["ln2"]["scale"])
+        out[f"{src}.layer_norm2.bias"] = np.asarray(b["ln2"]["bias"])
+        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                             ("v", "v_proj"), ("out", "out_proj")):
+            out[f"{src}.self_attn.{theirs}.weight"] = _torch_dense(
+                b["attn"][ours]["kernel"])
+            out[f"{src}.self_attn.{theirs}.bias"] = np.asarray(
+                b["attn"][ours]["bias"])
+        for fc in ("fc1", "fc2"):
+            out[f"{src}.mlp.{fc}.weight"] = _torch_dense(
+                b["mlp"][fc]["kernel"])
+            out[f"{src}.mlp.{fc}.bias"] = np.asarray(b["mlp"][fc]["bias"])
+    return out
+
+
+def test_convert_clip(cfg):
+    model = ClipTextEncoder(cfg.models.clip_text)
+    ids = jnp.zeros((1, 8), dtype=jnp.int32)
+    reference = init_params(model, 0, ids)
+    ckpt = fabricate_clip(reference, cfg.models.clip_text.num_layers)
+    converted = convert_clip_text(ckpt, cfg.models.clip_text.num_layers)
+    assert_same_structure(converted, reference)
+    # numeric: converted params give identical outputs to the originals
+    out_a = model.apply(reference, ids)["hidden"]
+    out_b = model.apply(
+        jax.tree_util.tree_map(jnp.asarray, converted), ids)["hidden"]
+    np.testing.assert_allclose(out_a, out_b, atol=1e-6)
+
+
+def fabricate_gpt2(params, num_layers, hidden):
+    p = params["params"]
+    out = {
+        "wte.weight": np.asarray(p["wte"]["embedding"]),
+        "wpe.weight": np.asarray(p["wpe"]["embedding"]),
+        "ln_f.weight": np.asarray(p["ln_f"]["scale"]),
+        "ln_f.bias": np.asarray(p["ln_f"]["bias"]),
+    }
+    for i in range(num_layers):
+        b = p[f"block_{i}"]
+        src = f"h.{i}"
+        out[f"{src}.ln_1.weight"] = np.asarray(b["ln1"]["scale"])
+        out[f"{src}.ln_1.bias"] = np.asarray(b["ln1"]["bias"])
+        out[f"{src}.ln_2.weight"] = np.asarray(b["ln2"]["scale"])
+        out[f"{src}.ln_2.bias"] = np.asarray(b["ln2"]["bias"])
+        # HF Conv1D: weight (in, out); fused qkv along out axis
+        out[f"{src}.attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(b["attn"][n]["kernel"]) for n in ("q", "k", "v")],
+            axis=1,
+        )
+        out[f"{src}.attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(b["attn"][n]["bias"]) for n in ("q", "k", "v")]
+        )
+        out[f"{src}.attn.c_proj.weight"] = np.asarray(
+            b["attn"]["out"]["kernel"])
+        out[f"{src}.attn.c_proj.bias"] = np.asarray(b["attn"]["out"]["bias"])
+        out[f"{src}.mlp.c_fc.weight"] = np.asarray(b["mlp"]["fc1"]["kernel"])
+        out[f"{src}.mlp.c_fc.bias"] = np.asarray(b["mlp"]["fc1"]["bias"])
+        out[f"{src}.mlp.c_proj.weight"] = np.asarray(
+            b["mlp"]["fc2"]["kernel"])
+        out[f"{src}.mlp.c_proj.bias"] = np.asarray(b["mlp"]["fc2"]["bias"])
+    return out
+
+
+def test_convert_gpt2(cfg):
+    gcfg = cfg.models.gpt2
+    model = GPT2LM(gcfg)
+    ids = jnp.zeros((1, 6), dtype=jnp.int32)
+    reference = init_params(model, 0, ids)
+    ckpt = fabricate_gpt2(reference, gcfg.num_layers, gcfg.hidden_size)
+    converted = convert_gpt2(ckpt, gcfg.num_layers, gcfg.hidden_size)
+    assert_same_structure(converted, reference)
+    out_a = model.apply(reference, ids)
+    out_b = model.apply(jax.tree_util.tree_map(jnp.asarray, converted), ids)
+    np.testing.assert_allclose(out_a, out_b, atol=1e-5)
+
+
+def fabricate_minilm(params, num_layers):
+    p = params["params"]
+    # token_type row must be zero for exact equality (it is folded into the
+    # position table by the converter).
+    hidden = p["position_embeddings"].shape[1]
+    out = {
+        "embeddings.word_embeddings.weight":
+            np.asarray(p["word_embeddings"]["embedding"]),
+        "embeddings.position_embeddings.weight":
+            np.asarray(p["position_embeddings"]),
+        "embeddings.token_type_embeddings.weight":
+            np.zeros((2, hidden), dtype=np.float32),
+        "embeddings.LayerNorm.weight": np.asarray(p["embed_ln"]["scale"]),
+        "embeddings.LayerNorm.bias": np.asarray(p["embed_ln"]["bias"]),
+    }
+    for i in range(num_layers):
+        b = p[f"block_{i}"]
+        src = f"encoder.layer.{i}"
+        for ours, theirs in (("q", "query"), ("k", "key"), ("v", "value")):
+            out[f"{src}.attention.self.{theirs}.weight"] = _torch_dense(
+                b["attn"][ours]["kernel"])
+            out[f"{src}.attention.self.{theirs}.bias"] = np.asarray(
+                b["attn"][ours]["bias"])
+        out[f"{src}.attention.output.dense.weight"] = _torch_dense(
+            b["attn"]["out"]["kernel"])
+        out[f"{src}.attention.output.dense.bias"] = np.asarray(
+            b["attn"]["out"]["bias"])
+        out[f"{src}.attention.output.LayerNorm.weight"] = np.asarray(
+            b["ln1"]["scale"])
+        out[f"{src}.attention.output.LayerNorm.bias"] = np.asarray(
+            b["ln1"]["bias"])
+        out[f"{src}.intermediate.dense.weight"] = _torch_dense(
+            b["mlp"]["fc1"]["kernel"])
+        out[f"{src}.intermediate.dense.bias"] = np.asarray(
+            b["mlp"]["fc1"]["bias"])
+        out[f"{src}.output.dense.weight"] = _torch_dense(
+            b["mlp"]["fc2"]["kernel"])
+        out[f"{src}.output.dense.bias"] = np.asarray(b["mlp"]["fc2"]["bias"])
+        out[f"{src}.output.LayerNorm.weight"] = np.asarray(
+            b["ln2"]["scale"])
+        out[f"{src}.output.LayerNorm.bias"] = np.asarray(b["ln2"]["bias"])
+    return out
+
+
+def test_convert_minilm(cfg):
+    mcfg = cfg.models.minilm
+    model = MiniLMEncoder(mcfg)
+    ids = jnp.zeros((1, 6), dtype=jnp.int32)
+    mask = jnp.ones((1, 6), dtype=jnp.int32)
+    reference = init_params(model, 0, ids, mask)
+    ckpt = fabricate_minilm(reference, mcfg.num_layers)
+    converted = convert_minilm(ckpt, mcfg.num_layers)
+    assert_same_structure(converted, reference)
+    out_a = model.apply(reference, ids, mask)
+    out_b = model.apply(
+        jax.tree_util.tree_map(jnp.asarray, converted), ids, mask)
+    np.testing.assert_allclose(out_a, out_b, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# UNet / VAE: reverse-map each flax param path to its diffusers name.
+# --------------------------------------------------------------------------
+
+def _unet_reverse_name(path, levels):
+    """flax path like 'down_0_res_1/conv1/kernel' -> diffusers name."""
+    parts = path.split("/")
+    top = parts[0]
+
+    def resblock_leaf(rest):
+        sub = {
+            "norm1/norm": "norm1", "norm2/norm": "norm2",
+            "conv1": "conv1", "conv2": "conv2",
+            "time_proj": "time_emb_proj", "skip": "conv_shortcut",
+        }["/".join(rest[:-1])]
+        return sub, rest[-1]
+
+    def attn_leaf(rest):
+        joined = "/".join(rest[:-1])
+        if joined == "norm/norm":
+            return "norm", rest[-1]
+        if joined in ("proj_in", "proj_out"):
+            return joined, rest[-1]
+        m = re.match(r"block_(\d+)/(\w+)(?:/(\w+))?$", joined)
+        blk, module, which = m.group(1), m.group(2), m.group(3)
+        if which is None:  # e.g. block_0/ln1 -> LayerNorm leaf
+            ln = {"ln1": "norm1", "ln2": "norm2", "ln3": "norm3"}[module]
+            return f"transformer_blocks.{blk}.{ln}", rest[-1]
+        attn_name = {"self_attn": "attn1", "cross_attn": "attn2"}.get(module)
+        if attn_name:
+            proj = {"q": "to_q", "k": "to_k", "v": "to_v",
+                    "out": "to_out.0"}[which]
+            return f"transformer_blocks.{blk}.{attn_name}.{proj}", rest[-1]
+        proj = {"proj": "ff.net.0.proj", "out": "ff.net.2"}[which]
+        return f"transformer_blocks.{blk}.{proj}", rest[-1]
+
+    if top == "conv_in":
+        return "conv_in", parts[-1]
+    if top == "conv_out":
+        return "conv_out", parts[-1]
+    if top == "norm_out":
+        return "conv_norm_out", parts[-1]
+    if top in ("time_fc1", "time_fc2"):
+        n = {"time_fc1": "time_embedding.linear_1",
+             "time_fc2": "time_embedding.linear_2"}[top]
+        return n, parts[-1]
+    m = re.match(r"down_(\d+)_res_(\d+)", top)
+    if m:
+        sub, leaf = resblock_leaf(parts[1:])
+        return f"down_blocks.{m.group(1)}.resnets.{m.group(2)}.{sub}", leaf
+    m = re.match(r"down_(\d+)_attn_(\d+)", top)
+    if m:
+        sub, leaf = attn_leaf(parts[1:])
+        return f"down_blocks.{m.group(1)}.attentions.{m.group(2)}.{sub}", leaf
+    m = re.match(r"down_(\d+)_downsample", top)
+    if m:
+        return f"down_blocks.{m.group(1)}.downsamplers.0.conv", parts[-1]
+    m = re.match(r"mid_res_(\d+)", top)
+    if m:
+        sub, leaf = resblock_leaf(parts[1:])
+        return f"mid_block.resnets.{m.group(1)}.{sub}", leaf
+    if top == "mid_attn":
+        sub, leaf = attn_leaf(parts[1:])
+        return f"mid_block.attentions.0.{sub}", leaf
+    m = re.match(r"up_(\d+)_res_(\d+)", top)
+    if m:
+        i = levels - 1 - int(m.group(1))
+        sub, leaf = resblock_leaf(parts[1:])
+        return f"up_blocks.{i}.resnets.{m.group(2)}.{sub}", leaf
+    m = re.match(r"up_(\d+)_attn_(\d+)", top)
+    if m:
+        i = levels - 1 - int(m.group(1))
+        sub, leaf = attn_leaf(parts[1:])
+        return f"up_blocks.{i}.attentions.{m.group(2)}.{sub}", leaf
+    m = re.match(r"up_(\d+)_upsample", top)
+    if m:
+        i = levels - 1 - int(m.group(1))
+        return f"up_blocks.{i}.upsamplers.0.conv", parts[-1]
+    raise KeyError(path)
+
+
+_LEAF_MAP = {"kernel": "weight", "bias": "bias",
+             "scale": "weight", "embedding": "weight"}
+
+
+def _to_torch_value(leaf_name, arr, torch_name):
+    arr = np.asarray(arr)
+    if leaf_name != "kernel":
+        return arr
+    if arr.ndim == 4:
+        return _torch_conv(arr)
+    # dense kernels that correspond to 1x1 convs in diffusers SD1.5
+    if any(s in torch_name for s in ("proj_in", "proj_out")):
+        return np.ascontiguousarray(arr.T)[:, :, None, None]
+    return _torch_dense(arr)
+
+
+def fabricate_unet(params, levels):
+    out = {}
+    for path, leaf in _flat(params).items():
+        assert path.startswith("params/")
+        rel = path[len("params/"):]
+        name, leaf_name = _unet_reverse_name(rel, levels)
+        out[f"{name}.{_LEAF_MAP[leaf_name]}"] = _to_torch_value(
+            leaf_name, leaf, name)
+    return out
+
+
+def test_convert_unet(cfg):
+    ucfg = cfg.models.unet
+    model = UNet(ucfg)
+    lat = jnp.zeros((1, 16, 16, 4), dtype=jnp.float32)
+    t = jnp.zeros((1,), dtype=jnp.int32)
+    ctx = jnp.zeros((1, 8, ucfg.context_dim), dtype=jnp.float32)
+    reference = init_params(model, 0, lat, t, ctx)
+    ckpt = fabricate_unet(reference, len(ucfg.channel_mults))
+    converted = convert_unet(ckpt, ucfg)
+    assert_same_structure(converted, reference)
+    out_a = model.apply(reference, lat, t, ctx)
+    out_b = model.apply(
+        jax.tree_util.tree_map(jnp.asarray, converted), lat, t, ctx)
+    np.testing.assert_allclose(out_a, out_b, atol=1e-5)
+
+
+def _vae_reverse_name(path, levels):
+    parts = path.split("/")
+    top = parts[0]
+
+    def resblock_leaf(rest):
+        sub = {
+            "norm1/norm": "norm1", "norm2/norm": "norm2",
+            "conv1": "conv1", "conv2": "conv2", "skip": "conv_shortcut",
+        }["/".join(rest[:-1])]
+        return sub, rest[-1]
+
+    if top == "post_quant_conv":
+        return "post_quant_conv", parts[-1]
+    if top == "conv_in":
+        return "decoder.conv_in", parts[-1]
+    if top == "conv_out":
+        return "decoder.conv_out", parts[-1]
+    if top == "norm_out":
+        return "decoder.conv_norm_out", parts[-1]
+    m = re.match(r"mid_res_(\d+)", top)
+    if m:
+        sub, leaf = resblock_leaf(parts[1:])
+        return f"decoder.mid_block.resnets.{m.group(1)}.{sub}", leaf
+    if top == "mid_attn":
+        joined = "/".join(parts[1:-1])
+        if joined == "norm/norm":
+            return "decoder.mid_block.attentions.0.group_norm", parts[-1]
+        which = parts[2]
+        proj = {"q": "to_q", "k": "to_k", "v": "to_v",
+                "out": "to_out.0"}[which]
+        return f"decoder.mid_block.attentions.0.{proj}", parts[-1]
+    m = re.match(r"up_(\d+)_res_(\d+)", top)
+    if m:
+        i = levels - 1 - int(m.group(1))
+        sub, leaf = resblock_leaf(parts[1:])
+        return f"decoder.up_blocks.{i}.resnets.{m.group(2)}.{sub}", leaf
+    m = re.match(r"up_(\d+)_upsample", top)
+    if m:
+        i = levels - 1 - int(m.group(1))
+        return f"decoder.up_blocks.{i}.upsamplers.0.conv", parts[-1]
+    raise KeyError(path)
+
+
+def fabricate_vae_decoder(params, levels):
+    out = {}
+    for path, leaf in _flat(params).items():
+        rel = path[len("params/"):]
+        name, leaf_name = _vae_reverse_name(rel, levels)
+        arr = np.asarray(leaf)
+        if leaf_name == "kernel":
+            arr = _torch_conv(arr) if arr.ndim == 4 else _torch_dense(arr)
+        out[f"{name}.{_LEAF_MAP[leaf_name]}"] = arr
+    return out
+
+
+def test_convert_vae_decoder(cfg):
+    vcfg = cfg.models.vae
+    model = VAEDecoder(vcfg)
+    lat = jnp.zeros((1, 8, 8, 4), dtype=jnp.float32)
+    reference = init_params(model, 0, lat)
+    ckpt = fabricate_vae_decoder(reference, len(vcfg.channel_mults))
+    converted = convert_vae_decoder(ckpt, vcfg)
+    assert_same_structure(converted, reference)
+    out_a = model.apply(reference, lat)
+    out_b = model.apply(jax.tree_util.tree_map(jnp.asarray, converted), lat)
+    np.testing.assert_allclose(out_a, out_b, atol=1e-5)
