@@ -27,6 +27,19 @@ _PLACE = ["harbor", "observatory", "market", "glacier", "station", "canyon"]
 _COLOR = ["crimson", "violet", "amber", "teal", "silver", "emerald"]
 
 
+def template_text(seed: str) -> str:
+    """Deterministic, always-maskable episode text derived from a seed
+    hash. Used by the fake backend and as the production pipeline's
+    fallback when a (e.g. randomly-initialized) LM emits degenerate text —
+    the round must stay playable (skip-don't-crash, SURVEY.md §5.3)."""
+    digest = hashlib.sha256(seed.encode()).digest()
+    pick = lambda options, i: options[digest[i] % len(options)]  # noqa: E731
+    return _FAKE_SENTENCES[digest[0] % len(_FAKE_SENTENCES)].format(
+        adj=pick(_ADJ, 1), noun=pick(_NOUN, 2),
+        place=pick(_PLACE, 3), color=pick(_COLOR, 4),
+    )
+
+
 class FakeContentBackend(ContentBackend):
     """Deterministic, instant content: text from a seed-hash template, image
     = a solid-pattern gradient keyed by the text. Lets the full game run
@@ -41,12 +54,8 @@ class FakeContentBackend(ContentBackend):
         self.calls += 1
         if self.delay_s:
             await asyncio.sleep(self.delay_s)
+        text = template_text(seed)
         digest = hashlib.sha256(seed.encode()).digest()
-        pick = lambda options, i: options[digest[i] % len(options)]  # noqa: E731
-        text = _FAKE_SENTENCES[digest[0] % len(_FAKE_SENTENCES)].format(
-            adj=pick(_ADJ, 1), noun=pick(_NOUN, 2),
-            place=pick(_PLACE, 3), color=pick(_COLOR, 4),
-        )
         size = self.image_size
         y, x = np.mgrid[0:size, 0:size]
         r = (x * int(digest[5]) // size) % 256

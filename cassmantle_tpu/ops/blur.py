@@ -1,0 +1,72 @@
+"""Gaussian blur on device: separable depthwise convolution.
+
+The reference blurs with PIL per request on the host CPU
+(backend.py:322-324, SURVEY.md §3.3 "CPU hot spot"). Here the reveal blur is
+two 1-D depthwise convs (separable Gaussian) compiled once for a static tap
+count; the per-request blur *radius* arrives as data (the kernel weights
+vector), so every radius reuses one compiled graph — no recompiles, no PIL.
+
+Matches PIL semantics closely enough for the game's purposes: PIL's
+GaussianBlur approximates a Gaussian with box blurs; we use the exact
+truncated Gaussian (radius = 3.5 sigma, SciPy/PIL-like truncation).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Max radius 15 (backend.py:319) -> ~2.3 sigma·3.5 taps each side at
+# sigma≈radius/2... keep a generous static width: 2*31+1 taps.
+MAX_TAPS = 63
+_HALF = MAX_TAPS // 2
+
+
+def gaussian_taps(radius: float) -> np.ndarray:
+    """Host-side: blur radius -> (MAX_TAPS,) normalized weights.
+
+    PIL's GaussianBlur(radius=r) uses sigma = r; taps beyond the static
+    window are truncated (negligible for r <= 15 with 31 taps per side at
+    sigma<=15: window covers ±2 sigma... adequate for a reveal effect).
+    """
+    if radius <= 0.05:
+        w = np.zeros(MAX_TAPS, dtype=np.float32)
+        w[_HALF] = 1.0
+        return w
+    sigma = float(radius)
+    x = np.arange(-_HALF, _HALF + 1, dtype=np.float32)
+    w = np.exp(-0.5 * (x / sigma) ** 2)
+    return (w / w.sum()).astype(np.float32)
+
+
+@jax.jit
+def blur_image(image_u8: jax.Array, taps: jax.Array) -> jax.Array:
+    """(H, W, 3) uint8 + (MAX_TAPS,) weights -> blurred (H, W, 3) uint8."""
+    img = image_u8.astype(jnp.float32)[None]          # (1, H, W, 3)
+    c = img.shape[-1]
+    # PIL-style border behavior: extend edges, then VALID conv.
+    img = jnp.pad(img, ((0, 0), (_HALF, _HALF), (_HALF, _HALF), (0, 0)),
+                  mode="edge")
+    kh = jnp.tile(taps[:, None, None, None], (1, 1, 1, c))  # (T,1,1,C)
+    kw = jnp.tile(taps[None, :, None, None], (1, 1, 1, c))
+    dn = jax.lax.conv_dimension_numbers(
+        img.shape, kh.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    out = jax.lax.conv_general_dilated(
+        img, kh, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=dn, feature_group_count=c,
+    )
+    out = jax.lax.conv_general_dilated(
+        out, kw, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=dn, feature_group_count=c,
+    )
+    return jnp.clip(jnp.round(out[0]), 0, 255).astype(jnp.uint8)
+
+
+def device_blur(image: np.ndarray, radius: float) -> np.ndarray:
+    """Game-facing BlurFn (engine/game.py): host arrays in/out."""
+    taps = jnp.asarray(gaussian_taps(radius))
+    return np.asarray(blur_image(jnp.asarray(image), taps))

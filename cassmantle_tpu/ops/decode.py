@@ -1,0 +1,68 @@
+"""Greedy text decode as prefill + ``lax.scan`` over KV-cache steps.
+
+Replaces the reference's remote LLM call (backend.py:240-268). The whole
+generation — prefill over the padded prompt bucket plus ``max_new_tokens``
+cached decode steps — compiles to one XLA computation with static shapes.
+Early stop is data-dependent, so instead of breaking the loop (illegal under
+jit) tokens after EOS are overwritten with EOS and reported lengths stop at
+the first EOS, matching the reference's "decode 32-96 tokens then trim"
+behavior (backend.py:250-255, 265).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(0, 4, 5))
+def greedy_decode(
+    model_apply_pair,          # (prefill_fn, decode_step_fn) static closure
+    input_ids: jax.Array,      # (B, P) right-padded prompt bucket
+    prompt_len: jax.Array,     # (B,)
+    rng_unused: jax.Array,     # reserved for future sampling modes
+    max_new_tokens: int,
+    eos_token: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (generated (B, max_new_tokens), gen_len (B,))."""
+    prefill_fn, decode_step_fn = model_apply_pair
+    b, p = input_ids.shape
+    max_len = p + max_new_tokens
+
+    last_logits, cache = prefill_fn(input_ids, prompt_len, max_len)
+
+    positions = jnp.arange(max_len)[None, :]          # (1, L)
+    prompt_valid = positions < prompt_len[:, None]     # (B, L)
+
+    def step(carry, i):
+        logits, cache, done = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = jnp.where(done, jnp.int32(eos_token), token)
+        emitted = token
+        done = done | (token == eos_token)
+        # All rows decode at cache index P+i. Rows whose prompt is shorter
+        # than P see a small position-id offset; the serving layer keeps
+        # buckets tight so the offset stays negligible, and masked padding
+        # positions are never attended either way.
+        idx = jnp.int32(p + i)
+        valid = prompt_valid | (
+            (positions >= p) & (positions <= idx)
+        )
+        logits, cache = decode_step_fn(token, idx, cache, valid)
+        return (logits, cache, done), emitted
+
+    init_done = jnp.zeros((b,), dtype=bool)
+    (_, _, _), tokens = jax.lax.scan(
+        step, (last_logits, cache, init_done), jnp.arange(max_new_tokens)
+    )
+    tokens = tokens.T  # (B, max_new_tokens)
+    is_eos = tokens == eos_token
+    gen_len = jnp.where(
+        is_eos.any(axis=1),
+        jnp.argmax(is_eos, axis=1),
+        jnp.int32(max_new_tokens),
+    )
+    return tokens, gen_len

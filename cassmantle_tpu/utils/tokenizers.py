@@ -1,0 +1,275 @@
+"""Self-contained tokenizers: byte-level BPE (GPT-2/CLIP), WordPiece (BERT),
+and a dependency-free byte fallback.
+
+The reference never tokenizes for models — its tokenization is
+nltk.word_tokenize for mask selection only (utils.py:83); model-side
+tokenization happened inside the HF Inference API. Running models locally
+needs real tokenizers, and this environment has no network egress, so:
+
+- If vocab artifacts exist in ``weights_dir`` (``vocab.json``+``merges.txt``
+  for GPT-2/CLIP, ``vocab.txt`` for MiniLM), full BPE/WordPiece encode and
+  decode are implemented here from scratch (no `tokenizers` wheel needed).
+- Otherwise :class:`ByteTokenizer` maps UTF-8 bytes to ids — lossless,
+  vocabulary-free, and enough to exercise every model path end to end with
+  random weights.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Tokenizer:
+    vocab_size: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Byte fallback
+# ---------------------------------------------------------------------------
+
+class ByteTokenizer(Tokenizer):
+    """ids 0..255 = bytes; 256 = BOS, 257 = EOS, 258 = PAD."""
+
+    BOS, EOS, PAD = 256, 257, 258
+
+    def __init__(self, vocab_size: int = 259) -> None:
+        assert vocab_size >= 259
+        self.vocab_size = vocab_size
+        self.eos_id = self.EOS
+        self.pad_id = self.PAD
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="ignore")
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE (GPT-2) and word-level BPE with </w> (CLIP)
+# ---------------------------------------------------------------------------
+
+@lru_cache()
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte<->printable-unicode table."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _bpe_merge(word: Tuple[str, ...], ranks: Dict[Tuple[str, str], int]
+               ) -> Tuple[str, ...]:
+    """Apply BPE merges to a symbol tuple until no ranked pair remains."""
+    word = list(word)
+    while len(word) > 1:
+        pairs = [(word[i], word[i + 1]) for i in range(len(word) - 1)]
+        best = min(pairs, key=lambda p: ranks.get(p, 1 << 30))
+        if best not in ranks:
+            break
+        merged, i = [], 0
+        while i < len(word):
+            if (
+                i < len(word) - 1
+                and (word[i], word[i + 1]) == best
+            ):
+                merged.append(word[i] + word[i + 1])
+                i += 2
+            else:
+                merged.append(word[i])
+                i += 1
+        word = merged
+    return tuple(word)
+
+
+class BPETokenizer(Tokenizer):
+    """GPT-2-style byte-level BPE (``style='gpt2'``) or CLIP-style
+    lowercased word BPE with ``</w>`` end-of-word markers
+    (``style='clip'``)."""
+
+    def __init__(self, vocab: Dict[str, int], merges: List[Tuple[str, str]],
+                 style: str = "gpt2") -> None:
+        import re
+
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.style = style
+        self.byte_enc = _bytes_to_unicode()
+        self.byte_dec = {v: k for k, v in self.byte_enc.items()}
+        self.vocab_size = max(vocab.values()) + 1
+        if style == "clip":
+            self.bos_id = vocab.get("<|startoftext|>", 0)
+            self.eos_id = vocab.get("<|endoftext|>", self.vocab_size - 1)
+            self.pad_id = self.eos_id
+        else:
+            self.eos_id = vocab.get("<|endoftext|>", self.vocab_size - 1)
+            self.pad_id = self.eos_id
+        self._word_re = re.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?\d+| ?[^\sA-Za-z\d]+|\s+"
+        )
+        self._cache: Dict[str, Tuple[str, ...]] = {}
+
+    @staticmethod
+    def from_files(vocab_path: str, merges_path: str,
+                   style: str = "gpt2") -> "BPETokenizer":
+        with open(vocab_path) as f:
+            vocab = json.load(f)
+        merges = []
+        with open(merges_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                if len(parts) == 2:
+                    merges.append((parts[0], parts[1]))
+        return BPETokenizer(vocab, merges, style=style)
+
+    def _encode_word(self, chunk: str) -> List[int]:
+        if chunk in self._cache:
+            symbols = self._cache[chunk]
+        else:
+            if self.style == "clip":
+                sym = tuple(chunk[:-1]) + (chunk[-1] + "</w>",)
+            else:
+                sym = tuple(self.byte_enc[b] for b in chunk.encode("utf-8"))
+            symbols = _bpe_merge(sym, self.ranks)
+            self._cache[chunk] = symbols
+        unk = self.vocab.get("<|unk|>", self.eos_id)
+        return [self.vocab.get(s, unk) for s in symbols]
+
+    def encode(self, text: str) -> List[int]:
+        if self.style == "clip":
+            words = text.lower().split()
+            ids = [self.bos_id]
+            for w in words:
+                ids.extend(self._encode_word(w))
+            return ids
+        ids: List[int] = []
+        for m in self._word_re.finditer(text):
+            chunk = m.group(0)
+            if self.style == "gpt2" and chunk.isspace() and chunk != " ":
+                chunk = " "
+            ids.extend(self._encode_word(chunk))
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        parts = [self.inv_vocab.get(int(i), "") for i in ids]
+        if self.style == "clip":
+            text = "".join(parts)
+            text = text.replace("</w>", " ")
+            for tok in ("<|startoftext|>", "<|endoftext|>"):
+                text = text.replace(tok, "")
+            return text.strip()
+        text = "".join(parts)
+        data = bytes(self.byte_dec.get(c, 32) for c in text)
+        return data.decode("utf-8", errors="ignore")
+
+
+# ---------------------------------------------------------------------------
+# WordPiece (BERT / MiniLM)
+# ---------------------------------------------------------------------------
+
+class WordPieceTokenizer(Tokenizer):
+    def __init__(self, vocab: Dict[str, int]) -> None:
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.vocab_size = max(vocab.values()) + 1
+        self.cls_id = vocab.get("[CLS]", 0)
+        self.sep_id = vocab.get("[SEP]", 0)
+        self.unk_id = vocab.get("[UNK]", 0)
+        self.pad_id = vocab.get("[PAD]", 0)
+        self.eos_id = self.sep_id
+
+    @staticmethod
+    def from_file(vocab_path: str) -> "WordPieceTokenizer":
+        vocab = {}
+        with open(vocab_path) as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\n")] = i
+        return WordPieceTokenizer(vocab)
+
+    def _split_word(self, word: str) -> List[int]:
+        ids, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str) -> List[int]:
+        import re
+
+        words = re.findall(r"[a-z0-9]+|[^\sa-z0-9]", text.lower())
+        ids = [self.cls_id]
+        for w in words:
+            ids.extend(self._split_word(w))
+        ids.append(self.sep_id)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        parts = []
+        for i in ids:
+            tok = self.inv_vocab.get(int(i), "")
+            if tok in ("[CLS]", "[SEP]", "[PAD]"):
+                continue
+            if tok.startswith("##") and parts:
+                parts[-1] += tok[2:]
+            else:
+                parts.append(tok)
+        return " ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+def load_tokenizer(
+    weights_dir: Optional[str], kind: str, vocab_size: int
+) -> Tokenizer:
+    """kind in {'gpt2', 'clip', 'minilm'}; byte fallback when artifacts are
+    missing (always the case under zero egress with no baked checkpoints)."""
+    if weights_dir:
+        if kind in ("gpt2", "clip"):
+            vocab = os.path.join(weights_dir, f"{kind}_vocab.json")
+            merges = os.path.join(weights_dir, f"{kind}_merges.txt")
+            if os.path.exists(vocab) and os.path.exists(merges):
+                return BPETokenizer.from_files(vocab, merges, style=kind)
+        if kind == "minilm":
+            vocab_txt = os.path.join(weights_dir, "minilm_vocab.txt")
+            if os.path.exists(vocab_txt):
+                return WordPieceTokenizer.from_file(vocab_txt)
+    return ByteTokenizer(max(vocab_size, 259))
