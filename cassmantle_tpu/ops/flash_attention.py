@@ -1,18 +1,155 @@
-"""Pallas flash attention (placeholder until the kernel lands).
+"""Pallas TPU flash attention: blockwise online-softmax, O(N) memory.
 
-The real blockwise online-softmax kernel is task 5; this stub keeps the
-dispatch seam in ops/attention.py honest: ``flash_attention_ok`` returns
-False so all callers use the XLA path.
+The UNet's self-attention over image tokens is the framework's "long
+sequence" axis (SURVEY.md §5.7): 4,096 tokens at 512² latents, 16k+ at
+SDXL-1024. This kernel tiles Q into VMEM blocks and streams K/V blocks
+through the grid's innermost dimension, keeping the running max/denominator
+(online softmax) in fp32 scratch — attention never materializes the (S, S)
+score matrix in HBM.
+
+Layout: callers pass q/k/v as (..., S, H, D); the wrapper folds batch×heads
+into the leading grid dimension. Scores accumulate in fp32 on the MXU
+(``preferred_element_type``); probabilities are cast back to the value dtype
+for the P·V matmul so both matmuls hit the MXU in bf16 on TPU.
+
+Dispatch rules (``flash_attention_ok``): self-attention (no mask), sequence
+divisible into blocks, head_dim bounded — everything else (cross-attention
+with S_k=77, tiny text sequences) stays on the XLA path where fusion is
+already optimal.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 512
+BLOCK_K = 512
+MAX_HEAD_DIM = 256
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
 
 
 def flash_attention_ok(q: jax.Array, k: jax.Array) -> bool:
-    return False
+    """Shapes the kernel handles profitably (others -> XLA path)."""
+    sq, sk, d = q.shape[-3], k.shape[-3], q.shape[-1]
+    return (
+        sq % BLOCK_Q == 0
+        and sk % BLOCK_K == 0
+        and sq >= BLOCK_Q
+        and sk >= BLOCK_K
+        and d <= MAX_HEAD_DIM
+        and q.ndim >= 4
+    )
 
 
-def flash_attention(q, k, v, scale=None):  # pragma: no cover
-    raise NotImplementedError("pallas flash attention lands in ops task 5")
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, num_k_blocks: int):
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                      # (BQ, D)
+    k = k_ref[0]                      # (BK, D)
+    v = v_ref[0]                      # (BK, D)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                          # (BQ, BK) fp32
+
+    m_prev = m_ref[:, :1]             # (BQ, 1)
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)   # (BQ, 1)
+    p = jnp.exp(s - m_new)            # (BQ, BK) fp32
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                  # (BQ, D) fp32
+    acc_ref[:] = acc_ref[:] * alpha + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(k_idx == num_k_blocks - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _flash_bhsd(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
+                interpret: bool) -> jax.Array:
+    """(BH, S, D) flash attention."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // BLOCK_Q, sk // BLOCK_K
+
+    grid = (bh, nq, nk)
+    kernel = functools.partial(_flash_kernel, scale=scale, num_k_blocks=nk)
+    # Only the k-block axis carries state (online-softmax scratch); the
+    # batch*heads and q-block axes are embarrassingly parallel.
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+    flops = 2 * 2 * bh * sq * sk * d  # QK^T + PV
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),   # running max
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((BLOCK_Q, d), jnp.float32),     # output accumulator
+        ],
+        compiler_params=compiler_params,
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=(2 * bh * sq * d + 2 * bh * sk * d) * 2,
+            transcendentals=bh * sq * sk,
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    scale=None, interpret=None) -> jax.Array:
+    """(..., S, H, D) self-attention via the Pallas kernel."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    *batch, sq, h, d = q.shape
+    sk = k.shape[-3]
+
+    def fold(t, s):
+        t = jnp.moveaxis(t, -2, -3)               # (..., H, S, D)
+        return t.reshape((-1, s, d))
+
+    qf, kf, vf = fold(q, sq), fold(k, sk), fold(v, sk)
+    out = _flash_bhsd(qf, kf, vf, float(scale), bool(interpret))
+    out = out.reshape(tuple(batch) + (h, sq, d))
+    return jnp.moveaxis(out, -3, -2)              # (..., S, H, D)
