@@ -1,0 +1,2 @@
+from cassmantle_tpu.parallel.mesh import make_mesh  # noqa: F401
+from cassmantle_tpu.parallel.ring import ring_attention  # noqa: F401

@@ -1,0 +1,83 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Long-context support (SURVEY.md §5.7): the UNet's image-token axis (16k+
+tokens at SDXL-1024 and beyond) and any long text sequence shard over the
+``sp`` mesh axis. Each device holds a sequence slice of Q/K/V; K/V blocks
+rotate around the ring via ``ppermute`` (one ICI hop per step) while the
+online-softmax running max/denominator merge partial results — the
+shard_map/XLA-collective formulation of the same math the Pallas flash
+kernel does within a chip. Memory per device stays O(S/n), and the K/V
+transfer for step i+1 overlaps with the compute of step i (XLA schedules
+the ppermute async on ICI).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, axis_name: str, scale: float):
+    """Per-shard body (runs under shard_map). q/k/v: (B, S_l, H, D)."""
+    n = jax.lax.psum(1, axis_name)
+
+    def step(carry, _):
+        k_cur, v_cur, m, l, acc = carry
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_cur,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * alpha + pv
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    b, s_l, h, d = q.shape
+    # initial carries are constants -> mark them device-varying over the
+    # ring axis so the scan carry type stays consistent
+    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")  # noqa: E731
+    m0 = vary(jnp.full((b, h, s_l, 1), _NEG_INF, dtype=jnp.float32))
+    l0 = vary(jnp.zeros((b, h, s_l, 1), dtype=jnp.float32))
+    acc0 = vary(jnp.zeros((b, h, s_l, d), dtype=jnp.float32))
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), None, length=n
+    )
+    out = acc / l
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence-parallel attention. Global shapes (B, S, H, D); S shards
+    over ``axis_name``; every other dim is replicated across that axis."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    body = functools.partial(
+        _ring_attention_local, axis_name=axis_name, scale=float(scale)
+    )
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)

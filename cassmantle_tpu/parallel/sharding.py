@@ -1,0 +1,83 @@
+"""Parameter/activation sharding rules (GSPMD partition specs).
+
+Megatron-style tensor parallelism for every transformer in the zoo, using
+the param-path conventions of our modules (models/layers.py):
+
+- attention ``q/k/v`` Dense kernels: shard the output (head) dim over
+  ``tp``; the ``out`` projection shards its input dim — the pair needs one
+  psum per attention block, inserted automatically by GSPMD.
+- MLP/GEGLU: first Dense shards output dim, second shards input dim.
+- conv kernels, norms, embeddings: replicated (convs are the UNet's
+  majority FLOPs but shard naturally over ``dp``/``sp`` instead).
+
+Everything is expressed as regex -> PartitionSpec rules on flattened param
+paths, so the same table serves UNet, CLIP, GPT-2 and MiniLM.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec) — first match wins. Kernel layouts: Dense (in, out),
+# Conv (H, W, in, out), Embed (vocab, dim).
+TP_RULES: List[Tuple[str, P]] = [
+    # attention projections
+    (r".*/(self_attn|cross_attn|attn)/(q|k|v)/kernel$", P(None, "tp")),
+    (r".*/(self_attn|cross_attn|attn)/(q|k|v)/bias$", P("tp")),
+    (r".*/(self_attn|cross_attn|attn)/out/kernel$", P("tp", None)),
+    # MLP / GEGLU
+    (r".*/(mlp|ff)/(fc1|proj)/kernel$", P(None, "tp")),
+    (r".*/(mlp|ff)/(fc1|proj)/bias$", P("tp")),
+    (r".*/(mlp|ff)/(fc2|out)/kernel$", P("tp", None)),
+    # everything else replicated
+    (r".*", P()),
+]
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def param_specs(params) -> "jax.tree_util.PyTreeDef":
+    """Param tree -> tree of PartitionSpec following TP_RULES."""
+
+    def spec_for(path, leaf):
+        s = _path_str(path)
+        for pattern, spec in TP_RULES:
+            if re.match(pattern, s):
+                # never shard a dim that doesn't divide; GSPMD requires
+                # divisibility — fall back to replication if mismatched.
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a param tree on the mesh per TP_RULES (validating divisibility
+    and falling back to replication where a dim doesn't divide)."""
+    tp = mesh.shape.get("tp", 1)
+
+    def place(path, leaf):
+        spec = None
+        s = _path_str(path)
+        for pattern, candidate in TP_RULES:
+            if re.match(pattern, s):
+                spec = candidate
+                break
+        if spec is None:
+            spec = P()
+        # validate divisibility of each sharded dim
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            if dim >= leaf.ndim or leaf.shape[dim] % tp != 0:
+                spec = P()
+                break
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)

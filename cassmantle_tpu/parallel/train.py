@@ -1,0 +1,119 @@
+"""Distributed diffusion training step (dp × tp × sp over one mesh).
+
+The reference never trains anything — but a complete framework must
+(fine-tuning the UNet on new styles is the natural extension of the game's
+content loop), and the driver's multi-chip dryrun compiles exactly this
+step. Design:
+
+- **loss**: standard denoising-score-matching: sample t ~ U, noise the
+  clean latents with the DDIM schedule's ᾱ, MSE between predicted and true
+  noise.
+- **dp**: batch dim sharded; gradient all-reduce inserted by GSPMD from
+  the sharding constraints (rides ICI).
+- **tp**: attention/MLP kernels sharded per parallel/sharding.py rules.
+- **sp**: inside the UNet the image-token axis can further shard via ring
+  attention (parallel/ring.py); at train-step level the latent height dim
+  shards over ``sp`` for the conv stack (halo-free 1x1/3x3 convs handled
+  by GSPMD's spatial partitioning).
+- bf16 activations, fp32 params/optimizer state, optax adamw with
+  gradient clipping; ``donate_argnums`` so params/opt state update
+  in place in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.models.unet import UNet
+from cassmantle_tpu.models.weights import init_params
+from cassmantle_tpu.ops.ddim import DDIMSchedule
+from cassmantle_tpu.parallel.sharding import shard_params
+
+
+def make_optimizer(lr: float = 1e-4) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.999, weight_decay=0.01),
+    )
+
+
+class DiffusionTrainer:
+    """Owns sharded params/opt state and the compiled train step."""
+
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        mesh: Mesh,
+        lr: float = 1e-4,
+        num_train_steps: int = 1000,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.unet = UNet(cfg.models.unet)
+        self.optimizer = make_optimizer(lr)
+
+        betas = (
+            jnp.linspace(0.00085**0.5, 0.012**0.5, num_train_steps) ** 2
+        )
+        self.alpha_bars = jnp.cumprod(1.0 - betas)
+        self.num_train_steps = num_train_steps
+
+        self._step = jax.jit(
+            self._train_step_impl, donate_argnums=(0, 1)
+        )
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, sample_batch: Dict[str, jax.Array], seed: int = 0
+                   ) -> Tuple[Any, Any]:
+        params = init_params(
+            self.unet, seed,
+            sample_batch["latents"],
+            jnp.zeros((sample_batch["latents"].shape[0],), jnp.int32),
+            sample_batch["context"],
+        )
+        params = shard_params(params, self.mesh)
+        opt_state = self.optimizer.init(params)
+        # optimizer moments inherit param shardings naturally via init
+        return params, opt_state
+
+    def batch_sharding(self) -> NamedSharding:
+        # batch over dp; latent height over sp (spatial partitioning)
+        return NamedSharding(self.mesh, P("dp", "sp"))
+
+    def shard_batch(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        lat_sh = self.batch_sharding()
+        ctx_sh = NamedSharding(self.mesh, P("dp"))
+        return {
+            "latents": jax.device_put(batch["latents"], lat_sh),
+            "context": jax.device_put(batch["context"], ctx_sh),
+        }
+
+    # -- step -------------------------------------------------------------
+    def _train_step_impl(self, params, opt_state, batch, rng):
+        latents = batch["latents"]
+        context = batch["context"]
+        b = latents.shape[0]
+        rng_t, rng_n = jax.random.split(rng)
+        t = jax.random.randint(rng_t, (b,), 0, self.num_train_steps)
+        noise = jax.random.normal(rng_n, latents.shape, latents.dtype)
+        a = self.alpha_bars[t][:, None, None, None]
+        noisy = jnp.sqrt(a) * latents + jnp.sqrt(1.0 - a) * noise
+
+        def loss_fn(p):
+            pred = self.unet.apply(p, noisy, t, context)
+            return jnp.mean((pred - noise) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = self.optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    def step(self, params, opt_state, batch, rng):
+        return self._step(params, opt_state, batch, rng)
