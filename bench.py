@@ -1,0 +1,61 @@
+"""Benchmark: SD1.5-geometry 512x512 txt2img, 50-step DDIM, images/sec/chip.
+
+The BASELINE.md north-star config: full serving pipeline (CLIP encode →
+50-step CFG DDIM scan → VAE decode → uint8) on one chip. Weights are
+deterministic random unless checkpoints exist under ``weights/`` —
+throughput is weight-independent.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline target: 4 images/sec/chip (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_IMAGES_PER_SEC = 4.0
+BATCH = 4
+TIMED_ROUNDS = 3
+
+
+def main() -> None:
+    import jax
+
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    cfg = FrameworkConfig()
+    weights_dir = "weights" if len(sys.argv) < 2 else sys.argv[1]
+    pipe = Text2ImagePipeline(cfg, weights_dir=weights_dir)
+
+    prompts = [
+        "A watercolor style piece depicting: a lighthouse over a stormy sea",
+        "An art deco style piece depicting: a caravan crossing silver dunes",
+        "A stained glass style piece depicting: an orchard under two moons",
+        "A vaporwave style piece depicting: a night train between cities",
+    ][:BATCH]
+
+    # warmup / compile
+    pipe.generate(prompts, seed=0)
+
+    n_images = 0
+    t0 = time.perf_counter()
+    for i in range(TIMED_ROUNDS):
+        images = pipe.generate(prompts, seed=i + 1)
+        n_images += images.shape[0]
+    elapsed = time.perf_counter() - t0
+
+    n_chips = jax.local_device_count()
+    ips_per_chip = n_images / elapsed / max(1, n_chips)
+    print(json.dumps({
+        "metric": "sd15_512px_ddim50_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 4),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Continuous-batching coalescer: async requests -> fixed-shape device batches.
+
+The reference scores each guess synchronously on the request path
+(backend.py:303-317) and could not batch across players. Here concurrent
+requests (guess scorings, image generations) land in an asyncio queue; a
+collector drains up to the largest configured bucket or until
+``max_delay_ms`` passes, then hands the batch to a single dispatch thread —
+one thread per process so device dispatches serialize (one compiled graph
+in flight per step) while the event loop stays free (SURVEY.md §7 stage 6,
+hard part (d)). Bucketed batch sizes keep shapes static: a batch of 37
+guesses pads to the 64 bucket, reusing the compiled graph.
+
+Backpressure: a bounded queue; when full, ``submit`` fails fast and the
+caller degrades (skip-don't-crash, reference error semantics §5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Awaitable, Callable, Generic, List, Optional, Sequence, TypeVar
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+log = get_logger("queue")
+
+# One dispatch thread per process: device work serializes here.
+_dispatch_executor = ThreadPoolExecutor(
+    max_workers=1, thread_name_prefix="cassmantle-dispatch"
+)
+
+
+class QueueFull(Exception):
+    pass
+
+
+class BatchingQueue(Generic[T, R]):
+    """Coalesces ``submit`` calls into batched ``handler`` invocations.
+
+    ``handler(items) -> results`` runs on the dispatch thread and must
+    return one result per item (it pads internally to its bucket shapes).
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[List[T]], Sequence[R]],
+        max_batch: int = 1024,
+        max_delay_ms: float = 25.0,
+        max_pending: int = 4096,
+        name: str = "queue",
+    ) -> None:
+        self.handler = handler
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_ms / 1000.0
+        self.name = name
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_pending)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def submit(self, item: T) -> R:
+        self.start()
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+        try:
+            self._queue.put_nowait((item, fut))
+        except asyncio.QueueFull:
+            metrics.inc(f"{self.name}.rejected")
+            raise QueueFull(self.name)
+        metrics.gauge(f"{self.name}.depth", self._queue.qsize())
+        return await fut
+
+    async def _collect(self) -> List:
+        """One entry (blocking) + everything arriving within the window."""
+        first = await self._queue.get()
+        batch = [first]
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self.max_delay_s
+        while len(batch) < self.max_batch:
+            timeout = deadline - loop.time()
+            if timeout <= 0:
+                break
+            try:
+                batch.append(
+                    await asyncio.wait_for(self._queue.get(), timeout)
+                )
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+    async def _run(self) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            batch = await self._collect()
+            items = [item for item, _ in batch]
+            futures = [fut for _, fut in batch]
+            metrics.inc(f"{self.name}.batches")
+            metrics.inc(f"{self.name}.items", len(items))
+            try:
+                with metrics.timer(f"{self.name}.batch_s"):
+                    results = await loop.run_in_executor(
+                        _dispatch_executor, self.handler, items
+                    )
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"handler returned {len(results)} results for "
+                        f"{len(items)} items"
+                    )
+                for fut, res in zip(futures, results):
+                    if not fut.done():
+                        fut.set_result(res)
+            except Exception as exc:  # noqa: BLE001 — propagate per-item
+                log.exception("%s batch failed", self.name)
+                metrics.inc(f"{self.name}.failures")
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(exc)

@@ -1,0 +1,75 @@
+"""Inference service: wires pipelines + batching queues into the game's
+injection points (embed / similarity / blur / ContentBackend).
+
+This is the production counterpart of the test wiring in
+tests/test_pipeline.py: one object owning the TPU state that the server
+layer (server/app.py) plugs into the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.ops.blur import device_blur
+from cassmantle_tpu.ops.scorer import EmbeddingScorer
+from cassmantle_tpu.serving.pipeline import TPUContentBackend
+from cassmantle_tpu.serving.queue import BatchingQueue, QueueFull
+from cassmantle_tpu.utils.logging import get_logger
+
+log = get_logger("service")
+
+
+class InferenceService:
+    def __init__(self, cfg: FrameworkConfig,
+                 weights_dir: Optional[str] = None) -> None:
+        self.cfg = cfg
+        self.scorer = EmbeddingScorer(
+            cfg.models.minilm,
+            weights_dir=weights_dir,
+            batch_buckets=cfg.serving.score_batch_sizes,
+        )
+        self.backend = TPUContentBackend(cfg, weights_dir=weights_dir)
+        self.score_queue: BatchingQueue = BatchingQueue(
+            handler=self._score_batch,
+            max_batch=max(cfg.serving.score_batch_sizes),
+            max_delay_ms=cfg.serving.max_queue_delay_ms,
+            max_pending=cfg.serving.max_pending,
+            name="score",
+        )
+
+    # handler runs on the dispatch thread
+    def _score_batch(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
+        return self.scorer.similarity(list(pairs))
+
+    # -- engine injection points -----------------------------------------
+    def embed(self, words) -> np.ndarray:
+        return self.scorer.embed(list(words))
+
+    async def similarity(self, pairs) -> np.ndarray:
+        """SimilarityFn: each pair rides the continuous-batching queue, so
+        concurrent guesses from many players coalesce into one device
+        batch."""
+        import asyncio
+
+        pairs = list(pairs)
+        try:
+            results = await asyncio.gather(
+                *(self.score_queue.submit(p) for p in pairs)
+            )
+        except QueueFull:
+            # overload: degrade to the min score rather than failing the
+            # request (skip-don't-crash)
+            log.warning("score queue full; returning zeros for %d pairs",
+                        len(pairs))
+            return np.zeros((len(pairs),), dtype=np.float32)
+        return np.asarray(results, dtype=np.float32)
+
+    @staticmethod
+    def blur(image: np.ndarray, radius: float) -> np.ndarray:
+        return device_blur(image, radius)
+
+    async def stop(self) -> None:
+        await self.score_queue.stop()
