@@ -1,0 +1,284 @@
+"""HTTP/WS API surface (aiohttp) — the reference's FastAPI layer rebuilt.
+
+Route-for-route parity with the reference (SURVEY.md §1 L4, §3.3-3.5):
+
+- ``GET  /``               game page (static/index.html)
+- ``GET  /init``           new session id in a cookie (main.py:47-53)
+- ``GET  /client/status``  {won, needInitialization} (main.py:81-93)
+- ``GET  /fetch/contents`` {image: b64 jpeg (per-session blur), prompt
+                            json, story} (main.py:95-111)
+- ``POST /compute_score``  {inputs: {mask_idx: guess}} -> scores
+                            (main.py:113-120)
+- ``WS   /clock``          1 Hz {time, reset, conns} push (main.py:55-79)
+- ``GET  /metrics``        counters/timings (new; SURVEY.md §5.5)
+- static mounts ``/static`` and ``/data`` (main.py:25-27)
+
+Rate limits mirror the reference: 3/s default, 2/s API routes, per IP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import uuid
+from typing import Optional
+
+import numpy as np
+from aiohttp import WSMsgType, web
+
+from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.utils.codec import image_to_base64
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("app")
+
+STATIC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "static"
+)
+DATA_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "data"
+)
+
+_GAME = web.AppKey("game", Game)
+
+
+def _client_ip(request: web.Request) -> str:
+    peer = request.transport.get_extra_info("peername") if request.transport else None
+    return peer[0] if peer else "?"
+
+
+def _session_id(request: web.Request) -> Optional[str]:
+    return request.cookies.get("session_id")
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler):
+    if request.method == "OPTIONS":
+        response = web.Response()
+    else:
+        response = await handler(request)
+    response.headers["Access-Control-Allow-Origin"] = "*"
+    response.headers["Access-Control-Allow-Credentials"] = "true"
+    response.headers["Access-Control-Allow-Methods"] = "GET, POST"
+    response.headers["Access-Control-Allow-Headers"] = "*"
+    return response
+
+
+def make_ratelimit_middleware(cfg: FrameworkConfig):
+    from cassmantle_tpu.server.ratelimit import RateLimiter
+
+    limiter = RateLimiter()
+    api_routes = {"/init", "/client/status", "/fetch/contents",
+                  "/compute_score"}
+
+    @web.middleware
+    async def ratelimit(request: web.Request, handler):
+        if request.path in api_routes:
+            rate = cfg.game.rate_limit_api
+        else:
+            rate = cfg.game.rate_limit_default
+        if not limiter.allow(_client_ip(request), request.path, rate):
+            metrics.inc("http.rate_limited")
+            raise web.HTTPTooManyRequests(text="rate limit exceeded")
+        return await handler(request)
+
+    return ratelimit
+
+
+async def handle_root(request: web.Request) -> web.StreamResponse:
+    return web.FileResponse(os.path.join(STATIC_DIR, "index.html"))
+
+
+async def handle_init(request: web.Request) -> web.Response:
+    game = request.app[_GAME]
+    session_id = str(uuid.uuid4())
+    await game.init_client(session_id)
+    response = web.json_response(
+        {"message": "Session initialized", "session_id": session_id}
+    )
+    response.set_cookie("session_id", session_id)
+    metrics.inc("http.init")
+    return response
+
+
+async def handle_status(request: web.Request) -> web.Response:
+    game = request.app[_GAME]
+    return web.json_response(await game.client_status(_session_id(request)))
+
+
+async def handle_fetch_contents(request: web.Request) -> web.Response:
+    game = request.app[_GAME]
+    session = _session_id(request) or str(uuid.uuid4())
+    await game.ensure_client(session)
+    with metrics.timer("http.fetch_contents_s"):
+        image = await game.fetch_masked_image(session)
+        prompt = await game.fetch_prompt_json(session)
+        story = await game.fetch_story()
+    response = web.json_response({
+        "image": image_to_base64(np.asarray(image)),
+        "prompt": prompt,
+        "story": story,
+    })
+    if not _session_id(request):
+        response.set_cookie("session_id", session)
+    return response
+
+
+async def handle_compute_score(request: web.Request) -> web.Response:
+    game = request.app[_GAME]
+    session = _session_id(request) or str(uuid.uuid4())
+    await game.ensure_client(session)
+    try:
+        data = await request.json()
+        inputs = data["inputs"]
+        assert isinstance(inputs, dict)
+    except Exception:
+        raise web.HTTPBadRequest(text="body must be {inputs: {idx: guess}}")
+    with metrics.timer("http.compute_score_s"):
+        scores = await game.compute_client_scores(session, inputs)
+    return web.json_response(scores)
+
+
+async def handle_clock(request: web.Request) -> web.WebSocketResponse:
+    game = request.app[_GAME]
+    session = _session_id(request)
+    ws = web.WebSocketResponse(heartbeat=30.0)
+    await ws.prepare(request)
+    log.info("client %s connected", session)
+    metrics.inc("ws.connections")
+
+    async def sender() -> None:
+        while not ws.closed:
+            if session:
+                await game.sessions.add_client(session)
+            await asyncio.sleep(1.0)
+            await ws.send_json(await game.clock_payload())
+
+    send_task = asyncio.ensure_future(sender())
+    try:
+        # consume incoming frames until the client goes away
+        async for msg in ws:
+            if msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        send_task.cancel()
+        try:
+            await send_task
+        except (asyncio.CancelledError, ConnectionResetError, Exception):
+            pass
+        log.info("client %s disconnected", session)
+        if session:
+            await game.sessions.remove_connection(session)
+        metrics.inc("ws.disconnections")
+    return ws
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    return web.json_response(metrics.snapshot())
+
+
+async def handle_wordlist(request: web.Request) -> web.Response:
+    """Vocabulary words for client-side guess validation (replaces the
+    reference's vendored hunspell dictionary + typo.js, §2 F3)."""
+    game = request.app[_GAME]
+    prompt = await game.rounds.fetch_current_prompt()
+    # The client only needs to validate words; serve the engine stopword
+    # set + current tokens as a light heuristic addition to its local rules
+    from cassmantle_tpu.engine.masking import STOPWORDS
+
+    return web.json_response({
+        "stopwords": sorted(STOPWORDS),
+        "min_len": 2,
+    })
+
+
+def create_app(game: Game, cfg: FrameworkConfig,
+               start_timer: bool = True) -> web.Application:
+    app = web.Application(middlewares=[
+        cors_middleware, make_ratelimit_middleware(cfg)
+    ])
+    app[_GAME] = game
+    app.router.add_get("/", handle_root)
+    app.router.add_get("/init", handle_init)
+    app.router.add_get("/client/status", handle_status)
+    app.router.add_get("/fetch/contents", handle_fetch_contents)
+    app.router.add_post("/compute_score", handle_compute_score)
+    app.router.add_get("/clock", handle_clock)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/wordlist", handle_wordlist)
+    if os.path.isdir(STATIC_DIR):
+        app.router.add_static("/static", STATIC_DIR)
+    if os.path.isdir(DATA_DIR):
+        app.router.add_static("/data", DATA_DIR)
+
+    async def on_startup(app_: web.Application) -> None:
+        await game.startup()
+        if start_timer:
+            game.start_timer()
+
+    async def on_cleanup(app_: web.Application) -> None:
+        await game.shutdown()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def build_game(cfg: FrameworkConfig, fake: bool = False,
+               weights_dir: Optional[str] = None) -> Game:
+    """Assemble a Game with real TPU serving or the fake backend."""
+    from cassmantle_tpu.engine.store import MemoryStore
+
+    store = MemoryStore()
+    if fake:
+        from cassmantle_tpu.engine.content import (
+            FakeContentBackend,
+            hash_embed,
+            hash_similarity,
+        )
+
+        return Game(cfg, store, FakeContentBackend(image_size=256),
+                    hash_embed, hash_similarity)
+    from cassmantle_tpu.serving.service import InferenceService
+
+    service = InferenceService(cfg, weights_dir=weights_dir)
+    return Game(
+        cfg, store, service.backend,
+        embed=service.embed,
+        similarity=service.similarity,
+        blur_fn=service.blur,
+    )
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="cassmantle-tpu server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--fake", action="store_true",
+                        help="deterministic fake content backend (no TPU)")
+    parser.add_argument("--weights", default=None,
+                        help="safetensors checkpoint directory")
+    parser.add_argument("--round-seconds", type=float, default=None)
+    args = parser.parse_args()
+
+    cfg = FrameworkConfig()
+    if args.round_seconds:
+        import dataclasses
+
+        cfg = cfg.replace(
+            game=dataclasses.replace(cfg.game,
+                                     time_per_prompt=args.round_seconds)
+        )
+    game = build_game(cfg, fake=args.fake, weights_dir=args.weights)
+    web.run_app(create_app(game, cfg), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Per-IP token-bucket rate limiting.
+
+The reference rate-limits with slowapi (3/s default, 2/s API routes;
+main.py:19, 43-48, 82, 96, 114). Same policy here, implemented as a small
+token bucket so there is no external dependency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: float = None) -> None:
+        self.rate = rate
+        self.burst = burst if burst is not None else max(1.0, rate)
+        self.tokens = self.burst
+        self.updated = time.monotonic()
+
+    def allow(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(
+            self.burst, self.tokens + (now - self.updated) * self.rate
+        )
+        self.updated = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class RateLimiter:
+    """Buckets keyed by (ip, class); stale buckets evicted lazily."""
+
+    def __init__(self, max_entries: int = 10000) -> None:
+        self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
+        self.max_entries = max_entries
+
+    def allow(self, ip: str, route_class: str, rate: float) -> bool:
+        key = (ip, route_class)
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            if len(self._buckets) >= self.max_entries:
+                self._buckets.clear()  # crude flush; per-IP state is cheap
+            bucket = self._buckets[key] = TokenBucket(rate)
+        return bucket.allow()

@@ -1,0 +1,213 @@
+/* CassMantle TPU frontend.
+ *
+ * Capability parity with the reference client (SURVEY.md §2.2):
+ * session bootstrap (/client/status -> /init), 1 Hz websocket clock with
+ * reset-triggered refetch, content rendering (base64 image, tokenized
+ * prompt with inputs at mask indices, score placeholders, solved tokens),
+ * guess submission with client-side validation, win banner, clock blink
+ * under 60 s. Guess validation is rule-based + /wordlist stopwords instead
+ * of a vendored hunspell dictionary.
+ */
+
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+const state = {
+  masks: [],
+  scores: {},
+  won: false,
+  stopwords: new Set(),
+  submitting: false,
+};
+
+/* ---------------- session bootstrap ---------------- */
+
+async function ensureSession() {
+  const res = await fetch("/client/status", { credentials: "include" });
+  const data = await res.json();
+  if (data.needInitialization) {
+    await fetch("/init", { credentials: "include" });
+  } else {
+    state.won = !!data.won;
+  }
+}
+
+async function loadWordlist() {
+  try {
+    const res = await fetch("/wordlist");
+    const data = await res.json();
+    state.stopwords = new Set(data.stopwords || []);
+  } catch (e) { /* validation degrades gracefully */ }
+}
+
+/* ---------------- clock websocket ---------------- */
+
+function connectClock() {
+  const proto = location.protocol === "https:" ? "wss:" : "ws:";
+  const ws = new WebSocket(`${proto}//${location.host}/clock`);
+  ws.onmessage = (ev) => {
+    const data = JSON.parse(ev.data);
+    const clock = $("clock");
+    clock.textContent = data.time;
+    const [mm, ss] = data.time.split(":").map(Number);
+    clock.classList.toggle("blink", mm * 60 + ss <= 60);
+    $("players").textContent = `${data.conns} online`;
+    if (data.reset) {
+      state.won = false;
+      $("win-banner").classList.add("hidden");
+      $("feedback").textContent = "";
+      fetchContents();
+    }
+  };
+  ws.onclose = () => setTimeout(connectClock, 2000);
+}
+
+/* ---------------- content rendering ---------------- */
+
+async function fetchContents() {
+  const res = await fetch("/fetch/contents", { credentials: "include" });
+  const data = await res.json();
+  $("round-image").src = `data:image/jpeg;base64,${data.image}`;
+  renderStory(data.story);
+  renderPrompt(data.prompt);
+  $("splash").classList.add("hidden");
+  $("game").classList.remove("hidden");
+}
+
+function renderStory(story) {
+  $("story-title").textContent = story.title || "";
+  $("episode").textContent = story.episode ? `episode ${story.episode}` : "";
+}
+
+function renderPrompt(prompt) {
+  const container = $("prompt");
+  container.innerHTML = "";
+  state.masks = prompt.masks.filter((m) => m >= 0);
+  state.scores = prompt.scores || {};
+  $("attempts").textContent = `attempts: ${prompt.attempts ?? 0}`;
+
+  const solved = new Set(prompt.correct || []);
+  const maskSet = new Set(state.masks);
+
+  prompt.tokens.forEach((token, idx) => {
+    if (maskSet.has(idx)) {
+      const box = document.createElement("span");
+      box.className = "mask-box";
+      const input = document.createElement("input");
+      input.type = "text";
+      input.maxLength = 24;
+      input.dataset.mask = idx;
+      input.placeholder = scoreHint(idx);
+      input.addEventListener("keydown", (ev) => {
+        if (ev.key === "Enter") submitGuesses();
+      });
+      box.appendChild(input);
+      container.appendChild(box);
+    } else {
+      const span = document.createElement("span");
+      span.textContent = token;
+      span.className = "token";
+      if (solved.has(idx)) span.classList.add("solved");
+      container.appendChild(span);
+    }
+    container.appendChild(document.createTextNode(" "));
+  });
+
+  if (state.won || prompt.masks.length === 0) {
+    $("win-banner").classList.toggle("hidden", !state.won);
+  }
+}
+
+function scoreHint(maskIdx) {
+  const s = parseFloat(state.scores[String(maskIdx)] || "0");
+  if (!s || s <= 0.1) return "guess…";
+  return `${Math.round(s * 100)}% close`;
+}
+
+/* ---------------- guessing ---------------- */
+
+function validGuess(word) {
+  if (!word) return "enter a word";
+  if (!/^[a-zA-Z][a-zA-Z'-]*$/.test(word)) return "letters only";
+  if (word.length < 2) return "too short";
+  if (state.stopwords.has(word.toLowerCase())) return "too common";
+  return null;
+}
+
+async function submitGuesses() {
+  if (state.submitting || state.won) return;
+  const inputs = {};
+  let error = null;
+  document.querySelectorAll("#prompt input").forEach((input) => {
+    const word = input.value.trim();
+    if (!word) return;
+    const problem = validGuess(word);
+    if (problem) { error = `"${word}": ${problem}`; return; }
+    inputs[input.dataset.mask] = word;
+  });
+  if (error) { $("feedback").textContent = error; return; }
+  if (Object.keys(inputs).length === 0) {
+    $("feedback").textContent = "type a guess first";
+    return;
+  }
+
+  state.submitting = true;
+  $("submit").disabled = true;
+  try {
+    const res = await fetch("/compute_score", {
+      method: "POST",
+      credentials: "include",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ inputs }),
+    });
+    const scores = await res.json();
+    state.won = scores.won === 1;
+    if (state.won) {
+      $("win-banner").classList.remove("hidden");
+      $("feedback").textContent = "";
+    } else {
+      const best = Math.max(
+        ...Object.entries(scores)
+          .filter(([k]) => k !== "won")
+          .map(([, v]) => parseFloat(v))
+      );
+      $("feedback").textContent =
+        best > 0.1 ? `${Math.round(best * 100)}% close — keep going`
+                   : "cold — try different words";
+    }
+    await fetchContents();
+  } finally {
+    state.submitting = false;
+    $("submit").disabled = false;
+  }
+}
+
+/* ---------------- consent ---------------- */
+
+function setupConsent() {
+  if (localStorage.getItem("cassmantle-consent")) return;
+  $("consent").classList.remove("hidden");
+  $("consent-ok").addEventListener("click", () => {
+    localStorage.setItem("cassmantle-consent", "1");
+    $("consent").classList.add("hidden");
+  });
+}
+
+/* ---------------- boot ---------------- */
+
+async function init() {
+  setupConsent();
+  $("submit").addEventListener("click", submitGuesses);
+  try {
+    await ensureSession();
+    await loadWordlist();
+    await fetchContents();
+    connectClock();
+  } catch (e) {
+    $("splash-status").textContent = "Server unavailable — retrying…";
+    setTimeout(init, 3000);
+  }
+}
+
+init();

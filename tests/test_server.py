@@ -1,0 +1,183 @@
+"""HTTP/WS API tests: full server over the fake backend (fast rounds)."""
+
+import asyncio
+import base64
+import dataclasses
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.engine.content import (
+    FakeContentBackend,
+    hash_embed,
+    hash_similarity,
+)
+from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.engine.store import MemoryStore
+from cassmantle_tpu.server.app import create_app
+
+
+def make_cfg(time_per_prompt=30.0, rate=1000.0):
+    cfg = _tiny_config()
+    return cfg.replace(game=dataclasses.replace(
+        cfg.game, time_per_prompt=time_per_prompt,
+        rate_limit_default=rate, rate_limit_api=rate,
+    ))
+
+
+async def make_client(cfg, start_timer=False):
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity)
+    app = create_app(game, cfg, start_timer=start_timer)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, game
+
+
+@pytest.mark.asyncio
+async def test_init_and_status_flow():
+    client, _ = await make_client(make_cfg())
+    try:
+        res = await client.get("/client/status")
+        assert (await res.json())["needInitialization"] is True
+
+        res = await client.get("/init")
+        data = await res.json()
+        assert "session_id" in data
+        assert "session_id" in res.cookies
+
+        res = await client.get("/client/status")
+        data = await res.json()
+        assert data == {"won": 0, "needInitialization": False}
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_fetch_contents_shape():
+    client, _ = await make_client(make_cfg())
+    try:
+        await client.get("/init")
+        res = await client.get("/fetch/contents")
+        data = await res.json()
+        assert set(data) == {"image", "prompt", "story"}
+        # image is valid base64 jpeg
+        raw = base64.b64decode(data["image"])
+        assert raw[:2] == b"\xff\xd8"
+        prompt = data["prompt"]
+        assert prompt["tokens"] and len(prompt["masks"]) == 2
+        for m in prompt["masks"]:
+            assert prompt["tokens"][m] == "*"
+        assert data["story"]["title"]
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_compute_score_and_win():
+    client, game = await make_client(make_cfg())
+    try:
+        await client.get("/init")
+        current = await game.rounds.fetch_current_prompt()
+        masks = current["masks"]
+
+        res = await client.post(
+            "/compute_score",
+            json={"inputs": {str(masks[0]): "zzzz"}},
+        )
+        scores = await res.json()
+        assert scores["won"] == 0
+
+        answers = {str(m): current["tokens"][m] for m in masks}
+        res = await client.post("/compute_score", json={"inputs": answers})
+        scores = await res.json()
+        assert scores["won"] == 1
+
+        res = await client.get("/fetch/contents")
+        prompt = (await res.json())["prompt"]
+        assert prompt["masks"] == []
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_compute_score_bad_body():
+    client, _ = await make_client(make_cfg())
+    try:
+        await client.get("/init")
+        res = await client.post("/compute_score", data=b"not json")
+        assert res.status == 400
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_clock_websocket_and_reset_flow():
+    cfg = make_cfg(time_per_prompt=2.0)
+    client, game = await make_client(cfg, start_timer=True)
+    try:
+        await client.get("/init")
+        ws = await client.ws_connect("/clock")
+        saw_reset = False
+        for _ in range(12):
+            msg = await asyncio.wait_for(ws.receive_json(), timeout=5.0)
+            assert set(msg) == {"time", "reset", "conns"}
+            assert ":" in msg["time"]
+            if msg["reset"]:
+                saw_reset = True
+                break
+        assert saw_reset, "round rollover never signalled reset"
+        await ws.close()
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_rate_limit_enforced():
+    cfg = make_cfg(rate=1000.0)
+    cfg = cfg.replace(game=dataclasses.replace(cfg.game, rate_limit_api=2.0))
+    client, _ = await make_client(cfg)
+    try:
+        statuses = []
+        for _ in range(8):
+            res = await client.get("/client/status")
+            statuses.append(res.status)
+        assert 429 in statuses
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_metrics_endpoint():
+    client, _ = await make_client(make_cfg())
+    try:
+        await client.get("/init")
+        res = await client.get("/metrics")
+        data = await res.json()
+        assert {"counters", "gauges", "timings"} <= set(data)
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_wordlist_endpoint():
+    client, _ = await make_client(make_cfg())
+    try:
+        res = await client.get("/wordlist")
+        data = await res.json()
+        assert "the" in data["stopwords"]
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_index_served():
+    client, _ = await make_client(make_cfg())
+    try:
+        res = await client.get("/")
+        assert res.status == 200
+        text = await res.text()
+        assert "CassMantle" in text
+    finally:
+        await client.close()
