@@ -34,6 +34,7 @@ from cassmantle_tpu.engine.masking import EmbedFn, build_prompt_state
 from cassmantle_tpu.engine.store import LockTimeout, StateStore
 from cassmantle_tpu.utils.codec import decode_jpeg, encode_jpeg
 from cassmantle_tpu.utils.logging import get_logger, metrics
+from cassmantle_tpu.utils.retry import linear_backoff, retry_async
 
 log = get_logger("rounds")
 
@@ -74,6 +75,8 @@ class RoundManager:
         episodes_per_story: int = 20,
         lock_timeout: float = 120.0,
         acquire_timeout: float = 2.0,
+        max_retries: int = 5,
+        retry_backoff_s: float = 2.0,
         rng: Optional[random.Random] = None,
         on_promote: Optional[Callable[[], object]] = None,
     ) -> None:
@@ -87,6 +90,8 @@ class RoundManager:
         self.episodes_per_story = episodes_per_story
         self.lock_timeout = lock_timeout
         self.acquire_timeout = acquire_timeout
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
         self.rng = rng or random.Random()
         # async callback run after each promotion (the game layer resets
         # sessions there, mirroring server.py:168).
@@ -114,6 +119,17 @@ class RoundManager:
             if prev is not None:
                 return False, prev.decode()
         return True, self.select_seed()
+
+    async def _generate(self, seed: str, is_seed: bool) -> RoundContent:
+        """Generation with regeneration-retry (reference retries failed API
+        calls ≤5x, utils.py:43-61; here failed device generations retry the
+        same way before the round falls back to a replay)."""
+        return await retry_async(
+            lambda: self.backend.generate(seed, is_seed),
+            max_retries=self.max_retries,
+            backoff=linear_backoff(self.retry_backoff_s),
+            name="generate",
+        )
 
     # -- content helpers --------------------------------------------------
     async def _store_content(self, slot: str, content: RoundContent) -> None:

@@ -1,0 +1,116 @@
+"""CLIP-similarity parity harness (BASELINE.md quality gate).
+
+Because RNG streams differ from any CUDA baseline, pixel-exact parity is
+impossible; the meaningful check (SURVEY.md §7 hard part (a)) is that
+generated images score comparably against their prompts under CLIP. This
+harness computes image-text CLIP similarity fully on-device:
+
+    sim = <normalize(vision(image))>, normalize(project(text(prompt)))>
+
+With real CLIP weights in ``weights_dir`` this is the true metric; with
+random init it still validates the plumbing end to end.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cassmantle_tpu.config import ClipTextConfig
+from cassmantle_tpu.models.clip_text import ClipTextEncoder
+from cassmantle_tpu.models.clip_vision import (
+    ClipVisionConfig,
+    ClipVisionEncoder,
+    preprocess_for_clip,
+)
+from cassmantle_tpu.models.weights import (
+    convert_clip_text,
+    init_params,
+    load_safetensors,
+    maybe_load,
+)
+from cassmantle_tpu.utils.tokenizers import load_tokenizer
+
+
+class ClipSimilarityHarness:
+    def __init__(
+        self,
+        text_cfg: Optional[ClipTextConfig] = None,
+        vision_cfg: Optional[ClipVisionConfig] = None,
+        weights_dir: Optional[str] = None,
+        pad_len: int = 77,
+    ) -> None:
+        self.text_cfg = text_cfg or ClipTextConfig()
+        self.vision_cfg = vision_cfg or ClipVisionConfig()
+        self.pad_len = min(pad_len, self.text_cfg.max_positions)
+        self.tokenizer = load_tokenizer(
+            weights_dir, "clip", self.text_cfg.vocab_size
+        )
+
+        self.text = ClipTextEncoder(self.text_cfg)
+        ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
+        self.text_params = (
+            maybe_load(weights_dir, "clip_text.safetensors",
+                       lambda t: convert_clip_text(
+                           t, self.text_cfg.num_layers),
+                       "clip_text")
+            or init_params(self.text, 11, ids)
+        )
+
+        self.vision = ClipVisionEncoder(self.vision_cfg)
+        img = jnp.zeros(
+            (1, self.vision_cfg.image_size, self.vision_cfg.image_size, 3)
+        )
+        self.vision_params = init_params(self.vision, 12, img)
+
+        # text projection into the shared space
+        rng = jax.random.PRNGKey(13)
+        self.text_projection = (
+            jax.random.normal(
+                rng,
+                (self.text_cfg.hidden_size, self.vision_cfg.projection_dim),
+            )
+            * 0.02
+        )
+        self._jit_sim = jax.jit(self._sim_impl)
+
+    def _tokenize(self, prompts: Sequence[str]) -> np.ndarray:
+        out = np.full((len(prompts), self.pad_len),
+                      self.tokenizer.pad_id, dtype=np.int32)
+        for i, p in enumerate(prompts):
+            toks = self.tokenizer.encode(p)[: self.pad_len - 1]
+            toks = toks + [self.tokenizer.eos_id]
+            out[i, : len(toks)] = (
+                np.asarray(toks) % self.text_cfg.vocab_size
+            )
+        return out
+
+    def _sim_impl(self, ids, images_u8):
+        pooled = self.text.apply(self.text_params, ids)["pooled"]
+        temb = pooled.astype(jnp.float32) @ self.text_projection
+        temb = temb / (jnp.linalg.norm(temb, axis=-1, keepdims=True) + 1e-8)
+        pre = preprocess_for_clip(images_u8, self.vision_cfg.image_size)
+        vemb = self.vision.apply(self.vision_params, pre)
+        return jnp.sum(temb * vemb, axis=-1)
+
+    def similarity(self, images_u8: np.ndarray,
+                   prompts: Sequence[str]) -> np.ndarray:
+        """(B,H,W,3) uint8 + B prompts -> (B,) CLIP similarities."""
+        ids = jnp.asarray(self._tokenize(prompts))
+        return np.asarray(self._jit_sim(ids, jnp.asarray(images_u8)))
+
+    def parity_report(self, images_u8, prompts,
+                      baseline_mean: Optional[float] = None) -> dict:
+        sims = self.similarity(images_u8, prompts)
+        report = {
+            "clip_sim_mean": float(np.mean(sims)),
+            "clip_sim_std": float(np.std(sims)),
+            "n": int(len(sims)),
+        }
+        if baseline_mean is not None:
+            report["baseline_mean"] = float(baseline_mean)
+            report["parity_ratio"] = float(np.mean(sims) / baseline_mean)
+        return report

@@ -1,0 +1,119 @@
+"""CLIP vision tower (ViT) — for the CLIP-similarity parity harness.
+
+BASELINE.md's quality gate is "CLIP-similarity parity vs the CUDA
+baseline": score each generated image against its prompt with CLIP and
+compare distributions. That needs the image side of CLIP locally; this is
+the standard ViT with class token, pre-LN blocks, and a projection to the
+shared text-image embedding space. Weights load from transformers-style
+safetensors (``convert_clip_vision``); random-init otherwise (the harness
+then still validates plumbing, not quality).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cassmantle_tpu.models.layers import (
+    MultiHeadAttention,
+    TransformerMLP,
+    quick_gelu,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipVisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    projection_dim: int = 768
+    dtype: str = "float32"
+
+    @staticmethod
+    def tiny() -> "ClipVisionConfig":
+        return ClipVisionConfig(
+            image_size=32, patch_size=8, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=4,
+            projection_dim=64,
+        )
+
+
+class ClipVisionBlock(nn.Module):
+    cfg: ClipVisionConfig
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + MultiHeadAttention(
+            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+        )(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        x = x + TransformerMLP(
+            intermediate=self.cfg.intermediate_size,
+            activation=quick_gelu, dtype=self.dtype, name="mlp",
+        )(h)
+        return x
+
+
+class ClipVisionEncoder(nn.Module):
+    cfg: ClipVisionConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        """(B, H, W, 3) images normalized to CLIP stats -> (B, P) unit
+        embeddings in the shared text-image space."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        b = images.shape[0]
+        x = nn.Conv(
+            cfg.hidden_size,
+            (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            use_bias=False, dtype=dtype, name="patch_embed",
+        )(images.astype(dtype))
+        x = x.reshape(b, -1, cfg.hidden_size)
+        cls = self.param(
+            "class_embedding", nn.initializers.normal(0.02),
+            (cfg.hidden_size,),
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.hidden_size)).astype(dtype), x],
+            axis=1,
+        )
+        n_pos = x.shape[1]
+        pos = self.param(
+            "position_embedding", nn.initializers.normal(0.02),
+            (n_pos, cfg.hidden_size),
+        )
+        x = x + pos[None].astype(dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, name="pre_ln")(x)
+        for i in range(cfg.num_layers):
+            x = ClipVisionBlock(cfg, dtype, name=f"block_{i}")(x)
+        pooled = nn.LayerNorm(dtype=jnp.float32, name="post_ln")(x[:, 0])
+        proj = self.param(
+            "projection", nn.initializers.normal(0.02),
+            (cfg.hidden_size, cfg.projection_dim),
+        )
+        emb = pooled @ proj.astype(jnp.float32)
+        return emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-8)
+
+
+CLIP_IMAGE_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_IMAGE_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def preprocess_for_clip(images_u8: jax.Array, size: int = 224) -> jax.Array:
+    """uint8 (B, H, W, 3) -> resized, CLIP-normalized float32."""
+    x = images_u8.astype(jnp.float32) / 255.0
+    b, h, w, c = x.shape
+    x = jax.image.resize(x, (b, size, size, c), "bilinear")
+    mean = jnp.asarray(CLIP_IMAGE_MEAN)
+    std = jnp.asarray(CLIP_IMAGE_STD)
+    return (x - mean) / std

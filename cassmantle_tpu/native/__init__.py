@@ -1,0 +1,1 @@
+from cassmantle_tpu.native.client import MantleStore, ensure_built, spawn_server  # noqa: F401

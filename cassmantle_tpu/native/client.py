@@ -1,0 +1,221 @@
+"""Asyncio client for mantlestore (the native C++ state store).
+
+Implements the same :class:`StateStore` contract as MemoryStore, so the
+game engine can run multi-process: N server workers (like the reference's
+multi-worker uvicorn) share one mantlestore exactly as the reference's
+workers share one Redis (SURVEY.md §5.8). The wire protocol is a RESP2
+subset; blocking lock acquisition is client-side polling against the
+server's atomic LOCK/UNLOCK (token + TTL, self-expiring on holder crash).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import subprocess
+import time
+import uuid
+from typing import AsyncIterator, Dict, Optional, Set
+
+from cassmantle_tpu.engine.store import LockTimeout, StateStore, Value
+from cassmantle_tpu.utils.logging import get_logger
+
+log = get_logger("native.store")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+BINARY = os.path.join(NATIVE_DIR, "build", "mantlestore")
+
+
+def ensure_built() -> Optional[str]:
+    """Build the server if needed; returns binary path or None."""
+    if os.path.exists(BINARY):
+        return BINARY
+    try:
+        subprocess.run(
+            ["sh", os.path.join(NATIVE_DIR, "build.sh")],
+            check=True, capture_output=True, timeout=120,
+        )
+        return BINARY if os.path.exists(BINARY) else None
+    except Exception as exc:  # no toolchain: callers fall back to memory
+        log.warning("mantlestore build failed: %s", exc)
+        return None
+
+
+def spawn_server(port: int = 7070) -> subprocess.Popen:
+    binary = ensure_built()
+    assert binary, "mantlestore binary unavailable"
+    proc = subprocess.Popen(
+        [binary, str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    # wait for the listening line
+    line = proc.stderr.readline().decode()
+    assert "listening" in line, line
+    return proc
+
+
+def _b(v: Value) -> bytes:
+    return v if isinstance(v, bytes) else str(v).encode()
+
+
+class MantleStore(StateStore):
+    def __init__(self, host: str = "127.0.0.1", port: int = 7070) -> None:
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._io_lock = asyncio.Lock()
+
+    async def connect(self) -> "MantleStore":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        assert await self._cmd(b"PING") == b"PONG"
+        return self
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            with contextlib.suppress(Exception):
+                await self._writer.wait_closed()
+            self._writer = None
+            self._reader = None
+
+    # -- protocol ---------------------------------------------------------
+    async def _cmd(self, *args: bytes):
+        if self._writer is None:
+            await self.connect()
+        async with self._io_lock:
+            payload = b"*%d\r\n" % len(args)
+            for a in args:
+                payload += b"$%d\r\n%s\r\n" % (len(a), a)
+            self._writer.write(payload)
+            await self._writer.drain()
+            return await self._read_reply()
+
+    async def _read_reply(self):
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionError("mantlestore closed connection")
+        kind, rest = line[:1], line[1:].strip()
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise RuntimeError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = await self._reader.readexactly(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            return [await self._read_reply() for _ in range(int(rest))]
+        raise RuntimeError(f"bad reply kind {kind!r}")
+
+    # -- plain keys -------------------------------------------------------
+    async def set(self, key, value):
+        await self._cmd(b"SET", key.encode(), _b(value))
+
+    async def get(self, key):
+        return await self._cmd(b"GET", key.encode())
+
+    async def setex(self, key, ttl, value):
+        await self._cmd(b"SETEX", key.encode(),
+                        str(int(ttl * 1000)).encode(), _b(value))
+
+    async def delete(self, *keys):
+        if keys:
+            await self._cmd(b"DEL", *[k.encode() for k in keys])
+
+    async def exists(self, key):
+        return bool(await self._cmd(b"EXISTS", key.encode()))
+
+    async def expire(self, key, ttl):
+        await self._cmd(b"PEXPIRE", key.encode(),
+                        str(int(ttl * 1000)).encode())
+
+    async def ttl(self, key):
+        ms = await self._cmd(b"PTTL", key.encode())
+        if ms in (-1, -2):
+            return float(ms)
+        return ms / 1000.0
+
+    # -- hashes -----------------------------------------------------------
+    async def hset(self, key, field=None, value=None, mapping=None):
+        args = [b"HSET", key.encode()]
+        if field is not None:
+            args += [field.encode(), _b(value)]
+        if mapping:
+            for k, v in mapping.items():
+                args += [k.encode(), _b(v)]
+        if len(args) > 2:
+            await self._cmd(*args)
+
+    async def hget(self, key, field):
+        return await self._cmd(b"HGET", key.encode(), field.encode())
+
+    async def hgetall(self, key) -> Dict[str, bytes]:
+        flat = await self._cmd(b"HGETALL", key.encode())
+        return {
+            flat[i].decode(): flat[i + 1] for i in range(0, len(flat), 2)
+        }
+
+    async def hdel(self, key, *fields):
+        if fields:
+            await self._cmd(b"HDEL", key.encode(),
+                            *[f.encode() for f in fields])
+
+    async def hincrby(self, key, field, amount: int = 1) -> int:
+        return await self._cmd(b"HINCRBY", key.encode(), field.encode(),
+                               str(amount).encode())
+
+    # -- sets -------------------------------------------------------------
+    async def sadd(self, key, *members):
+        if members:
+            await self._cmd(b"SADD", key.encode(),
+                            *[m.encode() for m in members])
+
+    async def srem(self, key, *members):
+        if members:
+            await self._cmd(b"SREM", key.encode(),
+                            *[m.encode() for m in members])
+
+    async def smembers(self, key) -> Set[str]:
+        return {m.decode() for m in await self._cmd(b"SMEMBERS",
+                                                    key.encode())}
+
+    async def sismember(self, key, member) -> bool:
+        return bool(await self._cmd(b"SISMEMBER", key.encode(),
+                                    member.encode()))
+
+    # -- locks ------------------------------------------------------------
+    @contextlib.asynccontextmanager
+    async def lock(self, name: str, timeout: float = 120.0,
+                   blocking_timeout: float = 2.0) -> AsyncIterator[None]:
+        token = uuid.uuid4().hex.encode()
+        deadline = time.monotonic() + blocking_timeout
+        ttl_ms = str(int(timeout * 1000)).encode()
+        acquired = False
+        while True:
+            reply = await self._cmd(b"LOCK", name.encode(), token, ttl_ms)
+            if reply == b"OK":
+                acquired = True
+                break
+            if time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.05)
+        if not acquired:
+            raise LockTimeout(name)
+        try:
+            yield
+        finally:
+            with contextlib.suppress(Exception):
+                await self._cmd(b"UNLOCK", name.encode(), token)
+
+    async def flushall(self) -> None:
+        await self._cmd(b"FLUSHALL")

@@ -15,11 +15,27 @@ Dispatch policy:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# When set, every attention site uses the plain XLA path — used when
+# tracing for a non-TPU device (e.g. CPU-side param init) while the default
+# backend is TPU.
+_FORCE_XLA = contextvars.ContextVar("cassmantle_force_xla", default=False)
+
+
+@contextlib.contextmanager
+def xla_only():
+    token = _FORCE_XLA.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_XLA.reset(token)
 
 
 def _on_tpu() -> bool:
@@ -65,6 +81,8 @@ def multi_head_attention(
 
     Shapes: q (..., Sq, H, D); k, v (..., Sk, H, D); returns (..., Sq, H, D).
     """
+    if _FORCE_XLA.get():
+        use_flash = False
     if use_flash is None:
         use_flash = _on_tpu() and mask is None
     if use_flash and mask is None:

@@ -19,7 +19,15 @@ import numpy as np
 
 from cassmantle_tpu.config import MiniLMConfig
 from cassmantle_tpu.models.minilm import MiniLMEncoder
-from cassmantle_tpu.models.weights import init_params, maybe_load, convert_minilm
+from cassmantle_tpu.models.weights import (
+    convert_minilm,
+    init_params_cached,
+    maybe_load,
+)
+from cassmantle_tpu.utils.compile_cache import (
+    enable_compile_cache,
+    param_cache_path,
+)
 from cassmantle_tpu.utils.logging import metrics
 from cassmantle_tpu.utils.tokenizers import Tokenizer, load_tokenizer
 
@@ -50,11 +58,14 @@ class EmbeddingScorer:
         model = MiniLMEncoder(cfg)
         sample_ids = jnp.zeros((1, self.seq_len), dtype=jnp.int32)
         sample_mask = jnp.ones((1, self.seq_len), dtype=jnp.int32)
+        enable_compile_cache()
         self.params = (
             maybe_load(weights_dir, "minilm.safetensors",
                        lambda t: convert_minilm(t, cfg.num_layers),
                        "minilm")
-            or init_params(model, 7, sample_ids, sample_mask)
+            or init_params_cached(
+                model, 7, sample_ids, sample_mask,
+                cache_path=param_cache_path("minilm", cfg))
         )
         self._encode = jax.jit(model.apply)
 

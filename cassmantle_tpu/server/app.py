@@ -230,11 +230,23 @@ def create_app(game: Game, cfg: FrameworkConfig,
 
 
 def build_game(cfg: FrameworkConfig, fake: bool = False,
-               weights_dir: Optional[str] = None) -> Game:
-    """Assemble a Game with real TPU serving or the fake backend."""
+               weights_dir: Optional[str] = None,
+               store_addr: Optional[str] = None) -> Game:
+    """Assemble a Game with real TPU serving or the fake backend.
+
+    ``store_addr`` like ``"native:7070"`` connects to a shared mantlestore
+    (multi-worker deployments, one store per host like the reference's
+    Redis); default is the in-process MemoryStore.
+    """
     from cassmantle_tpu.engine.store import MemoryStore
 
-    store = MemoryStore()
+    if store_addr and store_addr.startswith("native"):
+        from cassmantle_tpu.native.client import MantleStore
+
+        port = int(store_addr.split(":")[1]) if ":" in store_addr else 7070
+        store = MantleStore(port=port)
+    else:
+        store = MemoryStore()
     if fake:
         from cassmantle_tpu.engine.content import (
             FakeContentBackend,
@@ -266,6 +278,9 @@ def main() -> None:
     parser.add_argument("--weights", default=None,
                         help="safetensors checkpoint directory")
     parser.add_argument("--round-seconds", type=float, default=None)
+    parser.add_argument("--store", default=None,
+                        help="'native[:port]' = shared C++ mantlestore "
+                             "(spawn with native/build/mantlestore)")
     args = parser.parse_args()
 
     cfg = FrameworkConfig()
@@ -276,7 +291,8 @@ def main() -> None:
             game=dataclasses.replace(cfg.game,
                                      time_per_prompt=args.round_seconds)
         )
-    game = build_game(cfg, fake=args.fake, weights_dir=args.weights)
+    game = build_game(cfg, fake=args.fake, weights_dir=args.weights,
+                      store_addr=args.store)
     web.run_app(create_app(game, cfg), host=args.host, port=args.port)
 
 

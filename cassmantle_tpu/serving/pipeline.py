@@ -30,8 +30,12 @@ from cassmantle_tpu.models.weights import (
     convert_gpt2,
     convert_unet,
     convert_vae_decoder,
-    init_params,
+    init_params_cached,
     maybe_load,
+)
+from cassmantle_tpu.utils.compile_cache import (
+    enable_compile_cache,
+    param_cache_path,
 )
 from cassmantle_tpu.ops.ddim import (
     DDIMSchedule,
@@ -52,6 +56,7 @@ class Text2ImagePipeline:
 
     def __init__(self, cfg: FrameworkConfig,
                  weights_dir: Optional[str] = None) -> None:
+        enable_compile_cache()
         m = cfg.models
         self.cfg = cfg
         self.clip = ClipTextEncoder(m.clip_text)
@@ -70,7 +75,9 @@ class Text2ImagePipeline:
             maybe_load(weights_dir, "clip_text.safetensors",
                        lambda t: convert_clip_text(t, m.clip_text.num_layers),
                        "clip_text")
-            or init_params(self.clip, 1, ids)
+            or init_params_cached(
+                self.clip, 1, ids,
+                cache_path=param_cache_path("clip_text", m.clip_text))
         )
         lat_hw = cfg.sampler.image_size // self.vae_scale
         lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
@@ -80,12 +87,17 @@ class Text2ImagePipeline:
         self.unet_params = (
             maybe_load(weights_dir, "unet.safetensors",
                        lambda t: convert_unet(t, m.unet), "unet")
-            or init_params(self.unet, 2, lat, t0, ctx)
+            or init_params_cached(
+                self.unet, 2, lat, t0, ctx,
+                cache_path=param_cache_path("unet", m.unet))
         )
         self.vae_params = (
             maybe_load(weights_dir, "vae.safetensors",
                        lambda t: convert_vae_decoder(t, m.vae), "vae")
-            or init_params(self.vae, 3, lat)
+            or init_params_cached(
+                self.vae, 3, lat,
+                cache_path=param_cache_path(
+                    f"vae{cfg.sampler.image_size}", m.vae))
         )
         self.schedule = DDIMSchedule.create(cfg.sampler.num_steps)
         self._sample = jax.jit(self._sample_impl)
@@ -137,6 +149,7 @@ class PromptGenerator:
 
     def __init__(self, cfg: FrameworkConfig,
                  weights_dir: Optional[str] = None) -> None:
+        enable_compile_cache()
         m = cfg.models.gpt2
         self.cfg = cfg
         self.model = GPT2LM(m)
@@ -146,7 +159,9 @@ class PromptGenerator:
             maybe_load(weights_dir, "gpt2.safetensors",
                        lambda t: convert_gpt2(t, m.num_layers, m.hidden_size),
                        "gpt2")
-            or init_params(self.model, 5, ids)
+            or init_params_cached(
+                self.model, 5, ids,
+                cache_path=param_cache_path("gpt2", m))
         )
         self._prefill = lambda ids_, len_, max_len: self.model.apply(
             self.params, ids_, len_, max_len, method=GPT2LM.prefill

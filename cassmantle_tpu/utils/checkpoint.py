@@ -1,0 +1,66 @@
+"""Checkpoint/resume (SURVEY.md §5.4).
+
+Two planes, mirroring the reference's split:
+
+- **Game state** resumes through the state store's durability
+  (MemoryStore.snapshot/restore here; Redis persistence in the reference —
+  a worker restart re-attaches to the in-flight round, backend.py:93-97).
+- **Model/training state** checkpoints via orbax: params + optimizer state
+  + step counter, with atomic versioned directories and resume-latest.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from cassmantle_tpu.utils.logging import get_logger
+
+log = get_logger("checkpoint")
+
+
+class TrainCheckpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, params: Any, opt_state: Any,
+             extra: Optional[dict] = None) -> None:
+        import orbax.checkpoint as ocp
+
+        payload = {"params": params, "opt_state": opt_state}
+        if extra:
+            payload["extra"] = extra
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        self._mgr.wait_until_finished()
+        log.info("saved checkpoint step=%d to %s", step, self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[dict] = None) -> Optional[dict]:
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        if template is not None:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        else:
+            restored = self._mgr.restore(step)
+        log.info("restored checkpoint step=%d", step)
+        return restored
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,43 @@
+"""Persistent XLA compile cache + big-model param cache locations.
+
+First XLA compiles of the production models are expensive (tens of seconds
+locally, minutes through a tunneled device); both the serving pipelines and
+bench enable the on-disk compile cache so every later process reuses them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+COMPILE_CACHE_DIR = os.environ.get(
+    "CASSMANTLE_COMPILE_CACHE", os.path.join(_REPO_ROOT, ".jax_cache")
+)
+PARAM_CACHE_DIR = os.environ.get(
+    "CASSMANTLE_PARAM_CACHE", os.path.join(_REPO_ROOT, ".param_cache")
+)
+
+_enabled = False
+
+
+def enable_compile_cache() -> None:
+    global _enabled
+    if _enabled:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _enabled = True
+    except Exception:  # older jax / unsupported backend: not fatal
+        pass
+
+
+def param_cache_path(name: str, cfg) -> str:
+    """Stable cache file name for (model name, config)."""
+    digest = hashlib.sha256(repr(cfg).encode()).hexdigest()[:16]
+    return os.path.join(PARAM_CACHE_DIR, f"{name}-{digest}.safetensors")

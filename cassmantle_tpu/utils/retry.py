@@ -1,0 +1,54 @@
+"""Generic async retry with backoff.
+
+The reference's only fault-handling primitive is ``api_call``'s retry-on-503
+with linear backoff (utils.py:32-72, ≤5 tries, (k+1)·10 s). The framework
+keeps the same envelope but generalizes it: any async operation (content
+generation, store I/O) can be wrapped, with injectable sleep for tests and
+a backoff schedule matching the reference's default.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional, Tuple, Type, TypeVar
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+T = TypeVar("T")
+log = get_logger("retry")
+
+
+def linear_backoff(base_s: float = 10.0):
+    """Reference schedule: (attempt+1) * base seconds (utils.py:61)."""
+
+    def schedule(attempt: int) -> float:
+        return (attempt + 1) * base_s
+
+    return schedule
+
+
+async def retry_async(
+    op: Callable[[], Awaitable[T]],
+    *,
+    max_retries: int = 5,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    backoff: Optional[Callable[[int], float]] = None,
+    sleep: Callable[[float], Awaitable[None]] = asyncio.sleep,
+    name: str = "op",
+) -> T:
+    """Run ``op`` with up to ``max_retries`` attempts; re-raises the last
+    failure (callers keep skip-don't-crash semantics at their level)."""
+    backoff = backoff or linear_backoff()
+    last: Optional[BaseException] = None
+    for attempt in range(max_retries):
+        try:
+            return await op()
+        except retry_on as exc:  # noqa: PERF203
+            last = exc
+            metrics.inc(f"retry.{name}.failures")
+            log.warning("%s attempt %d/%d failed: %s",
+                        name, attempt + 1, max_retries, exc)
+            if attempt + 1 < max_retries:
+                await sleep(backoff(attempt))
+    assert last is not None
+    raise last

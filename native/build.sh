@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build mantlestore into native/build/.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+g++ -O2 -std=c++17 -Wall -o build/mantlestore mantlestore.cc
+echo "built native/build/mantlestore"

@@ -1,0 +1,458 @@
+// mantlestore — native state store for cassmantle_tpu.
+//
+// The reference outsources ALL shared state to a Redis server
+// (SURVEY.md §1 L0: sessions, round content, the countdown-as-TTL clock,
+// and the startup/buffer/promotion locks). This is the framework's native
+// equivalent: a single-threaded epoll TCP server speaking a RESP2 subset,
+// implementing exactly the operations the game engine's StateStore
+// contract needs — strings with TTL, hashes, sets, and expiring locks.
+//
+// Design notes:
+// - single-threaded event loop: every command is atomic by construction,
+//   which is the property the engine's double-buffer/promotion logic
+//   relies on (no torn read-modify-write between workers).
+// - TTLs use the steady clock, checked lazily on access plus a periodic
+//   sweep, mirroring redis semantics (TTL -> -2 missing, -1 no expiry).
+// - locks are (token, deadline) pairs: LOCK name token ttl_ms -> +OK or
+//   +BUSY; a crashed holder's lock self-expires. Blocking acquisition is
+//   client-side (the engine polls with its acquire timeout).
+//
+// Build: g++ -O2 -std=c++17 -o mantlestore mantlestore.cc
+// Run:   ./mantlestore [port]   (default 7070, localhost only)
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+
+static double now_s() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Store
+// ---------------------------------------------------------------------------
+
+struct Entry {
+  enum Kind { STRING, HASH, SET } kind = STRING;
+  std::string str;
+  std::unordered_map<std::string, std::string> hash;
+  std::unordered_set<std::string> set;
+  double deadline = -1.0;  // -1 = no expiry
+};
+
+struct LockEntry {
+  std::string token;
+  double deadline;
+};
+
+class Store {
+ public:
+  bool alive(const std::string& key) {
+    auto it = data_.find(key);
+    if (it == data_.end()) return false;
+    if (it->second.deadline >= 0 && now_s() >= it->second.deadline) {
+      data_.erase(it);
+      return false;
+    }
+    return true;
+  }
+
+  Entry* get(const std::string& key) {
+    return alive(key) ? &data_[key] : nullptr;
+  }
+
+  Entry& upsert(const std::string& key, Entry::Kind kind) {
+    if (!alive(key)) {
+      Entry e;
+      e.kind = kind;
+      data_[key] = std::move(e);
+    }
+    return data_[key];
+  }
+
+  void erase(const std::string& key) { data_.erase(key); }
+
+  void sweep() {
+    double t = now_s();
+    for (auto it = data_.begin(); it != data_.end();) {
+      if (it->second.deadline >= 0 && t >= it->second.deadline)
+        it = data_.erase(it);
+      else
+        ++it;
+    }
+    for (auto it = locks_.begin(); it != locks_.end();) {
+      if (t >= it->second.deadline)
+        it = locks_.erase(it);
+      else
+        ++it;
+    }
+  }
+
+  std::unordered_map<std::string, Entry> data_;
+  std::unordered_map<std::string, LockEntry> locks_;
+};
+
+// ---------------------------------------------------------------------------
+// RESP protocol
+// ---------------------------------------------------------------------------
+
+static void resp_simple(std::string& out, const char* s) {
+  out += '+';
+  out += s;
+  out += "\r\n";
+}
+
+static void resp_error(std::string& out, const char* s) {
+  out += '-';
+  out += s;
+  out += "\r\n";
+}
+
+static void resp_int(std::string& out, long long v) {
+  out += ':';
+  out += std::to_string(v);
+  out += "\r\n";
+}
+
+static void resp_bulk(std::string& out, const std::string& v) {
+  out += '$';
+  out += std::to_string(v.size());
+  out += "\r\n";
+  out += v;
+  out += "\r\n";
+}
+
+static void resp_nil(std::string& out) { out += "$-1\r\n"; }
+
+static void resp_array_header(std::string& out, size_t n) {
+  out += '*';
+  out += std::to_string(n);
+  out += "\r\n";
+}
+
+// Parse one RESP array-of-bulk-strings command from buf starting at pos.
+// Returns true + advances pos when a full command was parsed.
+static bool parse_command(const std::string& buf, size_t& pos,
+                          std::vector<std::string>& argv) {
+  argv.clear();
+  size_t p = pos;
+  if (p >= buf.size() || buf[p] != '*') return false;
+  size_t eol = buf.find("\r\n", p);
+  if (eol == std::string::npos) return false;
+  long n = strtol(buf.c_str() + p + 1, nullptr, 10);
+  if (n < 0 || n > 1024) return false;
+  p = eol + 2;
+  for (long i = 0; i < n; i++) {
+    if (p >= buf.size() || buf[p] != '$') return false;
+    eol = buf.find("\r\n", p);
+    if (eol == std::string::npos) return false;
+    long len = strtol(buf.c_str() + p + 1, nullptr, 10);
+    if (len < 0 || len > (64 << 20)) return false;
+    p = eol + 2;
+    if (buf.size() < p + (size_t)len + 2) return false;
+    argv.emplace_back(buf, p, len);
+    p += len + 2;
+  }
+  pos = p;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Command dispatch
+// ---------------------------------------------------------------------------
+
+static void execute(Store& store, const std::vector<std::string>& argv,
+                    std::string& out) {
+  if (argv.empty()) {
+    resp_error(out, "ERR empty command");
+    return;
+  }
+  std::string cmd = argv[0];
+  for (auto& c : cmd) c = toupper(c);
+
+  if (cmd == "PING") {
+    resp_simple(out, "PONG");
+  } else if (cmd == "SET" && argv.size() == 3) {
+    Entry& e = store.upsert(argv[1], Entry::STRING);
+    e.kind = Entry::STRING;
+    e.str = argv[2];
+    e.deadline = -1;
+    resp_simple(out, "OK");
+  } else if (cmd == "SETEX" && argv.size() == 4) {
+    // SETEX key ttl_ms value  (milliseconds for sub-second test clocks)
+    Entry& e = store.upsert(argv[1], Entry::STRING);
+    e.kind = Entry::STRING;
+    e.str = argv[3];
+    e.deadline = now_s() + strtod(argv[2].c_str(), nullptr) / 1000.0;
+    resp_simple(out, "OK");
+  } else if (cmd == "GET" && argv.size() == 2) {
+    Entry* e = store.get(argv[1]);
+    if (e && e->kind == Entry::STRING)
+      resp_bulk(out, e->str);
+    else
+      resp_nil(out);
+  } else if (cmd == "DEL" && argv.size() >= 2) {
+    long long n = 0;
+    for (size_t i = 1; i < argv.size(); i++) {
+      if (store.alive(argv[i])) n++;
+      store.erase(argv[i]);
+    }
+    resp_int(out, n);
+  } else if (cmd == "EXISTS" && argv.size() == 2) {
+    resp_int(out, store.alive(argv[1]) ? 1 : 0);
+  } else if (cmd == "PEXPIRE" && argv.size() == 3) {
+    Entry* e = store.get(argv[1]);
+    if (e) {
+      e->deadline = now_s() + strtod(argv[2].c_str(), nullptr) / 1000.0;
+      resp_int(out, 1);
+    } else {
+      resp_int(out, 0);
+    }
+  } else if (cmd == "PTTL" && argv.size() == 2) {
+    Entry* e = store.get(argv[1]);
+    if (!e)
+      resp_int(out, -2);
+    else if (e->deadline < 0)
+      resp_int(out, -1);
+    else
+      resp_int(out, (long long)((e->deadline - now_s()) * 1000.0));
+  } else if (cmd == "HSET" && argv.size() >= 4 && argv.size() % 2 == 0) {
+    Entry& e = store.upsert(argv[1], Entry::HASH);
+    long long added = 0;
+    for (size_t i = 2; i + 1 < argv.size(); i += 2) {
+      added += e.hash.count(argv[i]) ? 0 : 1;
+      e.hash[argv[i]] = argv[i + 1];
+    }
+    resp_int(out, added);
+  } else if (cmd == "HGET" && argv.size() == 3) {
+    Entry* e = store.get(argv[1]);
+    if (e && e->kind == Entry::HASH) {
+      auto it = e->hash.find(argv[2]);
+      if (it != e->hash.end()) {
+        resp_bulk(out, it->second);
+        return;
+      }
+    }
+    resp_nil(out);
+  } else if (cmd == "HGETALL" && argv.size() == 2) {
+    Entry* e = store.get(argv[1]);
+    if (e && e->kind == Entry::HASH) {
+      resp_array_header(out, e->hash.size() * 2);
+      for (auto& kv : e->hash) {
+        resp_bulk(out, kv.first);
+        resp_bulk(out, kv.second);
+      }
+    } else {
+      resp_array_header(out, 0);
+    }
+  } else if (cmd == "HDEL" && argv.size() >= 3) {
+    Entry* e = store.get(argv[1]);
+    long long n = 0;
+    if (e && e->kind == Entry::HASH)
+      for (size_t i = 2; i < argv.size(); i++) n += e->hash.erase(argv[i]);
+    resp_int(out, n);
+  } else if (cmd == "HINCRBY" && argv.size() == 4) {
+    Entry& e = store.upsert(argv[1], Entry::HASH);
+    long long v = 0;
+    auto it = e.hash.find(argv[2]);
+    if (it != e.hash.end()) v = strtoll(it->second.c_str(), nullptr, 10);
+    v += strtoll(argv[3].c_str(), nullptr, 10);
+    e.hash[argv[2]] = std::to_string(v);
+    resp_int(out, v);
+  } else if (cmd == "SADD" && argv.size() >= 3) {
+    Entry& e = store.upsert(argv[1], Entry::SET);
+    long long n = 0;
+    for (size_t i = 2; i < argv.size(); i++)
+      n += e.set.insert(argv[i]).second ? 1 : 0;
+    resp_int(out, n);
+  } else if (cmd == "SREM" && argv.size() >= 3) {
+    Entry* e = store.get(argv[1]);
+    long long n = 0;
+    if (e && e->kind == Entry::SET)
+      for (size_t i = 2; i < argv.size(); i++) n += e->set.erase(argv[i]);
+    resp_int(out, n);
+  } else if (cmd == "SMEMBERS" && argv.size() == 2) {
+    Entry* e = store.get(argv[1]);
+    if (e && e->kind == Entry::SET) {
+      resp_array_header(out, e->set.size());
+      for (auto& m : e->set) resp_bulk(out, m);
+    } else {
+      resp_array_header(out, 0);
+    }
+  } else if (cmd == "SISMEMBER" && argv.size() == 3) {
+    Entry* e = store.get(argv[1]);
+    resp_int(out,
+             (e && e->kind == Entry::SET && e->set.count(argv[2])) ? 1 : 0);
+  } else if (cmd == "LOCK" && argv.size() == 4) {
+    // LOCK name token ttl_ms -> +OK acquired | +BUSY held by other
+    auto it = store.locks_.find(argv[1]);
+    if (it != store.locks_.end() && now_s() < it->second.deadline &&
+        it->second.token != argv[2]) {
+      resp_simple(out, "BUSY");
+    } else {
+      store.locks_[argv[1]] = {
+          argv[2], now_s() + strtod(argv[3].c_str(), nullptr) / 1000.0};
+      resp_simple(out, "OK");
+    }
+  } else if (cmd == "UNLOCK" && argv.size() == 3) {
+    // UNLOCK name token -> :1 released | :0 not held by this token
+    auto it = store.locks_.find(argv[1]);
+    if (it != store.locks_.end() && it->second.token == argv[2]) {
+      store.locks_.erase(it);
+      resp_int(out, 1);
+    } else {
+      resp_int(out, 0);
+    }
+  } else if (cmd == "FLUSHALL" && argv.size() == 1) {
+    store.data_.clear();
+    store.locks_.clear();
+    resp_simple(out, "OK");
+  } else {
+    resp_error(out, "ERR unknown command");
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Event loop
+// ---------------------------------------------------------------------------
+
+struct Conn {
+  int fd;
+  std::string in;
+  std::string out;
+  size_t out_off = 0;
+};
+
+static int set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 7070;
+  signal(SIGPIPE, SIG_IGN);
+
+  int listener = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(listener, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(listener, 128);
+  set_nonblock(listener);
+
+  int ep = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = listener;
+  epoll_ctl(ep, EPOLL_CTL_ADD, listener, &ev);
+
+  Store store;
+  std::unordered_map<int, Conn> conns;
+  std::vector<std::string> cmd_args;
+  double last_sweep = now_s();
+
+  fprintf(stderr, "mantlestore listening on 127.0.0.1:%d\n", port);
+  fflush(stderr);
+
+  epoll_event events[64];
+  for (;;) {
+    int n = epoll_wait(ep, events, 64, 250);
+    if (now_s() - last_sweep > 1.0) {
+      store.sweep();
+      last_sweep = now_s();
+    }
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      if (fd == listener) {
+        for (;;) {
+          int cfd = accept(listener, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.fd = cfd;
+          epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev);
+          conns[cfd] = Conn{cfd};
+        }
+        continue;
+      }
+      auto cit = conns.find(fd);
+      if (cit == conns.end()) continue;
+      Conn& conn = cit->second;
+      bool closed = false;
+
+      if (events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) {
+        char buf[65536];
+        for (;;) {
+          ssize_t r = read(fd, buf, sizeof(buf));
+          if (r > 0) {
+            conn.in.append(buf, r);
+          } else if (r == 0) {
+            closed = true;
+            break;
+          } else {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            closed = true;
+            break;
+          }
+        }
+        size_t pos = 0;
+        while (parse_command(conn.in, pos, cmd_args))
+          execute(store, cmd_args, conn.out);
+        if (pos > 0) conn.in.erase(0, pos);
+        if (conn.in.size() > (64u << 20)) closed = true;  // abuse guard
+      }
+
+      if (!closed && !conn.out.empty()) {
+        ssize_t w = write(fd, conn.out.data() + conn.out_off,
+                          conn.out.size() - conn.out_off);
+        if (w > 0) {
+          conn.out_off += w;
+          if (conn.out_off == conn.out.size()) {
+            conn.out.clear();
+            conn.out_off = 0;
+          }
+        } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+          closed = true;
+        }
+        // if output remains, watch for writability too
+        epoll_event cev{};
+        cev.events = EPOLLIN | (conn.out.empty() ? 0 : EPOLLOUT);
+        cev.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_MOD, fd, &cev);
+      }
+
+      if (closed) {
+        epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
+        close(fd);
+        conns.erase(fd);
+      }
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,103 @@
+"""Aux subsystem tests: retry, checkpoint/resume, param save/load cache."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cassmantle_tpu.utils.retry import linear_backoff, retry_async
+
+
+@pytest.mark.asyncio
+async def test_retry_succeeds_after_failures():
+    attempts = []
+
+    async def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    sleeps = []
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    result = await retry_async(
+        flaky, max_retries=5, backoff=linear_backoff(10.0),
+        sleep=fake_sleep,
+    )
+    assert result == "ok"
+    assert len(attempts) == 3
+    assert sleeps == [10.0, 20.0]  # reference schedule (k+1)*base
+
+
+@pytest.mark.asyncio
+async def test_retry_exhausts_and_raises():
+    async def always_fails():
+        raise ValueError("permanent")
+
+    async def fake_sleep(s):
+        pass
+
+    with pytest.raises(ValueError):
+        await retry_async(always_fails, max_retries=3, sleep=fake_sleep)
+
+
+def test_param_save_load_roundtrip(tmp_path):
+    from cassmantle_tpu.models.weights import load_params, save_params
+
+    tree = {"params": {"layer": {"kernel": np.ones((4, 4), np.float32),
+                                 "bias": np.zeros((4,), np.float32)}}}
+    path = str(tmp_path / "cache.safetensors")
+    save_params(tree, path)
+    back = load_params(path)
+    np.testing.assert_array_equal(
+        back["params"]["layer"]["kernel"], tree["params"]["layer"]["kernel"]
+    )
+
+
+def test_init_params_cached_uses_cache(tmp_path, cfg):
+    import flax.linen as nn
+
+    from cassmantle_tpu.models.weights import init_params_cached
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(x)
+
+    model = Tiny()
+    x = jnp.ones((1, 4))
+    path = str(tmp_path / "tiny.safetensors")
+    p1 = init_params_cached(model, 0, x, cache_path=path)
+    p2 = init_params_cached(model, 0, x, cache_path=path)
+    np.testing.assert_array_equal(
+        np.asarray(p1["params"]["Dense_0"]["kernel"]),
+        np.asarray(p2["params"]["Dense_0"]["kernel"]),
+    )
+    import os
+
+    assert os.path.exists(path)
+
+
+def test_train_checkpoint_roundtrip(tmp_path):
+    from cassmantle_tpu.utils.checkpoint import TrainCheckpointer
+
+    params = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(1, params, opt_state)
+    ckpt.save(2, {"w": params["w"] * 2}, opt_state)
+    assert ckpt.latest_step() == 2
+    restored = ckpt.restore(
+        template={"params": params, "opt_state": opt_state}
+    )
+    np.testing.assert_allclose(
+        np.asarray(restored["params"]["w"]), np.asarray(params["w"]) * 2
+    )
+    ckpt.close()

@@ -1,0 +1,46 @@
+import numpy as np
+
+from cassmantle_tpu.config import ClipTextConfig
+from cassmantle_tpu.eval.clip_parity import ClipSimilarityHarness
+from cassmantle_tpu.models.clip_vision import ClipVisionConfig
+
+
+def _tiny_harness():
+    text_cfg = ClipTextConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, max_positions=16,
+    )
+    return ClipSimilarityHarness(
+        text_cfg=text_cfg, vision_cfg=ClipVisionConfig.tiny(), pad_len=16
+    )
+
+
+def test_clip_similarity_shapes_and_range():
+    h = _tiny_harness()
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (3, 32, 32, 3), dtype=np.uint8)
+    prompts = ["a lighthouse", "a caravan", "a comet"]
+    sims = h.similarity(images, prompts)
+    assert sims.shape == (3,)
+    assert np.isfinite(sims).all()
+    assert (np.abs(sims) <= 1.0 + 1e-5).all()
+
+
+def test_clip_similarity_deterministic():
+    h = _tiny_harness()
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 255, (2, 32, 32, 3), dtype=np.uint8)
+    prompts = ["storm", "harbor"]
+    np.testing.assert_allclose(
+        h.similarity(images, prompts), h.similarity(images, prompts)
+    )
+
+
+def test_parity_report():
+    h = _tiny_harness()
+    rng = np.random.default_rng(2)
+    images = rng.integers(0, 255, (2, 32, 32, 3), dtype=np.uint8)
+    report = h.parity_report(images, ["a", "b"], baseline_mean=0.3)
+    assert {"clip_sim_mean", "clip_sim_std", "n", "parity_ratio"} <= set(
+        report
+    )

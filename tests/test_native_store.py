@@ -1,0 +1,164 @@
+"""Native C++ state store (mantlestore) end-to-end tests.
+
+Builds the server with g++, spawns it on a test port, and drives it through
+the asyncio RESP client — including the same contract cases MemoryStore
+passes, plus cross-connection lock exclusion (the multi-worker property the
+engine's double-buffer relies on)."""
+
+import asyncio
+
+import pytest
+
+from cassmantle_tpu.engine.store import LockTimeout
+from cassmantle_tpu.native.client import MantleStore, ensure_built, spawn_server
+
+PORT = 7171
+
+pytestmark = pytest.mark.skipif(
+    ensure_built() is None, reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    proc = spawn_server(PORT)
+    yield proc
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture
+def store(server):
+    # NOTE: each async test runs in its own event loop (conftest runner),
+    # so the client must connect inside the test; cleanup uses a fresh
+    # client+loop of its own.
+    yield MantleStore(port=PORT)
+
+    async def cleanup():
+        c = MantleStore(port=PORT)
+        await c.flushall()
+        await c.close()
+
+    asyncio.run(cleanup())
+
+
+@pytest.mark.asyncio
+async def test_plain_keys_and_ttl(store):
+    await store.setex("countdown", 0.2, "active")
+    assert await store.exists("countdown")
+    ttl = await store.ttl("countdown")
+    assert 0.0 < ttl <= 0.2
+    await asyncio.sleep(0.25)
+    assert not await store.exists("countdown")
+    assert await store.ttl("countdown") == -2.0
+
+    await store.set("k", "v")
+    assert await store.get("k") == b"v"
+    assert await store.ttl("k") == -1.0
+    await store.delete("k")
+    assert await store.get("k") is None
+
+
+@pytest.mark.asyncio
+async def test_binary_values(store):
+    blob = bytes(range(256)) * 3
+    await store.hset("image", "current", blob)
+    assert await store.hget("image", "current") == blob
+
+
+@pytest.mark.asyncio
+async def test_hash_ops(store):
+    await store.hset("sess", mapping={"max": 0.01, "won": 0})
+    await store.hset("sess", "attempts", 0)
+    assert await store.hget("sess", "max") == b"0.01"
+    assert set(await store.hgetall("sess")) == {"max", "won", "attempts"}
+    assert await store.hincrby("sess", "attempts") == 1
+    assert await store.hincrby("sess", "attempts", 4) == 5
+    await store.hdel("sess", "max")
+    assert await store.hget("sess", "max") is None
+
+
+@pytest.mark.asyncio
+async def test_set_ops(store):
+    await store.sadd("sessions", "a", "b")
+    assert await store.sismember("sessions", "a")
+    await store.srem("sessions", "a")
+    assert await store.smembers("sessions") == {"b"}
+
+
+@pytest.mark.asyncio
+async def test_lock_exclusion_across_connections(store):
+    other = MantleStore(port=PORT)
+    order = []
+
+    async def holder():
+        async with store.lock("l", timeout=5.0, blocking_timeout=1.0):
+            order.append("h-in")
+            await asyncio.sleep(0.2)
+            order.append("h-out")
+
+    async def waiter():
+        await asyncio.sleep(0.05)
+        async with other.lock("l", timeout=5.0, blocking_timeout=1.0):
+            order.append("w-in")
+
+    await asyncio.gather(holder(), waiter())
+    assert order == ["h-in", "h-out", "w-in"]
+    await other.close()
+
+
+@pytest.mark.asyncio
+async def test_lock_acquire_timeout(store):
+    other = MantleStore(port=PORT)
+
+    async def holder():
+        async with store.lock("l2", timeout=5.0, blocking_timeout=0.5):
+            await asyncio.sleep(0.4)
+
+    async def contender():
+        await asyncio.sleep(0.05)
+        with pytest.raises(LockTimeout):
+            async with other.lock("l2", timeout=5.0,
+                                  blocking_timeout=0.1):
+                pass
+
+    await asyncio.gather(holder(), contender())
+    await other.close()
+
+
+@pytest.mark.asyncio
+async def test_lock_self_expires(store):
+    other = MantleStore(port=PORT)
+    mgr = store.lock("l3", timeout=0.2, blocking_timeout=0.1)
+    await mgr.__aenter__()  # simulated crash: never released
+    await asyncio.sleep(0.25)
+    async with other.lock("l3", timeout=1.0, blocking_timeout=0.5):
+        pass
+    await other.close()
+
+
+@pytest.mark.asyncio
+async def test_full_game_on_native_store(store):
+    """The whole engine runs against the native store."""
+    import dataclasses
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.engine.content import (
+        FakeContentBackend,
+        hash_embed,
+        hash_similarity,
+    )
+    from cassmantle_tpu.engine.game import Game
+
+    cfg = test_config()
+    game = Game(cfg, store, FakeContentBackend(image_size=16),
+                hash_embed, hash_similarity)
+    await game.startup()
+    await game.init_client("s1")
+    prompt = await game.rounds.fetch_current_prompt()
+    answers = {str(m): prompt["tokens"][m] for m in prompt["masks"]}
+    result = await game.compute_client_scores("s1", answers)
+    assert result["won"] == 1
+    await game.rounds.buffer_contents()
+    await game.rounds.promote_buffer()
+    assert int((await game.fetch_story())["episode"]) == 2

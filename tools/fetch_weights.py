@@ -1,0 +1,92 @@
+"""One-shot weight/tokenizer bootstrap (reference download_model.py analogue).
+
+The reference's bootstrap downloads NLTK corpora and a gensim word2vec
+artifact (download_model.py:4-10). This framework's artifacts are model
+checkpoints + tokenizer vocabularies, laid out as::
+
+    weights/
+      clip_text.safetensors   # CLIP ViT-L/14 text tower (SD1.5's)
+      unet.safetensors        # SD1.5 UNet
+      vae.safetensors         # SD VAE (decoder+post_quant used)
+      gpt2.safetensors        # GPT-2-small
+      minilm.safetensors      # all-MiniLM-L6-v2
+      clip_vocab.json / clip_merges.txt
+      gpt2_vocab.json / gpt2_merges.txt
+      minilm_vocab.txt
+
+Run this on a machine WITH network egress; every pipeline automatically
+prefers these files over random init (models/weights.py:maybe_load,
+utils/tokenizers.py:load_tokenizer). In a zero-egress environment this
+script exits gracefully and the framework runs on deterministic random
+init.
+
+Usage:  python tools/fetch_weights.py [--out weights]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+SOURCES = {
+    "clip_text.safetensors": (
+        "openai/clip-vit-large-patch14", "model.safetensors"),
+    "unet.safetensors": (
+        "runwayml/stable-diffusion-v1-5", "unet/diffusion_pytorch_model.safetensors"),
+    "vae.safetensors": (
+        "runwayml/stable-diffusion-v1-5", "vae/diffusion_pytorch_model.safetensors"),
+    "gpt2.safetensors": ("gpt2", "model.safetensors"),
+    "minilm.safetensors": (
+        "sentence-transformers/all-MiniLM-L6-v2", "model.safetensors"),
+    "gpt2_vocab.json": ("gpt2", "vocab.json"),
+    "gpt2_merges.txt": ("gpt2", "merges.txt"),
+    "clip_vocab.json": ("openai/clip-vit-large-patch14", "vocab.json"),
+    "clip_merges.txt": ("openai/clip-vit-large-patch14", "merges.txt"),
+    "minilm_vocab.txt": (
+        "sentence-transformers/all-MiniLM-L6-v2", "vocab.txt"),
+}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="weights")
+    args = parser.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    try:
+        from huggingface_hub import hf_hub_download
+    except ImportError:
+        print("huggingface_hub unavailable; cannot fetch weights.")
+        return 1
+
+    failures = []
+    for filename, (repo, remote) in SOURCES.items():
+        target = os.path.join(args.out, filename)
+        if os.path.exists(target):
+            print(f"[skip] {filename} already present")
+            continue
+        try:
+            path = hf_hub_download(repo_id=repo, filename=remote)
+            os.replace(path, target) if os.access(
+                os.path.dirname(path), os.W_OK
+            ) else None
+            if not os.path.exists(target):
+                import shutil
+
+                shutil.copyfile(path, target)
+            print(f"[ok]   {filename} <- {repo}/{remote}")
+        except Exception as exc:  # zero-egress or transient
+            failures.append(filename)
+            print(f"[fail] {filename}: {exc}")
+
+    if failures:
+        print(f"\n{len(failures)} artifacts missing; the framework will "
+              "use deterministic random init for those models.")
+        return 0  # not fatal by design
+    print("\nAll artifacts fetched.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
