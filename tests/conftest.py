@@ -9,9 +9,18 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# On few-core hosts the 8 virtual devices' programs serialize; XLA's default
+# 40 s collective termination timeout then kills the process mid-rendezvous
+# while straggler devices are still computing. Raise it well past the worst
+# observed compile+step time.
+for _f in (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
+):
+    if _f.split("=")[0].lstrip("-") not in flags:
+        flags = (flags + " " + _f).strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 
