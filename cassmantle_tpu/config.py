@@ -111,6 +111,8 @@ class MiniLMConfig:
 @dataclasses.dataclass(frozen=True)
 class ModelZooConfig:
     clip_text: ClipTextConfig = dataclasses.field(default_factory=ClipTextConfig)
+    # SDXL's second text tower (OpenCLIP bigG); None for SD1.5.
+    clip_text_2: Optional[ClipTextConfig] = None
     unet: UNetConfig = dataclasses.field(default_factory=UNetConfig)
     vae: VAEConfig = dataclasses.field(default_factory=VAEConfig)
     gpt2: GPT2Config = dataclasses.field(default_factory=GPT2Config)
@@ -141,14 +143,20 @@ class MeshConfig:
     - ``dp``: data parallel (batch sharding) — rides ICI within a slice.
     - ``tp``: tensor parallel (attention heads / MLP columns).
     - ``sp``: sequence/context parallel (ring attention over image tokens).
+    - ``pp``: pipeline parallel (layer stages; activations ppermute
+      stage-to-stage, parallel/pipeline.py).
+    - ``ep``: expert parallel (MoE experts sharded; token dispatch
+      all-to-all inserted by GSPMD, models/moe.py).
     Sizes of -1 mean "fill with remaining devices".
     """
 
     dp: int = -1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
+    ep: int = 1
     # Axis names, in mesh order.
-    axis_names: Tuple[str, ...] = ("dp", "tp", "sp")
+    axis_names: Tuple[str, ...] = ("dp", "pp", "tp", "sp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,6 +200,22 @@ class FrameworkConfig:
         return dataclasses.replace(self, **kw)
 
 
+def sdxl_config() -> FrameworkConfig:
+    """SDXL-base-1.0 at 1024×1024: dual text towers (CLIP-L + OpenCLIP
+    bigG), micro-conditioned UNet, 0.13025 VAE scaling — the BASELINE.md
+    "SDXL-base 1024 batched prompts, data-parallel" workload."""
+
+    return FrameworkConfig(
+        models=ModelZooConfig(
+            clip_text=ClipTextConfig(),
+            clip_text_2=ClipTextConfig.sdxl_big(),
+            unet=UNetConfig.sdxl(),
+            vae=VAEConfig(scaling_factor=0.13025),
+        ),
+        sampler=SamplerConfig(image_size=1024),
+    )
+
+
 def test_config() -> FrameworkConfig:
     """A tiny config for CPU tests: small models, fast rounds, 64px images."""
 
@@ -219,4 +243,28 @@ def test_config() -> FrameworkConfig:
                               min_new_tokens=2, prompt_pad_len=16),
         game=GameConfig(time_per_prompt=2.0, lock_timeout=5.0,
                         acquire_timeout=0.5),
+    )
+
+
+def test_sdxl_config() -> FrameworkConfig:
+    """Tiny SDXL-shaped config for CPU tests: dual towers, micro-conds."""
+
+    base = test_config()
+    tower = base.models.clip_text
+    tower2 = dataclasses.replace(tower, hidden_size=96, num_heads=4)
+    return base.replace(
+        models=dataclasses.replace(
+            base.models,
+            clip_text_2=tower2,
+            unet=UNetConfig(
+                base_channels=32, channel_mults=(1, 2), num_heads=4,
+                attention_levels=(False, True), transformer_depth=(0, 2),
+                blocks_per_level=1, context_dim=tower.hidden_size + 96,
+                time_embed_dim=128,
+                # pooled (96) + 6 sinusoidal time_ids × 32
+                addition_embed_dim=96 + 6 * 32,
+                dtype="float32",
+            ),
+            vae=dataclasses.replace(base.models.vae, scaling_factor=0.13025),
+        ),
     )

@@ -75,6 +75,10 @@ class ClipSimilarityHarness:
             )
             * 0.02
         )
+        # params as jit args (device buffers), not captured constants
+        self._params = {"text": self.text_params,
+                        "vision": self.vision_params,
+                        "proj": self.text_projection}
         self._jit_sim = jax.jit(self._sim_impl)
 
     def _tokenize(self, prompts: Sequence[str]) -> np.ndarray:
@@ -88,19 +92,21 @@ class ClipSimilarityHarness:
             )
         return out
 
-    def _sim_impl(self, ids, images_u8):
-        pooled = self.text.apply(self.text_params, ids)["pooled"]
-        temb = pooled.astype(jnp.float32) @ self.text_projection
+    def _sim_impl(self, params, ids, images_u8):
+        pooled = self.text.apply(params["text"], ids)["pooled"]
+        temb = pooled.astype(jnp.float32) @ params["proj"]
         temb = temb / (jnp.linalg.norm(temb, axis=-1, keepdims=True) + 1e-8)
         pre = preprocess_for_clip(images_u8, self.vision_cfg.image_size)
-        vemb = self.vision.apply(self.vision_params, pre)
+        vemb = self.vision.apply(params["vision"], pre)
         return jnp.sum(temb * vemb, axis=-1)
 
     def similarity(self, images_u8: np.ndarray,
                    prompts: Sequence[str]) -> np.ndarray:
         """(B,H,W,3) uint8 + B prompts -> (B,) CLIP similarities."""
         ids = jnp.asarray(self._tokenize(prompts))
-        return np.asarray(self._jit_sim(ids, jnp.asarray(images_u8)))
+        return np.asarray(
+            self._jit_sim(self._params, ids, jnp.asarray(images_u8))
+        )
 
     def parity_report(self, images_u8, prompts,
                       baseline_mean: Optional[float] = None) -> dict:

@@ -67,8 +67,14 @@ class ClipTextEncoder(nn.Module):
         x = tok + pos[None, :seq].astype(self.dtype)
 
         causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))[None, None]
+        penultimate = x
         for i in range(self.cfg.num_layers):
             x = ClipBlock(self.cfg, self.dtype, name=f"block_{i}")(x, causal)
+            if i == self.cfg.num_layers - 2:
+                # SDXL conditions the UNet on the second-to-last hidden
+                # state (no final LN) of both towers — diffusers'
+                # ``hidden_states[-2]`` / clip-skip-1 convention.
+                penultimate = x
 
         hidden = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # CLIP pools at the EOT token = argmax of ids (highest id is EOT).
@@ -77,4 +83,5 @@ class ClipTextEncoder(nn.Module):
             hidden, eot[:, None, None], axis=1
         ).squeeze(1)
         return {"hidden": hidden.astype(self.dtype),
-                "pooled": pooled.astype(self.dtype)}
+                "pooled": pooled.astype(self.dtype),
+                "penultimate": penultimate.astype(self.dtype)}

@@ -102,15 +102,30 @@ def make_cfg_denoiser(
     context: jax.Array,          # (B, S, D) conditional text states
     uncond_context: jax.Array,   # (B, S, D) unconditional ("") states
     guidance_scale: float,
+    addition_embeds: Optional[jax.Array] = None,         # (B, A) SDXL
+    uncond_addition_embeds: Optional[jax.Array] = None,  # (B, A) SDXL
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
-    """Classifier-free guidance denoiser: one 2B-batch UNet call per step."""
+    """Classifier-free guidance denoiser: one 2B-batch UNet call per step.
+
+    For SDXL, ``addition_embeds`` carries the pooled-text + time-ids
+    micro-conditioning vector; it rides the same 2B batch as the context.
+    """
     full_context = jnp.concatenate([uncond_context, context], axis=0)
+    full_addition = None
+    if addition_embeds is not None:
+        uncond_add = (uncond_addition_embeds
+                      if uncond_addition_embeds is not None
+                      else jnp.zeros_like(addition_embeds))
+        full_addition = jnp.concatenate([uncond_add, addition_embeds], axis=0)
 
     def denoise(x, t):
         b = x.shape[0]
         x2 = jnp.concatenate([x, x], axis=0)
         t2 = jnp.full((2 * b,), t, dtype=jnp.int32)
-        eps = unet_apply(params, x2, t2, full_context)
+        if full_addition is None:
+            eps = unet_apply(params, x2, t2, full_context)
+        else:
+            eps = unet_apply(params, x2, t2, full_context, full_addition)
         eps_uncond, eps_cond = jnp.split(eps, 2, axis=0)
         return eps_uncond + guidance_scale * (eps_cond - eps_uncond)
 

@@ -18,9 +18,13 @@ import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnums=(0, 4, 5))
+@partial(jax.jit, static_argnums=(0, 5, 6))
 def greedy_decode(
-    model_apply_pair,          # (prefill_fn, decode_step_fn) static closure
+    model_apply_pair,          # (prefill_fn, decode_step_fn), static; both
+                               # take ``params`` first so weights enter the
+                               # jit as device buffers, NOT as captured
+                               # constants baked into the HLO
+    params,                    # model param tree (traced argument)
     input_ids: jax.Array,      # (B, P) right-padded prompt bucket
     prompt_len: jax.Array,     # (B,)
     rng_unused: jax.Array,     # reserved for future sampling modes
@@ -32,7 +36,7 @@ def greedy_decode(
     b, p = input_ids.shape
     max_len = p + max_new_tokens
 
-    last_logits, cache = prefill_fn(input_ids, prompt_len, max_len)
+    last_logits, cache = prefill_fn(params, input_ids, prompt_len, max_len)
 
     positions = jnp.arange(max_len)[None, :]          # (1, L)
     prompt_valid = positions < prompt_len[:, None]     # (B, L)
@@ -51,7 +55,7 @@ def greedy_decode(
         valid = prompt_valid | (
             (positions >= p) & (positions <= idx)
         )
-        logits, cache = decode_step_fn(token, idx, cache, valid)
+        logits, cache = decode_step_fn(params, token, idx, cache, valid)
         return (logits, cache, done), emitted
 
     init_done = jnp.zeros((b,), dtype=bool)

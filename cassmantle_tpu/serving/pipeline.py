@@ -51,6 +51,20 @@ from cassmantle_tpu.utils.tokenizers import load_tokenizer
 log = get_logger("pipeline")
 
 
+def tokenize_clip_prompts(tokenizer, prompts: Sequence[str], pad_len: int,
+                          vocab_size: int) -> np.ndarray:
+    """Right-padded CLIP token ids: encode, trim, append EOS, pad.
+
+    Shared by the SD1.5 and SDXL pipelines so both tokenize identically.
+    """
+    out = np.full((len(prompts), pad_len), tokenizer.pad_id, dtype=np.int32)
+    for i, p in enumerate(prompts):
+        toks = tokenizer.encode(p)[: pad_len - 1]
+        toks = toks + [tokenizer.eos_id]
+        out[i, : len(toks)] = np.asarray(toks) % vocab_size
+    return out
+
+
 class Text2ImagePipeline:
     """prompts -> uint8 images; whole sampler jitted per batch bucket."""
 
@@ -100,14 +114,20 @@ class Text2ImagePipeline:
                     f"vae{cfg.sampler.image_size}", m.vae))
         )
         self.schedule = DDIMSchedule.create(cfg.sampler.num_steps)
+        # Params enter the jit as ARGUMENTS (device buffers), never as
+        # captured constants — capturing bakes ~4 GB of weights into the
+        # HLO, blowing up compile payloads (fatal through a remote-compile
+        # tunnel) and compile-cache keys.
+        self._params = {"clip": self.clip_params, "unet": self.unet_params,
+                        "vae": self.vae_params}
         self._sample = jax.jit(self._sample_impl)
 
-    def _sample_impl(self, ids, uncond_ids, rng):
+    def _sample_impl(self, params, ids, uncond_ids, rng):
         with annotate("clip_encode"):
-            ctx = self.clip.apply(self.clip_params, ids)["hidden"]
-            uncond = self.clip.apply(self.clip_params, uncond_ids)["hidden"]
+            ctx = self.clip.apply(params["clip"], ids)["hidden"]
+            uncond = self.clip.apply(params["clip"], uncond_ids)["hidden"]
         denoise = make_cfg_denoiser(
-            self.unet.apply, self.unet_params, ctx, uncond,
+            self.unet.apply, params["unet"], ctx, uncond,
             self.cfg.sampler.guidance_scale,
         )
         lat = initial_latents(rng, ids.shape[0], self.cfg.sampler.image_size,
@@ -116,19 +136,14 @@ class Text2ImagePipeline:
             final = ddim_sample(denoise, lat, self.schedule,
                                 eta=self.cfg.sampler.eta)
         with annotate("vae_decode"):
-            decoded = self.vae.apply(self.vae_params, final)
+            decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)
 
     def _tokenize(self, prompts: Sequence[str]) -> np.ndarray:
-        out = np.full((len(prompts), self.pad_len),
-                      self.tokenizer.pad_id, dtype=np.int32)
-        for i, p in enumerate(prompts):
-            toks = self.tokenizer.encode(p)[: self.pad_len - 1]
-            toks = toks + [self.tokenizer.eos_id]
-            out[i, : len(toks)] = np.asarray(toks) % (
-                self.cfg.models.clip_text.vocab_size
-            )
-        return out
+        return tokenize_clip_prompts(
+            self.tokenizer, prompts, self.pad_len,
+            self.cfg.models.clip_text.vocab_size,
+        )
 
     def generate(self, prompts: Sequence[str], seed: int = 0) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. One compiled graph per batch."""
@@ -136,7 +151,7 @@ class Text2ImagePipeline:
         uncond = jnp.asarray(self._tokenize([""] * len(prompts)))
         rng = jax.random.PRNGKey(seed)
         with metrics.timer("pipeline.t2i_s"):
-            images = self._sample(ids, uncond, rng)
+            images = self._sample(self._params, ids, uncond, rng)
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.images", len(prompts))
         return np.asarray(images)
@@ -163,11 +178,13 @@ class PromptGenerator:
                 self.model, 5, ids,
                 cache_path=param_cache_path("gpt2", m))
         )
-        self._prefill = lambda ids_, len_, max_len: self.model.apply(
-            self.params, ids_, len_, max_len, method=GPT2LM.prefill
+        # params flow through greedy_decode as traced args (no captured
+        # constants — see Text2ImagePipeline note)
+        self._prefill = lambda p, ids_, len_, max_len: self.model.apply(
+            p, ids_, len_, max_len, method=GPT2LM.prefill
         )
-        self._step = lambda tok, idx, cache, valid: self.model.apply(
-            self.params, tok, idx, cache, valid, method=GPT2LM.decode_step
+        self._step = lambda p, tok, idx, cache, valid: self.model.apply(
+            p, tok, idx, cache, valid, method=GPT2LM.decode_step
         )
 
     def generate(self, seed_text: str, max_new_tokens: Optional[int] = None
@@ -190,6 +207,7 @@ class PromptGenerator:
         with metrics.timer("pipeline.prompt_s"):
             out_tokens, gen_len = greedy_decode(
                 (self._prefill, self._step),
+                self.params,
                 jnp.asarray(ids),
                 jnp.asarray([len(toks)], dtype=jnp.int32),
                 jax.random.PRNGKey(0),
