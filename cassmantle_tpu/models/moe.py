@@ -1,0 +1,146 @@
+"""Mixture-of-Experts MLP with expert parallelism (the ``ep`` mesh axis).
+
+The reference has no MoE (it has no local models at all, SURVEY.md §2);
+this supplies the expert-parallel rung of the build's mesh so the
+framework's parallelism surface covers dp/tp/sp/pp/ep. Design is the
+TPU-canonical Switch/GShard formulation — everything is dense einsums over
+static shapes, so XLA can lay the expert dim out across the mesh:
+
+- **router**: top-1 token→expert assignment with a fixed capacity
+  ``C = capacity_factor · T / E`` per expert. Overflowing tokens fall
+  through the residual (standard Switch behavior) — no dynamic shapes.
+- **dispatch/combine** are one-hot einsums producing ``(E, C, D)``
+  buffers; with the expert axis sharded ``P("ep")`` GSPMD turns the
+  einsums into the all-to-all shuffles that ride ICI.
+- **expert FFN**: batched (E, ·, ·) matmuls — every expert's GEMM runs
+  concurrently on its own shard of the ``ep`` axis.
+
+``MoEMLP`` drops in anywhere a TransformerMLP fits; ``expert_specs`` gives
+the ``P("ep", ...)`` param specs for mesh placement.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MoEMLP(nn.Module):
+    """Top-1 (Switch) routed MLP: x (B, S, D) -> (B, S, D)."""
+
+    num_experts: int
+    intermediate: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        e = self.num_experts
+        t = b * s
+        cap = max(1, int(self.capacity_factor * t / e))
+
+        tokens = x.reshape(t, d)
+        # router in fp32: small, and argmax stability matters
+        gate_w = self.param(
+            "router", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        logits = tokens.astype(jnp.float32) @ gate_w          # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                   # (T,)
+        gate = jnp.take_along_axis(
+            probs, expert[:, None], axis=-1
+        )[:, 0]                                               # (T,)
+
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)   # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot             # 1-based
+        pos = jnp.sum(pos, axis=-1) - 1                       # (T,)
+        keep = pos < cap                                      # overflow drops
+
+        # dispatch tensor (T, E, C): one-hot routing incl. capacity slot
+        disp = (
+            jax.nn.one_hot(expert, e, dtype=self.dtype)[:, :, None]
+            * jax.nn.one_hot(jnp.where(keep, pos, cap), cap + 1,
+                             dtype=self.dtype)[:, None, :cap]
+        )
+        buf = jnp.einsum("td,tec->ecd", tokens.astype(self.dtype), disp)
+
+        # expert FFN: batched GEMMs over the (sharded) expert axis
+        w1 = self.param(
+            "w1", nn.initializers.lecun_normal(),
+            (e, d, self.intermediate), jnp.float32,
+        ).astype(self.dtype)
+        w2 = self.param(
+            "w2", nn.initializers.lecun_normal(),
+            (e, self.intermediate, d), jnp.float32,
+        ).astype(self.dtype)
+        h = jnp.einsum("ecd,edf->ecf", buf, w1)
+        h = nn.gelu(h)
+        h = jnp.einsum("ecf,efd->ecd", h, w2)
+
+        # combine: weight by the gate, scatter back to token order
+        combine = disp * gate[:, None, None].astype(self.dtype)
+        out = jnp.einsum("ecd,tec->td", h, combine)
+        # aux load-balancing loss (Switch eq. 4), exposed as a sown value
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(
+            jax.nn.one_hot(expert, e, dtype=jnp.float32), axis=0
+        )
+        self.sow("aux_loss", "load_balance", e * jnp.sum(me * ce))
+        return out.reshape(b, s, d).astype(x.dtype)
+
+
+def expert_specs(params) -> dict:
+    """PartitionSpecs placing expert-stacked weights over ``ep``."""
+
+    def spec_for(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if name.endswith("w1") or name.endswith("w2"):
+            return P("ep", None, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_moe_params(params, mesh: Mesh):
+    """Place MoE params: experts over ``ep``, router replicated."""
+    ep = int(mesh.shape.get("ep", 1))
+
+    def place(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        spec = P()
+        if (name.endswith("w1") or name.endswith("w2")) and \
+                leaf.shape[0] % ep == 0:
+            spec = P("ep", None, None)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+@functools.lru_cache(maxsize=32)
+def _moe_jitted(model: MoEMLP, mesh: Mesh):
+    """One compiled executable per (model config, mesh) — MoEMLP is a
+    frozen dataclass and Mesh hashes by devices+axes, so both key the
+    cache; a fresh closure per call would retrace every time."""
+    batch_spec = P("dp") if "dp" in mesh.axis_names else P()
+
+    @jax.jit
+    def fn(p, x):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, batch_spec)
+        )
+        return model.apply(p, x)
+
+    return fn
+
+
+def moe_sharded_apply(model: MoEMLP, params, x: jax.Array, mesh: Mesh):
+    """MoE forward with expert-sharded params and batch-sharded
+    activations; GSPMD inserts the dispatch/combine all-to-alls."""
+    return _moe_jitted(model, mesh)(params, x)

@@ -45,8 +45,11 @@ def maybe_init_distributed() -> bool:
 
 
 def resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> Sequence[int]:
-    """Fill -1 axes with the remaining device count (row-major)."""
-    sizes = [cfg.dp, cfg.tp, cfg.sp]
+    """Fill -1 axes with the remaining device count (row-major).
+
+    Order matches ``cfg.axis_names``: (dp, pp, tp, sp, ep).
+    """
+    sizes = [cfg.dp, cfg.pp, cfg.tp, cfg.sp, cfg.ep]
     fixed = 1
     for s in sizes:
         if s > 0:

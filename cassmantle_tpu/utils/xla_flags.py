@@ -1,0 +1,30 @@
+"""XLA_FLAGS management shared by every multi-device CPU entry point.
+
+Must stay importable BEFORE jax (no jax imports here): XLA parses the env
+var once at first backend initialization, so tests/conftest.py and
+__graft_entry__.py both append these flags at module import time.
+"""
+
+from __future__ import annotations
+
+import os
+
+# On few-core hosts the virtual CPU devices' programs serialize, and XLA's
+# default 40 s collective termination timeout kills the process while
+# straggler devices are still computing. Harmless on real-TPU paths.
+COLLECTIVE_TIMEOUT_FLAGS = (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
+)
+
+VIRTUAL_8_DEVICE_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def append_xla_flags(*flags: str) -> None:
+    """Append each flag to XLA_FLAGS unless its name is already set."""
+    current = os.environ.get("XLA_FLAGS", "")
+    for flag in flags:
+        name = flag.split("=")[0].lstrip("-")
+        if name not in current:
+            current = (current + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = current

@@ -5,22 +5,19 @@ collective/sharding tests exercise real XLA collectives on 8 host devices; the
 real-chip path is covered by bench.py and the driver's dryrun.
 """
 
-import os
+import os  # noqa: F401  (kept for tests that monkeypatch env)
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-# On few-core hosts the 8 virtual devices' programs serialize; XLA's default
-# 40 s collective termination timeout then kills the process mid-rendezvous
-# while straggler devices are still computing. Raise it well past the worst
-# observed compile+step time.
-for _f in (
-    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
-    "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
-):
-    if _f.split("=")[0].lstrip("-") not in flags:
-        flags = (flags + " " + _f).strip()
-os.environ["XLA_FLAGS"] = flags
+# Raised collective timeouts: on few-core hosts the 8 virtual devices'
+# programs serialize and XLA's default 40 s termination timeout kills the
+# process mid-rendezvous. The helper is jax-free, so this import cannot
+# initialize a backend before the flags land.
+from cassmantle_tpu.utils.xla_flags import (
+    COLLECTIVE_TIMEOUT_FLAGS,
+    VIRTUAL_8_DEVICE_FLAG,
+    append_xla_flags,
+)
+
+append_xla_flags(VIRTUAL_8_DEVICE_FLAG, *COLLECTIVE_TIMEOUT_FLAGS)
 
 import jax  # noqa: E402
 

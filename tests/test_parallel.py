@@ -18,14 +18,18 @@ from cassmantle_tpu.parallel.train import DiffusionTrainer
 
 
 def test_resolve_axis_sizes():
-    assert resolve_axis_sizes(MeshConfig(), 8) == [8, 1, 1]
-    assert resolve_axis_sizes(MeshConfig(dp=-1, tp=2), 8) == [4, 2, 1]
-    assert resolve_axis_sizes(MeshConfig(dp=2, tp=2, sp=2), 8) == [2, 2, 2]
+    # order matches axis_names: (dp, pp, tp, sp, ep)
+    assert resolve_axis_sizes(MeshConfig(), 8) == [8, 1, 1, 1, 1]
+    assert resolve_axis_sizes(MeshConfig(dp=-1, tp=2), 8) == [4, 1, 2, 1, 1]
+    assert resolve_axis_sizes(
+        MeshConfig(dp=2, tp=2, sp=2), 8) == [2, 1, 2, 2, 1]
+    assert resolve_axis_sizes(
+        MeshConfig(dp=-1, pp=2, ep=2), 8) == [2, 2, 1, 1, 2]
 
 
 def test_make_mesh_shapes():
     mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=2))
-    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    assert dict(mesh.shape) == {"dp": 2, "pp": 1, "tp": 2, "sp": 2, "ep": 1}
     mesh = make_mesh(MeshConfig())
     assert mesh.shape["dp"] == 8
 
