@@ -5,8 +5,12 @@ The BASELINE.md north-star config: full serving pipeline (CLIP encode →
 deterministic random unless checkpoints exist under ``weights/`` —
 throughput is weight-independent.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline target: 4 images/sec/chip (BASELINE.md).
+Default run prints ONE JSON line: {"metric", "value", "unit",
+"vs_baseline"} for the north-star metric. ``--suite`` additionally runs
+the full BASELINE.md workload ladder (MiniLM scorer, GPT-2 greedy decode,
+SD1.5-512, SDXL-1024 data-parallel, end-to-end round with 1k concurrent
+guesses) and writes all results to BENCH_SUITE.json; the north-star line
+is still the last stdout line.
 """
 
 from __future__ import annotations
@@ -20,33 +24,34 @@ BATCH = 4
 TIMED_ROUNDS = 3
 
 
-def main() -> None:
+PROMPTS = [
+    "A watercolor style piece depicting: a lighthouse over a stormy sea",
+    "An art deco style piece depicting: a caravan crossing silver dunes",
+    "A stained glass style piece depicting: an orchard under two moons",
+    "A vaporwave style piece depicting: a night train between cities",
+]
+
+
+def _setup_jax():
     import jax
+
+    from cassmantle_tpu.utils.compile_cache import enable_compile_cache
 
     # Persistent compile cache: first bench run pays the XLA compile, every
     # later run (and the driver's) reuses it.
-    try:
-        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    enable_compile_cache()
+    return jax
 
+
+def bench_sd15(weights_dir: str) -> dict:
+    """North-star: SD1.5 512², 50-step CFG DDIM, images/sec/chip."""
+    jax = _setup_jax()
     from cassmantle_tpu.config import FrameworkConfig
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
-    cfg = FrameworkConfig()
-    weights_dir = "weights" if len(sys.argv) < 2 else sys.argv[1]
-    pipe = Text2ImagePipeline(cfg, weights_dir=weights_dir)
-
-    prompts = [
-        "A watercolor style piece depicting: a lighthouse over a stormy sea",
-        "An art deco style piece depicting: a caravan crossing silver dunes",
-        "A stained glass style piece depicting: an orchard under two moons",
-        "A vaporwave style piece depicting: a night train between cities",
-    ][:BATCH]
-
-    # warmup / compile
-    pipe.generate(prompts, seed=0)
+    pipe = Text2ImagePipeline(FrameworkConfig(), weights_dir=weights_dir)
+    prompts = PROMPTS[:BATCH]
+    pipe.generate(prompts, seed=0)  # warmup / compile
 
     n_images = 0
     t0 = time.perf_counter()
@@ -57,12 +62,174 @@ def main() -> None:
 
     n_chips = jax.local_device_count()
     ips_per_chip = n_images / elapsed / max(1, n_chips)
-    print(json.dumps({
+    return {
         "metric": "sd15_512px_ddim50_images_per_sec_per_chip",
         "value": round(ips_per_chip, 4),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC, 4),
-    }))
+    }
+
+
+def bench_scorer(weights_dir: str) -> dict:
+    """BASELINE ladder #1: MiniLM guess scorer, 1k pairs coalesced."""
+    _setup_jax()
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.ops.scorer import EmbeddingScorer
+
+    cfg = FrameworkConfig()
+    scorer = EmbeddingScorer(cfg.models.minilm, weights_dir=weights_dir,
+                             batch_buckets=cfg.serving.score_batch_sizes)
+    words = ["stormy", "silver", "ancient", "quiet", "glass", "velvet"]
+    pairs = [(words[i % 6], words[(i + 1) % 6]) for i in range(1000)]
+    scorer.similarity(pairs)  # warmup
+
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        scorer.similarity(pairs)
+    elapsed = time.perf_counter() - t0
+    gps = reps * len(pairs) / elapsed
+    return {
+        "metric": "minilm_guess_scorings_per_sec",
+        "value": round(gps, 1),
+        "unit": "pairs/sec",
+        "vs_baseline": None,
+    }
+
+
+def bench_gpt2(weights_dir: str) -> dict:
+    """BASELINE ladder #2: GPT-2-small greedy decode, tokens/sec."""
+    _setup_jax()
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    gen = PromptGenerator(FrameworkConfig(), weights_dir=weights_dir)
+    seed_text = "The lighthouse keeper walked down the winding stair"
+    gen.generate(seed_text, max_new_tokens=96)  # warmup
+
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        gen.generate(seed_text, max_new_tokens=96)
+    elapsed = time.perf_counter() - t0
+    tps = reps * 96 / elapsed
+    return {
+        "metric": "gpt2_greedy_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+    }
+
+
+def bench_sdxl(weights_dir: str) -> dict:
+    """BASELINE ladder #4: SDXL-base 1024², batched, data-parallel."""
+    jax = _setup_jax()
+    from cassmantle_tpu.config import MeshConfig, sdxl_config
+    from cassmantle_tpu.parallel.mesh import make_mesh
+    from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+    n = jax.local_device_count()
+    mesh = make_mesh(MeshConfig(dp=-1, tp=1, sp=1)) if n > 1 else None
+    pipe = SDXLPipeline(sdxl_config(), weights_dir=weights_dir, mesh=mesh)
+    prompts = (PROMPTS * ((n + len(PROMPTS) - 1) // len(PROMPTS)))[: max(n, 1)]
+    pipe.generate(prompts, seed=0)  # warmup
+
+    t0 = time.perf_counter()
+    reps = 2
+    for i in range(reps):
+        pipe.generate(prompts, seed=i + 1)
+    elapsed = time.perf_counter() - t0
+    ips_chip = reps * len(prompts) / elapsed / max(1, n)
+    return {
+        "metric": "sdxl_1024px_ddim50_images_per_sec_per_chip",
+        "value": round(ips_chip, 4),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+    }
+
+
+def bench_e2e_round(weights_dir: str) -> dict:
+    """BASELINE ladder #5: full round (prompt gen + image + 1k concurrent
+    guess scorings through the continuous-batching queue)."""
+    import asyncio
+
+    _setup_jax()
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.serving.service import InferenceService
+
+    svc = InferenceService(FrameworkConfig(), weights_dir=weights_dir)
+
+    async def run() -> float:
+        svc.score_queue.start()
+        # warmup both paths
+        await svc.backend.generate("An old ship left the harbor", True)
+        await svc.similarity([("stormy", "windy")] * 64)
+        t0 = time.perf_counter()
+        content_task = asyncio.ensure_future(
+            svc.backend.generate("The market opened at dawn", False)
+        )
+        # 1k guesses land while the round is generating (the serving
+        # pressure point: queue coalescing + device contention)
+        guesses = [
+            svc.similarity([(f"word{i}", "stormy")]) for i in range(1000)
+        ]
+        await asyncio.gather(*guesses)
+        await content_task
+        elapsed = time.perf_counter() - t0
+        await svc.stop()
+        return elapsed
+
+    elapsed = asyncio.run(run())
+    return {
+        "metric": "e2e_round_with_1k_guesses_seconds",
+        "value": round(elapsed, 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+    }
+
+
+SUITE = {
+    "scorer": bench_scorer,
+    "gpt2": bench_gpt2,
+    "sd15": bench_sd15,
+    "sdxl": bench_sdxl,
+    "e2e": bench_e2e_round,
+}
+
+
+def main() -> None:
+    args = list(sys.argv[1:])
+    suite = "--suite" in args
+    flags = [a for a in args if a.startswith("--")]
+    unknown = [f for f in flags if f != "--suite"]
+    if unknown:
+        sys.exit(f"unknown flag(s): {' '.join(unknown)} (only --suite)")
+    args = [a for a in args if not a.startswith("--")]
+    weights_dir = args[0] if args else "weights"
+
+    if not suite:
+        print(json.dumps(bench_sd15(weights_dir)))
+        return
+
+    results = {}
+    north_star = None
+    for name, fn in SUITE.items():
+        try:
+            t0 = time.perf_counter()
+            res = fn(weights_dir)
+            res["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+        except Exception as exc:  # keep the suite going; record the failure
+            res = {"metric": name, "error": f"{type(exc).__name__}: {exc}"}
+        results[name] = res
+        if name == "sd15":
+            north_star = res
+        print(json.dumps(res), file=sys.stderr)
+    with open("BENCH_SUITE.json", "w") as f:
+        json.dump(results, f, indent=2)
+    if north_star is None or "error" in north_star:
+        # never emit a malformed north-star line with a zero exit
+        sys.exit(f"north-star bench failed: {north_star}")
+    print(json.dumps(north_star))
 
 
 if __name__ == "__main__":
