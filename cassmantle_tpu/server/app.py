@@ -183,15 +183,14 @@ async def handle_metrics(request: web.Request) -> web.Response:
 
 
 async def handle_wordlist(request: web.Request) -> web.Response:
-    """Vocabulary words for client-side guess validation (replaces the
-    reference's vendored hunspell dictionary + typo.js, §2 F3)."""
-    game = request.app[_GAME]
-    prompt = await game.rounds.fetch_current_prompt()
-    # The client only needs to validate words; serve the engine stopword
-    # set + current tokens as a light heuristic addition to its local rules
+    """Dictionary + stopwords for client-side spellcheck (replaces the
+    reference's vendored hunspell dictionary + typo.js, §2 F3; the client
+    runs static/spell.js check/suggest over these words)."""
     from cassmantle_tpu.engine.masking import STOPWORDS
+    from cassmantle_tpu.server.assets import load_wordlist
 
     return web.json_response({
+        "words": load_wordlist(),
         "stopwords": sorted(STOPWORDS),
         "min_len": 2,
     })

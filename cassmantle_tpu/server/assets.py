@@ -8,8 +8,9 @@ built-ins when the data files are absent.
 
 from __future__ import annotations
 
+import functools
 import os
-from typing import List
+from typing import List, Tuple
 
 DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "data")
@@ -60,3 +61,18 @@ def load_seeds() -> List[str]:
 
 def load_styles() -> List[str]:
     return _load_lines(os.path.join(DATA_DIR, "styles.txt"), _DEFAULT_STYLES)
+
+
+@functools.lru_cache(maxsize=1)
+def load_wordlist() -> Tuple[str, ...]:
+    """Dictionary words backing client-side spellcheck (data/wordlist.txt
+    + every word appearing in seeds/styles; the reference ships a hunspell
+    en_US dictionary for the same purpose, SURVEY.md §2 #13/F3). Cached:
+    the list is immutable at runtime and /wordlist is hit per page load."""
+    words = set(_load_lines(os.path.join(DATA_DIR, "wordlist.txt"), []))
+    for line in load_seeds() + load_styles():
+        for token in line.lower().split():
+            token = token.strip("'-")
+            if token.isalpha() and len(token) >= 2:
+                words.add(token)
+    return tuple(sorted(words))

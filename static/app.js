@@ -5,8 +5,9 @@
  * reset-triggered refetch, content rendering (base64 image, tokenized
  * prompt with inputs at mask indices, score placeholders, solved tokens),
  * guess submission with client-side validation, win banner, clock blink
- * under 60 s. Guess validation is rule-based + /wordlist stopwords instead
- * of a vendored hunspell dictionary.
+ * under 60 s. Guess validation runs the Spell checker (static/spell.js,
+ * check/suggest parity with the reference's typo.js) over the served
+ * /wordlist, with stopword and shape rules on top.
  */
 
 "use strict";
@@ -18,6 +19,7 @@ const state = {
   scores: {},
   won: false,
   stopwords: new Set(),
+  spell: null,
   submitting: false,
 };
 
@@ -38,6 +40,9 @@ async function loadWordlist() {
     const res = await fetch("/wordlist");
     const data = await res.json();
     state.stopwords = new Set(data.stopwords || []);
+    if (window.Spell && data.words && data.words.length) {
+      state.spell = new Spell(data.words);
+    }
   } catch (e) { /* validation degrades gracefully */ }
 }
 
@@ -135,18 +140,32 @@ function validGuess(word) {
   return null;
 }
 
+/* Advisory only: answers come from unrestricted LM output, so an absent
+ * word must never block submission (the served list is far smaller than
+ * the reference's full hunspell dictionary) — it just earns a hint. */
+function spellHint(word) {
+  if (!state.spell || state.spell.check(word)) return null;
+  const hints = state.spell.suggest(word, 3);
+  return hints.length
+    ? `unusual word — did you mean ${hints.join(", ")}?`
+    : null;
+}
+
 async function submitGuesses() {
   if (state.submitting || state.won) return;
   const inputs = {};
   let error = null;
+  let hint = null;
   document.querySelectorAll("#prompt input").forEach((input) => {
     const word = input.value.trim();
     if (!word) return;
     const problem = validGuess(word);
     if (problem) { error = `"${word}": ${problem}`; return; }
+    hint = hint || spellHint(word);
     inputs[input.dataset.mask] = word;
   });
   if (error) { $("feedback").textContent = error; return; }
+  if (hint) $("feedback").textContent = hint;
   if (Object.keys(inputs).length === 0) {
     $("feedback").textContent = "type a guess first";
     return;

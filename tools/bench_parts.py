@@ -1,0 +1,99 @@
+"""Stage-level timing of the SD1.5 serving path on the real chip.
+
+Times each piece of the north-star pipeline separately (CLIP encode, one
+2B-batch UNet denoise step, the 50-step DDIM scan, VAE decode) so perf
+work targets the real hot spot. Also times UNet variants (bf16 params,
+flash vs XLA attention) to size individual levers.
+
+Usage: python tools/bench_parts.py [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(name, fn, *args, reps=3, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:36s} {dt * 1e3:9.1f} ms")
+    return dt
+
+
+def main() -> None:
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    cfg = FrameworkConfig()
+    pipe = Text2ImagePipeline(cfg, weights_dir="weights")
+
+    ids = jnp.asarray(pipe._tokenize(["a lighthouse over a stormy sea"] * batch))
+    uncond = jnp.asarray(pipe._tokenize([""] * batch))
+    rng = jax.random.PRNGKey(0)
+
+    # full pipeline
+    full = timeit(
+        "full pipeline (tokenize..uint8)",
+        lambda: pipe._sample(pipe._params, ids, uncond, rng),
+    )
+
+    # CLIP encode
+    clip_fn = jax.jit(
+        lambda p, i: pipe.clip.apply(p, i)["hidden"]
+    )
+    timeit("clip encode (B)", clip_fn, pipe.clip_params, ids)
+
+    # single UNet step at CFG batch (2B)
+    lat_hw = cfg.sampler.image_size // pipe.vae_scale
+    lat2 = jnp.zeros((2 * batch, lat_hw, lat_hw, 4), jnp.float32)
+    t2 = jnp.zeros((2 * batch,), jnp.int32)
+    ctx2 = jnp.zeros((2 * batch, pipe.pad_len,
+                      cfg.models.unet.context_dim), jnp.float32)
+    unet_fn = jax.jit(lambda p, l, t, c: pipe.unet.apply(p, l, t, c))
+    step = timeit("unet step (2B batch)", unet_fn, pipe.unet_params,
+                  lat2, t2, ctx2)
+    print(f"{'-> 50 steps would be':36s} {step * 50 * 1e3:9.1f} ms")
+
+    # bf16 param variant
+    unet_bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if a.dtype == jnp.float32 else a,
+        pipe.unet_params,
+    )
+    timeit("unet step (bf16 params)", unet_fn, unet_bf16, lat2, t2, ctx2)
+
+    # XLA-attention variant
+    from cassmantle_tpu.ops.attention import xla_only
+
+    with xla_only():
+        unet_xla = jax.jit(
+            lambda p, l, t, c: pipe.unet.apply(p, l, t, c))
+        timeit("unet step (XLA attention)", unet_xla, pipe.unet_params,
+               lat2, t2, ctx2)
+
+    # VAE decode
+    latB = jnp.zeros((batch, lat_hw, lat_hw, 4), jnp.float32)
+    vae_fn = jax.jit(lambda p, l: pipe.vae.apply(p, l))
+    timeit("vae decode (B)", vae_fn, pipe.vae_params, latB)
+
+    print(f"batch={batch}: full={full * 1e3:.0f} ms "
+          f"-> {batch / full:.2f} images/sec")
+
+
+if __name__ == "__main__":
+    main()
