@@ -19,9 +19,11 @@ import json
 import sys
 import time
 
+import os
+
 BASELINE_IMAGES_PER_SEC = 4.0
-BATCH = 4
-TIMED_ROUNDS = 3
+BATCH = int(os.environ.get("BENCH_BATCH", "4"))
+TIMED_ROUNDS = int(os.environ.get("BENCH_ROUNDS", "3"))
 
 
 PROMPTS = [
@@ -102,37 +104,19 @@ def bench_gpt2(weights_dir: str) -> dict:
 
     Counts tokens actually generated (greedy_decode reports gen_len and
     stops at EOS), not the requested maximum."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    _setup_jax()
+    jax = _setup_jax()
     from cassmantle_tpu.config import FrameworkConfig
-    from cassmantle_tpu.ops.decode import greedy_decode
     from cassmantle_tpu.serving.pipeline import PromptGenerator
 
     gen = PromptGenerator(FrameworkConfig(), weights_dir=weights_dir)
     seed_text = "The lighthouse keeper walked down the winding stair"
-    toks = gen.tokenizer.encode(seed_text)
-    bucket = 64
-    ids = np.full((1, bucket), gen.tokenizer.pad_id, dtype=np.int32)
-    ids[0, : len(toks)] = np.asarray(toks) % gen.cfg.models.gpt2.vocab_size
-    args = (
-        (gen._prefill, gen._step),
-        gen.params,
-        jnp.asarray(ids),
-        jnp.asarray([len(toks)], dtype=jnp.int32),
-        jax.random.PRNGKey(0),
-        96,
-        gen.tokenizer.eos_id,
-    )
-    greedy_decode(*args)  # warmup
+    gen.decode_ids(seed_text, max_new_tokens=96)  # warmup
 
     t0 = time.perf_counter()
     reps = 5
     n_tokens = 0
     for _ in range(reps):
-        _, gen_len = greedy_decode(*args)
+        _, gen_len = gen.decode_ids(seed_text, max_new_tokens=96)
         n_tokens += int(jax.block_until_ready(gen_len)[0])
     elapsed = time.perf_counter() - t0
     tps = n_tokens / elapsed

@@ -120,6 +120,10 @@ class ModelZooConfig:
     # Directory holding safetensors checkpoints; None -> deterministic
     # random-init (fixed PRNG) so the full pipeline runs without artifacts.
     weights_dir: Optional[str] = None
+    # Storage dtype for UNet/text-model params ("bfloat16" halves HBM
+    # weight traffic per denoise step — the TPU-standard serving layout;
+    # norm layers still compute fp32 internally). "float32" to disable.
+    param_dtype: str = "bfloat16"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +242,9 @@ def test_config() -> FrameworkConfig:
             minilm=MiniLMConfig(vocab_size=512, hidden_size=64,
                                 intermediate_size=128, num_layers=2,
                                 num_heads=4, max_positions=32),
+            # fp32 storage on CPU tests: keeps golden/parity tolerances
+            # tight and bit-stable
+            param_dtype="float32",
         ),
         sampler=SamplerConfig(num_steps=4, image_size=64, max_new_tokens=8,
                               min_new_tokens=2, prompt_pad_len=16),

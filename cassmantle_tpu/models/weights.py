@@ -370,30 +370,41 @@ def load_params(path: str) -> dict:
 
 
 def init_params_cached(model, rng_seed: int, *sample_args,
-                       cache_path: Optional[str] = None) -> dict:
+                       cache_path: Optional[str] = None,
+                       cast_to: Optional[str] = None) -> dict:
     """Big-model init: run the init program on CPU (the on-device init
     graph for an 860M-param UNet takes minutes through a TPU tunnel, the
     CPU path ~1 min), cache to disk, and push the tree to the default
-    device in one transfer. Subsequent constructions load from cache."""
+    device in one transfer. Subsequent constructions load from cache.
+
+    ``cast_to`` applies the storage dtype (e.g. bf16 serving layout) at
+    this single production point so no caller ships a forgotten tree in
+    fp32. The disk cache stays fp32."""
     if cache_path and os.path.exists(cache_path):
         log.info("loading cached init params from %s", cache_path)
         tree = load_params(cache_path)
-        return jax.tree_util.tree_map(jnp.asarray, tree)
-    from cassmantle_tpu.ops.attention import xla_only
+    else:
+        from cassmantle_tpu.ops.attention import xla_only
 
-    cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu), xla_only():
-        params = model.init(jax.random.PRNGKey(rng_seed), *sample_args)
-    if cache_path:
-        log.info("caching init params to %s", cache_path)
-        save_params(params, cache_path)
-    return jax.tree_util.tree_map(jnp.asarray, params)
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu), xla_only():
+            tree = model.init(jax.random.PRNGKey(rng_seed), *sample_args)
+        if cache_path:
+            log.info("caching init params to %s", cache_path)
+            save_params(tree, cache_path)
+    if cast_to:
+        tree = cast_params(tree, cast_to)
+    return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
 def maybe_load(
-    weights_dir: Optional[str], filename: str, converter, model_name: str
+    weights_dir: Optional[str], filename: str, converter, model_name: str,
+    cast_to: Optional[str] = None,
 ) -> Optional[dict]:
-    """Load+convert a checkpoint if present, else None (random init)."""
+    """Load+convert a checkpoint if present, else None (random init).
+
+    ``cast_to``: storage dtype applied after conversion (see
+    init_params_cached)."""
     if not weights_dir:
         return None
     path = os.path.join(weights_dir, filename)
@@ -404,7 +415,29 @@ def maybe_load(
     log.info("%s: loading %s", model_name, path)
     tensors = load_safetensors(path)
     params = converter(tensors)
+    if cast_to:
+        params = cast_params(params, cast_to)
     return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def cast_params(params, dtype) -> dict:
+    """Cast float params to a storage dtype (bf16 serving layout).
+
+    Only floating leaves are cast; int leaves (e.g. embeddings indices,
+    none today) pass through. Norm layers compute in fp32 internally
+    (GroupNorm32 / LayerNorm(dtype=fp32)), so bf16 storage costs one
+    upcast there and halves HBM weight reads everywhere else.
+    """
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.float32:
+        return params
+
+    def cast(leaf):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, params)
 
 
 def tree_shapes(tree) -> Dict[str, tuple]:

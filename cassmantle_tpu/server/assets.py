@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import functools
 import os
+import re
 from typing import List, Tuple
 
 DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -72,7 +73,13 @@ def load_wordlist() -> Tuple[str, ...]:
     words = set(_load_lines(os.path.join(DATA_DIR, "wordlist.txt"), []))
     for line in load_seeds() + load_styles():
         for token in line.lower().split():
-            token = token.strip("'-")
-            if token.isalpha() and len(token) >= 2:
+            token = token.strip("'-.,;:!?\"")
+            # whole token (keeps 'ukiyo-e', 'low-poly' checkable exactly)
+            if re.fullmatch(r"[a-z]+(?:[-'][a-z]+)*", token) and \
+                    len(token) >= 2:
                 words.add(token)
+            # plus each alpha run, so the parts are guessable too
+            for part in re.findall(r"[a-z]+", token):
+                if len(part) >= 2:
+                    words.add(part)
     return tuple(sorted(words))

@@ -88,10 +88,11 @@ class Text2ImagePipeline:
         self.clip_params = (
             maybe_load(weights_dir, "clip_text.safetensors",
                        lambda t: convert_clip_text(t, m.clip_text.num_layers),
-                       "clip_text")
+                       "clip_text", cast_to=m.param_dtype)
             or init_params_cached(
                 self.clip, 1, ids,
-                cache_path=param_cache_path("clip_text", m.clip_text))
+                cache_path=param_cache_path("clip_text", m.clip_text),
+                cast_to=m.param_dtype)
         )
         lat_hw = cfg.sampler.image_size // self.vae_scale
         lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
@@ -100,10 +101,12 @@ class Text2ImagePipeline:
                         dtype=jnp.float32)
         self.unet_params = (
             maybe_load(weights_dir, "unet.safetensors",
-                       lambda t: convert_unet(t, m.unet), "unet")
+                       lambda t: convert_unet(t, m.unet), "unet",
+                       cast_to=m.param_dtype)
             or init_params_cached(
                 self.unet, 2, lat, t0, ctx,
-                cache_path=param_cache_path("unet", m.unet))
+                cache_path=param_cache_path("unet", m.unet),
+                cast_to=m.param_dtype)
         )
         self.vae_params = (
             maybe_load(weights_dir, "vae.safetensors",
@@ -173,10 +176,11 @@ class PromptGenerator:
         self.params = (
             maybe_load(weights_dir, "gpt2.safetensors",
                        lambda t: convert_gpt2(t, m.num_layers, m.hidden_size),
-                       "gpt2")
+                       "gpt2", cast_to=cfg.models.param_dtype)
             or init_params_cached(
                 self.model, 5, ids,
-                cache_path=param_cache_path("gpt2", m))
+                cache_path=param_cache_path("gpt2", m),
+                cast_to=cfg.models.param_dtype)
         )
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
@@ -187,11 +191,12 @@ class PromptGenerator:
             p, tok, idx, cache, valid, method=GPT2LM.decode_step
         )
 
-    def generate(self, seed_text: str, max_new_tokens: Optional[int] = None
-                 ) -> str:
-        """Greedy continuation of ``seed_text`` (the reference decodes
-        32-96 tokens then keeps the first two sentences,
-        backend.py:253-265)."""
+    def decode_ids(self, seed_text: str,
+                   max_new_tokens: Optional[int] = None):
+        """Greedy continuation at the token level: seed text -> bucketed
+        prefill + cached decode; returns (tokens (1, max_new), gen_len
+        (1,)). The serving path and the benchmark both use this, so they
+        measure the same computation."""
         m = self.cfg.models.gpt2
         max_new = max_new_tokens or self.cfg.sampler.max_new_tokens
         toks = self.tokenizer.encode(seed_text)
@@ -204,16 +209,23 @@ class PromptGenerator:
         )
         ids = np.full((1, bucket), self.tokenizer.pad_id, dtype=np.int32)
         ids[0, : len(toks)] = np.asarray(toks) % m.vocab_size
+        return greedy_decode(
+            (self._prefill, self._step),
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray([len(toks)], dtype=jnp.int32),
+            jax.random.PRNGKey(0),
+            max_new,
+            self.tokenizer.eos_id,
+        )
+
+    def generate(self, seed_text: str, max_new_tokens: Optional[int] = None
+                 ) -> str:
+        """Greedy continuation of ``seed_text`` (the reference decodes
+        32-96 tokens then keeps the first two sentences,
+        backend.py:253-265)."""
         with metrics.timer("pipeline.prompt_s"):
-            out_tokens, gen_len = greedy_decode(
-                (self._prefill, self._step),
-                self.params,
-                jnp.asarray(ids),
-                jnp.asarray([len(toks)], dtype=jnp.int32),
-                jax.random.PRNGKey(0),
-                max_new,
-                self.tokenizer.eos_id,
-            )
+            out_tokens, gen_len = self.decode_ids(seed_text, max_new_tokens)
         n = int(gen_len[0])
         text = self.tokenizer.decode(np.asarray(out_tokens[0, :n]).tolist())
         return two_sentences(text)
@@ -252,7 +264,14 @@ class TPUContentBackend(ContentBackend):
         from cassmantle_tpu.server.assets import load_styles
 
         self.cfg = cfg
-        self.t2i = Text2ImagePipeline(cfg, weights_dir)
+        if cfg.models.clip_text_2 is not None:
+            # SDXL config (both text towers): serve rounds at SDXL-1024,
+            # the reference's actual image model (backend.py:24).
+            from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+            self.t2i = SDXLPipeline(cfg, weights_dir)
+        else:
+            self.t2i = Text2ImagePipeline(cfg, weights_dir)
         self.prompt_gen = PromptGenerator(cfg, weights_dir)
         self.styles = styles or load_styles()
         self.rng = rng or random.Random(cfg.seed)

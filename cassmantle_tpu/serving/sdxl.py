@@ -104,19 +104,21 @@ class SDXLPipeline:
         self.clip_params = (
             maybe_load(weights_dir, "clip_text.safetensors",
                        lambda t: convert_clip_text(t, m.clip_text.num_layers),
-                       "clip_text")
+                       "clip_text", cast_to=m.param_dtype)
             or init_params_cached(
                 self.clip, 1, ids,
-                cache_path=param_cache_path("clip_text", m.clip_text))
+                cache_path=param_cache_path("clip_text", m.clip_text),
+                cast_to=m.param_dtype)
         )
         self.clip2_params = (
             maybe_load(weights_dir, "clip_text_2.safetensors",
                        lambda t: convert_clip_text(
                            t, m.clip_text_2.num_layers),
-                       "clip_text_2")
+                       "clip_text_2", cast_to=m.param_dtype)
             or init_params_cached(
                 self.clip2, 11, ids,
-                cache_path=param_cache_path("clip_text_2", m.clip_text_2))
+                cache_path=param_cache_path("clip_text_2", m.clip_text_2),
+                cast_to=m.param_dtype)
         )
         lat_hw = cfg.sampler.image_size // self.vae_scale
         lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
@@ -126,10 +128,12 @@ class SDXLPipeline:
         add = jnp.zeros((1, m.unet.addition_embed_dim), dtype=jnp.float32)
         self.unet_params = (
             maybe_load(weights_dir, "unet_xl.safetensors",
-                       lambda t: convert_unet(t, m.unet), "unet_xl")
+                       lambda t: convert_unet(t, m.unet), "unet_xl",
+                       cast_to=m.param_dtype)
             or init_params_cached(
                 self.unet, 2, lat, t0, ctx, add,
-                cache_path=param_cache_path("unet_xl", m.unet))
+                cache_path=param_cache_path("unet_xl", m.unet),
+                cast_to=m.param_dtype)
         )
         self.vae_params = (
             maybe_load(weights_dir, "vae_xl.safetensors",

@@ -4,7 +4,8 @@
  * parsing. Affix handling is rule-based: plural, past, progressive,
  * agentive, superlative and adverb suffixes reduce to a stem before
  * lookup. Suggestions are edit-distance-1 candidates that pass check(),
- * ranked by frequency of the letters kept.
+ * in generation order (deletion, transposition, insertion, substitution
+ * at each position, left to right).
  */
 
 "use strict";
@@ -63,7 +64,7 @@ class Spell {
         consider(head + c + tail);                           // insertion
         if (tail) consider(head + c + tail.slice(1));        // substitution
       }
-      if (out.length >= limit * 3) break;
+      if (out.length >= limit) break;
     }
     return out.slice(0, limit);
   }

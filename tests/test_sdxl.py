@@ -106,6 +106,18 @@ def test_sdxl_data_parallel_matches_single_device(cfg):
     assert mismatch < 0.02, f"{mismatch:.4f} of pixels differ"
 
 
+def test_content_backend_uses_sdxl_with_dual_towers(cfg):
+    from cassmantle_tpu.serving.pipeline import TPUContentBackend
+    from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+    backend = TPUContentBackend(cfg)
+    assert isinstance(backend.t2i, SDXLPipeline)
+    content = backend.generate_sync("The harbor at dawn", True)
+    s = cfg.sampler.image_size
+    assert content.image.shape == (s, s, 3)
+    assert content.prompt_text
+
+
 def test_sdxl_data_parallel_pads_partial_batch(cfg):
     mesh = make_mesh(MeshConfig(dp=-1, tp=1, sp=1))
     dp_pipe = SDXLPipeline(cfg, mesh=mesh)

@@ -69,13 +69,14 @@ def main() -> None:
                   lat2, t2, ctx2)
     print(f"{'-> 50 steps would be':36s} {step * 50 * 1e3:9.1f} ms")
 
-    # bf16 param variant
-    unet_bf16 = jax.tree_util.tree_map(
-        lambda a: a.astype(jnp.bfloat16)
-        if a.dtype == jnp.float32 else a,
+    # fp32-storage variant (the pipeline default is bf16; this sizes the
+    # bf16-weights lever by timing the OLD layout)
+    unet_fp32 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32)
+        if a.dtype == jnp.bfloat16 else a,
         pipe.unet_params,
     )
-    timeit("unet step (bf16 params)", unet_fn, unet_bf16, lat2, t2, ctx2)
+    timeit("unet step (fp32 params)", unet_fn, unet_fp32, lat2, t2, ctx2)
 
     # XLA-attention variant
     from cassmantle_tpu.ops.attention import xla_only
