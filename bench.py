@@ -52,7 +52,7 @@ def bench_sd15(weights_dir: str) -> dict:
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
     pipe = Text2ImagePipeline(FrameworkConfig(), weights_dir=weights_dir)
-    prompts = PROMPTS[:BATCH]
+    prompts = (PROMPTS * ((BATCH + len(PROMPTS) - 1) // len(PROMPTS)))[:BATCH]
     pipe.generate(prompts, seed=0)  # warmup / compile
 
     n_images = 0

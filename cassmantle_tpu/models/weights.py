@@ -426,11 +426,10 @@ def cast_params(params, dtype) -> dict:
     Only floating leaves are cast; int leaves (e.g. embeddings indices,
     none today) pass through. Norm layers compute in fp32 internally
     (GroupNorm32 / LayerNorm(dtype=fp32)), so bf16 storage costs one
-    upcast there and halves HBM weight reads everywhere else.
+    upcast there and halves HBM weight reads everywhere else. Casting TO
+    fp32 also works (upcasts a half-precision checkpoint).
     """
     dtype = jnp.dtype(dtype)
-    if dtype == jnp.float32:
-        return params
 
     def cast(leaf):
         if jnp.issubdtype(leaf.dtype, jnp.floating):

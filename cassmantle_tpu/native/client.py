@@ -44,17 +44,26 @@ def ensure_built() -> Optional[str]:
         return None
 
 
-def spawn_server(port: int = 7070) -> subprocess.Popen:
+def spawn_server(port: int = 7070,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_interval_s: float = 30.0) -> subprocess.Popen:
+    """Spawn mantlestore. With ``snapshot_path`` the server restores that
+    snapshot at boot and persists to it periodically and on SIGTERM —
+    the Redis-durability resume semantics of the reference (SURVEY §5.4)."""
     binary = ensure_built()
     assert binary, "mantlestore binary unavailable"
+    cmd = [binary, str(port)]
+    if snapshot_path:
+        cmd += [snapshot_path, str(snapshot_interval_s)]
     proc = subprocess.Popen(
-        [binary, str(port)],
-        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
     )
-    # wait for the listening line
-    line = proc.stderr.readline().decode()
-    assert "listening" in line, line
-    return proc
+    # wait for the listening line (restore logs precede it)
+    while True:
+        line = proc.stderr.readline().decode()
+        assert line, "mantlestore exited before listening"
+        if "listening" in line:
+            return proc
 
 
 def _b(v: Value) -> bytes:
@@ -145,16 +154,27 @@ class MantleStore(StateStore):
             return float(ms)
         return ms / 1000.0
 
+    # The server's RESP parser caps commands at 1024 args; multi-member
+    # writes are chunked client-side so arbitrarily large collections
+    # never wedge the connection (a too-long command would never parse
+    # and the reply would never come).
+    _CHUNK = 500
+
+    async def _cmd_chunked(self, head, pairs_or_members, stride):
+        for i in range(0, len(pairs_or_members), self._CHUNK * stride):
+            await self._cmd(*head,
+                            *pairs_or_members[i:i + self._CHUNK * stride])
+
     # -- hashes -----------------------------------------------------------
     async def hset(self, key, field=None, value=None, mapping=None):
-        args = [b"HSET", key.encode()]
+        args = []
         if field is not None:
             args += [field.encode(), _b(value)]
         if mapping:
             for k, v in mapping.items():
                 args += [k.encode(), _b(v)]
-        if len(args) > 2:
-            await self._cmd(*args)
+        if args:
+            await self._cmd_chunked([b"HSET", key.encode()], args, 2)
 
     async def hget(self, key, field):
         return await self._cmd(b"HGET", key.encode(), field.encode())
@@ -167,8 +187,8 @@ class MantleStore(StateStore):
 
     async def hdel(self, key, *fields):
         if fields:
-            await self._cmd(b"HDEL", key.encode(),
-                            *[f.encode() for f in fields])
+            await self._cmd_chunked([b"HDEL", key.encode()],
+                                    [f.encode() for f in fields], 1)
 
     async def hincrby(self, key, field, amount: int = 1) -> int:
         return await self._cmd(b"HINCRBY", key.encode(), field.encode(),
@@ -177,13 +197,13 @@ class MantleStore(StateStore):
     # -- sets -------------------------------------------------------------
     async def sadd(self, key, *members):
         if members:
-            await self._cmd(b"SADD", key.encode(),
-                            *[m.encode() for m in members])
+            await self._cmd_chunked([b"SADD", key.encode()],
+                                    [m.encode() for m in members], 1)
 
     async def srem(self, key, *members):
         if members:
-            await self._cmd(b"SREM", key.encode(),
-                            *[m.encode() for m in members])
+            await self._cmd_chunked([b"SREM", key.encode()],
+                                    [m.encode() for m in members], 1)
 
     async def smembers(self, key) -> Set[str]:
         return {m.decode() for m in await self._cmd(b"SMEMBERS",

@@ -279,7 +279,10 @@ def main() -> None:
     parser.add_argument("--round-seconds", type=float, default=None)
     parser.add_argument("--store", default=None,
                         help="'native[:port]' = shared C++ mantlestore "
-                             "(spawn with native/build/mantlestore)")
+                             "(spawn with native/build/mantlestore "
+                             "[port] [snapshot_path [interval_s]]; a "
+                             "snapshot path makes rounds survive store "
+                             "restarts)")
     args = parser.parse_args()
 
     cfg = FrameworkConfig()

@@ -17,8 +17,16 @@
 //   +BUSY; a crashed holder's lock self-expires. Blocking acquisition is
 //   client-side (the engine polls with its acquire timeout).
 //
+// - durability: with a snapshot path, state serializes as a stream of
+//   replayable RESP commands (SET/HSET/SADD + PEXPIRE with the REMAINING
+//   ttl) — written atomically (tmp+rename) every snapshot_interval_s and
+//   on SIGTERM/SIGINT, replayed through the normal dispatch at boot. A
+//   restarted worker resumes the in-flight round exactly the way the
+//   reference resumes from Redis durability (SURVEY.md §5.4).
+//
 // Build: g++ -O2 -std=c++17 -o mantlestore mantlestore.cc
-// Run:   ./mantlestore [port]   (default 7070, localhost only)
+// Run:   ./mantlestore [port] [snapshot_path [interval_s]]
+//        (default port 7070, localhost only; no path = in-memory only)
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -325,9 +333,99 @@ static void execute(Store& store, const std::vector<std::string>& argv,
     store.data_.clear();
     store.locks_.clear();
     resp_simple(out, "OK");
+  } else if (cmd == "DBSIZE" && argv.size() == 1) {
+    store.sweep();
+    resp_int(out, (long long)store.data_.size());
   } else {
     resp_error(out, "ERR unknown command");
   }
+}
+
+// ---------------------------------------------------------------------------
+// Snapshot persistence (replayable RESP command stream)
+// ---------------------------------------------------------------------------
+
+static void emit_command(std::string& out,
+                         const std::vector<std::string>& argv) {
+  resp_array_header(out, argv.size());
+  for (const auto& a : argv) resp_bulk(out, a);
+}
+
+static bool save_snapshot(Store& store, const std::string& path) {
+  store.sweep();
+  std::string out;
+  double t = now_s();
+  // parse_command caps commands at 1024 args: chunk multi-member emits
+  // well below that so replay never truncates.
+  const size_t kChunk = 512;
+  for (const auto& [key, e] : store.data_) {
+    long long ms = -1;
+    if (e.deadline >= 0) {
+      ms = (long long)((e.deadline - t) * 1000.0);
+      if (ms <= 0) continue;  // effectively expired: don't resurrect it
+    }
+    if (e.kind == Entry::STRING) {
+      emit_command(out, {"SET", key, e.str});
+    } else if (e.kind == Entry::HASH) {
+      std::vector<std::string> cmd = {"HSET", key};
+      for (const auto& [f, v] : e.hash) {
+        cmd.push_back(f);
+        cmd.push_back(v);
+        if (cmd.size() >= kChunk) {
+          emit_command(out, cmd);
+          cmd = {"HSET", key};
+        }
+      }
+      if (cmd.size() > 2) emit_command(out, cmd);
+    } else {
+      std::vector<std::string> cmd = {"SADD", key};
+      for (const auto& m : e.set) {
+        cmd.push_back(m);
+        if (cmd.size() >= kChunk) {
+          emit_command(out, cmd);
+          cmd = {"SADD", key};
+        }
+      }
+      if (cmd.size() > 2) emit_command(out, cmd);
+    }
+    if (ms > 0)
+      emit_command(out, {"PEXPIRE", key, std::to_string(ms)});
+  }
+  // locks deliberately not persisted: they self-expire and a restarted
+  // holder must not believe it still owns one
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return false;
+  bool ok = fwrite(out.data(), 1, out.size(), f) == out.size();
+  // fsync before rename: otherwise a crash can persist the rename but
+  // not the data blocks, replacing a good snapshot with a torn one
+  ok = fflush(f) == 0 && ok;
+  ok = fsync(fileno(f)) == 0 && ok;
+  ok = fclose(f) == 0 && ok;
+  if (ok) ok = rename(tmp.c_str(), path.c_str()) == 0;
+  if (!ok) remove(tmp.c_str());
+  return ok;
+}
+
+static void load_snapshot(Store& store, const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return;  // first boot: nothing to restore
+  std::string buf;
+  char chunk[65536];
+  size_t r;
+  while ((r = fread(chunk, 1, sizeof(chunk), f)) > 0) buf.append(chunk, r);
+  fclose(f);
+  size_t pos = 0;
+  std::vector<std::string> argv;
+  std::string discard;
+  size_t n = 0;
+  while (parse_command(buf, pos, argv)) {
+    execute(store, argv, discard);
+    discard.clear();
+    n++;
+  }
+  fprintf(stderr, "mantlestore: restored %zu commands from %s\n", n,
+          path.c_str());
 }
 
 // ---------------------------------------------------------------------------
@@ -346,9 +444,16 @@ static int set_nonblock(int fd) {
   return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
+static volatile sig_atomic_t g_shutdown = 0;
+static void on_term(int) { g_shutdown = 1; }
+
 int main(int argc, char** argv) {
   int port = argc > 1 ? atoi(argv[1]) : 7070;
+  std::string snapshot_path = argc > 2 ? argv[2] : "";
+  double snapshot_interval = argc > 3 ? strtod(argv[3], nullptr) : 30.0;
   signal(SIGPIPE, SIG_IGN);
+  signal(SIGTERM, on_term);
+  signal(SIGINT, on_term);
 
   int listener = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -371,19 +476,39 @@ int main(int argc, char** argv) {
   epoll_ctl(ep, EPOLL_CTL_ADD, listener, &ev);
 
   Store store;
+  if (!snapshot_path.empty()) load_snapshot(store, snapshot_path);
   std::unordered_map<int, Conn> conns;
   std::vector<std::string> cmd_args;
   double last_sweep = now_s();
+  double last_save = now_s();
 
-  fprintf(stderr, "mantlestore listening on 127.0.0.1:%d\n", port);
+  fprintf(stderr, "mantlestore listening on 127.0.0.1:%d%s\n", port,
+          snapshot_path.empty() ? "" : " (durable)");
   fflush(stderr);
 
   epoll_event events[64];
   for (;;) {
     int n = epoll_wait(ep, events, 64, 250);
+    if (g_shutdown) {
+      if (!snapshot_path.empty()) {
+        if (save_snapshot(store, snapshot_path)) {
+          fprintf(stderr, "mantlestore: snapshot saved on shutdown\n");
+          return 0;
+        }
+        fprintf(stderr, "mantlestore: SNAPSHOT SAVE FAILED on shutdown\n");
+        return 1;
+      }
+      return 0;
+    }
     if (now_s() - last_sweep > 1.0) {
       store.sweep();
       last_sweep = now_s();
+    }
+    if (!snapshot_path.empty() &&
+        now_s() - last_save > snapshot_interval) {
+      if (!save_snapshot(store, snapshot_path))
+        fprintf(stderr, "mantlestore: periodic snapshot save failed\n");
+      last_save = now_s();
     }
     for (int i = 0; i < n; i++) {
       int fd = events[i].data.fd;

@@ -162,3 +162,74 @@ async def test_full_game_on_native_store(store):
     await game.rounds.buffer_contents()
     await game.rounds.promote_buffer()
     assert int((await game.fetch_story())["episode"]) == 2
+
+
+@pytest.mark.asyncio
+async def test_snapshot_durability(tmp_path):
+    """State survives a SIGTERM + restart via the snapshot file — the
+    worker-restart-resumes-round semantics the reference gets from Redis
+    durability (SURVEY.md §5.4)."""
+    import signal
+
+    snap = str(tmp_path / "store.snap")
+    port = PORT + 1
+    proc = spawn_server(port, snapshot_path=snap)
+    try:
+        c = MantleStore(port=port)
+        await c.set("prompt:current", "the stormy lighthouse")
+        await c.hset("story", mapping={"title": "Salt Roads", "episode": "3"})
+        await c.sadd("sessions", "s1", "s2")
+        await c.setex("countdown", 30.0, "active")
+        await c.setex("gone", 0.05, "x")
+        await c.close()
+        import asyncio as aio
+
+        await aio.sleep(0.1)  # 'gone' expires before the snapshot
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=5)
+
+        proc = spawn_server(port, snapshot_path=snap)
+        c = MantleStore(port=port)
+        assert await c.get("prompt:current") == b"the stormy lighthouse"
+        story = await c.hgetall("story")
+        assert story["title"] == b"Salt Roads" and story["episode"] == b"3"
+        assert await c.smembers("sessions") == {"s1", "s2"}
+        ttl = await c.ttl("countdown")
+        assert 0.0 < ttl <= 30.0  # TTL persisted as REMAINING time
+        assert not await c.exists("gone")
+        await c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+@pytest.mark.asyncio
+async def test_snapshot_chunks_large_collections(tmp_path):
+    """Sets/hashes beyond the RESP 1024-arg parse cap replay losslessly
+    (the snapshot writer chunks multi-member commands)."""
+    import signal
+
+    snap = str(tmp_path / "big.snap")
+    port = PORT + 2
+    proc = spawn_server(port, snapshot_path=snap)
+    try:
+        c = MantleStore(port=port)
+        members = [f"player-{i}" for i in range(1500)]
+        await c.sadd("sessions", *members)
+        await c.hset("scores",
+                     mapping={f"f{i}": str(i) for i in range(700)})
+        await c.set("after", "still-here")  # key serialized after the big ones
+        await c.close()
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=5)
+
+        proc = spawn_server(port, snapshot_path=snap)
+        c = MantleStore(port=port)
+        assert await c.smembers("sessions") == set(members)
+        scores = await c.hgetall("scores")
+        assert len(scores) == 700 and scores["f699"] == b"699"
+        assert await c.get("after") == b"still-here"
+        await c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
