@@ -10,6 +10,9 @@ checkpoints + tokenizer vocabularies, laid out as::
       vae.safetensors         # SD VAE (decoder+post_quant used)
       gpt2.safetensors        # GPT-2-small
       minilm.safetensors      # all-MiniLM-L6-v2
+      clip_text_2.safetensors # OpenCLIP bigG text tower (SDXL)
+      unet_xl.safetensors     # SDXL-base UNet
+      vae_xl.safetensors      # SDXL VAE
       clip_vocab.json / clip_merges.txt
       gpt2_vocab.json / gpt2_merges.txt
       minilm_vocab.txt
@@ -45,6 +48,16 @@ SOURCES = {
     "clip_merges.txt": ("openai/clip-vit-large-patch14", "merges.txt"),
     "minilm_vocab.txt": (
         "sentence-transformers/all-MiniLM-L6-v2", "vocab.txt"),
+    # SDXL-base (serving/sdxl.py): second text tower + XL UNet/VAE
+    "clip_text_2.safetensors": (
+        "stabilityai/stable-diffusion-xl-base-1.0",
+        "text_encoder_2/model.safetensors"),
+    "unet_xl.safetensors": (
+        "stabilityai/stable-diffusion-xl-base-1.0",
+        "unet/diffusion_pytorch_model.safetensors"),
+    "vae_xl.safetensors": (
+        "stabilityai/stable-diffusion-xl-base-1.0",
+        "vae/diffusion_pytorch_model.safetensors"),
 }
 
 
