@@ -53,10 +53,17 @@ class DiffusionTrainer:
         mesh: Mesh,
         lr: float = 1e-4,
         num_train_steps: int = 1000,
+        remat: bool = False,
     ) -> None:
         self.cfg = cfg
         self.mesh = mesh
         self.unet = UNet(cfg.models.unet)
+        # Rematerialization trades FLOPs for HBM: the backward pass
+        # recomputes the UNet forward instead of keeping every
+        # activation live — the standard lever for fitting bigger
+        # batches/resolutions per chip.
+        self._apply = (jax.checkpoint(self.unet.apply) if remat
+                       else self.unet.apply)
         self.optimizer = make_optimizer(lr)
 
         betas = (
@@ -107,7 +114,7 @@ class DiffusionTrainer:
         noisy = jnp.sqrt(a) * latents + jnp.sqrt(1.0 - a) * noise
 
         def loss_fn(p):
-            pred = self.unet.apply(p, noisy, t, context)
+            pred = self._apply(p, noisy, t, context)
             return jnp.mean((pred - noise) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

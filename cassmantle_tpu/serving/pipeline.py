@@ -51,6 +51,36 @@ from cassmantle_tpu.utils.tokenizers import load_tokenizer
 log = get_logger("pipeline")
 
 
+def dp_sharded_sampler(sample_impl, mesh):
+    """Jit a ``(params, ids, uncond_ids, rng)`` sampler for the mesh.
+
+    Returns ``(jitted_fn, dp)``: with a mesh, token ids arrive sharded
+    over the required ``dp`` axis and params replicate (GSPMD inserts
+    nothing in the forward — batch parallelism is collective-free);
+    without one, a plain jit and dp=1. Shared by the SD1.5 and SDXL
+    pipelines so the sharding/padding contract lives in one place.
+    """
+    if mesh is None:
+        return jax.jit(sample_impl), 1
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(
+        sample_impl,
+        in_shardings=(repl, batch, batch, repl),
+        out_shardings=batch,
+    )
+    return fn, int(mesh.shape["dp"])
+
+
+def pad_prompts_to_dp(prompts: Sequence[str], dp: int):
+    """Pad a prompt list to a multiple of the dp width (equal per-device
+    shards); callers drop the pad rows from the output."""
+    n = len(prompts)
+    return list(prompts) + [""] * ((-n) % dp), n
+
+
 def tokenize_clip_prompts(tokenizer, prompts: Sequence[str], pad_len: int,
                           vocab_size: int) -> np.ndarray:
     """Right-padded CLIP token ids: encode, trim, append EOS, pad.
@@ -66,10 +96,16 @@ def tokenize_clip_prompts(tokenizer, prompts: Sequence[str], pad_len: int,
 
 
 class Text2ImagePipeline:
-    """prompts -> uint8 images; whole sampler jitted per batch bucket."""
+    """prompts -> uint8 images; whole sampler jitted per batch bucket.
+
+    With ``mesh`` the batch shards over the ``dp`` axis (params
+    replicated by GSPMD) — the v5e-8 batch-data-parallel serving layout;
+    partial batches pad to the dp width and pad rows are dropped.
+    """
 
     def __init__(self, cfg: FrameworkConfig,
-                 weights_dir: Optional[str] = None) -> None:
+                 weights_dir: Optional[str] = None,
+                 mesh=None) -> None:
         enable_compile_cache()
         m = cfg.models
         self.cfg = cfg
@@ -123,7 +159,7 @@ class Text2ImagePipeline:
         # tunnel) and compile-cache keys.
         self._params = {"clip": self.clip_params, "unet": self.unet_params,
                         "vae": self.vae_params}
-        self._sample = jax.jit(self._sample_impl)
+        self._sample, self.dp = dp_sharded_sampler(self._sample_impl, mesh)
 
     def _sample_impl(self, params, ids, uncond_ids, rng):
         with annotate("clip_encode"):
@@ -150,14 +186,15 @@ class Text2ImagePipeline:
 
     def generate(self, prompts: Sequence[str], seed: int = 0) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. One compiled graph per batch."""
-        ids = jnp.asarray(self._tokenize(prompts))
-        uncond = jnp.asarray(self._tokenize([""] * len(prompts)))
+        padded, n = pad_prompts_to_dp(prompts, self.dp)
+        ids = jnp.asarray(self._tokenize(padded))
+        uncond = jnp.asarray(self._tokenize([""] * len(padded)))
         rng = jax.random.PRNGKey(seed)
         with metrics.timer("pipeline.t2i_s"):
             images = self._sample(self._params, ids, uncond, rng)
             images = jax.block_until_ready(images)
-        metrics.inc("pipeline.images", len(prompts))
-        return np.asarray(images)
+        metrics.inc("pipeline.images", n)
+        return np.asarray(images[:n])
 
 
 class PromptGenerator:
@@ -260,6 +297,7 @@ class TPUContentBackend(ContentBackend):
         weights_dir: Optional[str] = None,
         styles: Optional[List[str]] = None,
         rng: Optional[random.Random] = None,
+        mesh=None,
     ) -> None:
         from cassmantle_tpu.server.assets import load_styles
 
@@ -269,9 +307,9 @@ class TPUContentBackend(ContentBackend):
             # the reference's actual image model (backend.py:24).
             from cassmantle_tpu.serving.sdxl import SDXLPipeline
 
-            self.t2i = SDXLPipeline(cfg, weights_dir)
+            self.t2i = SDXLPipeline(cfg, weights_dir, mesh=mesh)
         else:
-            self.t2i = Text2ImagePipeline(cfg, weights_dir)
+            self.t2i = Text2ImagePipeline(cfg, weights_dir, mesh=mesh)
         self.prompt_gen = PromptGenerator(cfg, weights_dir)
         self.styles = styles or load_styles()
         self.rng = rng or random.Random(cfg.seed)

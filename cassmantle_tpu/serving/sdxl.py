@@ -27,7 +27,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.models.clip_text import ClipTextEncoder
@@ -151,18 +151,9 @@ class SDXLPipeline:
             "unet": self.unet_params, "vae": self.vae_params,
         }
 
-        if mesh is not None:
-            batch = NamedSharding(mesh, P("dp"))
-            repl = NamedSharding(mesh, P())
-            self._sample = jax.jit(
-                self._sample_impl,
-                in_shardings=(repl, batch, batch, repl),
-                out_shardings=batch,
-            )
-            self.dp = int(mesh.shape.get("dp", 1))
-        else:
-            self._sample = jax.jit(self._sample_impl)
-            self.dp = 1
+        from cassmantle_tpu.serving.pipeline import dp_sharded_sampler
+
+        self._sample, self.dp = dp_sharded_sampler(self._sample_impl, mesh)
 
     # -- conditioning ------------------------------------------------------
 
@@ -220,9 +211,9 @@ class SDXLPipeline:
         """prompts -> (B, H, W, 3) uint8. Batch is padded to a multiple of
         the dp axis so every device holds an equal shard; pad rows are
         dropped before returning."""
-        n = len(prompts)
-        pad = (-n) % self.dp
-        padded = list(prompts) + [""] * pad
+        from cassmantle_tpu.serving.pipeline import pad_prompts_to_dp
+
+        padded, n = pad_prompts_to_dp(prompts, self.dp)
         ids = jnp.asarray(self._tokenize(padded))
         uncond = jnp.asarray(self._tokenize([""] * len(padded)))
         rng = jax.random.PRNGKey(seed)

@@ -22,16 +22,35 @@ from cassmantle_tpu.utils.logging import get_logger
 log = get_logger("service")
 
 
+def default_serving_mesh(cfg: FrameworkConfig):
+    """Batch-DP mesh over all local devices when more than one is
+    visible (the v5e-8 serving layout); None on a single chip."""
+    import jax
+
+    if jax.local_device_count() <= 1:
+        return None
+    from cassmantle_tpu.config import MeshConfig
+    from cassmantle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=-1))
+    log.info("serving mesh: dp=%d", mesh.shape["dp"])
+    return mesh
+
+
 class InferenceService:
     def __init__(self, cfg: FrameworkConfig,
-                 weights_dir: Optional[str] = None) -> None:
+                 weights_dir: Optional[str] = None,
+                 mesh=None) -> None:
+        if mesh is None:
+            mesh = default_serving_mesh(cfg)
         self.cfg = cfg
         self.scorer = EmbeddingScorer(
             cfg.models.minilm,
             weights_dir=weights_dir,
             batch_buckets=cfg.serving.score_batch_sizes,
         )
-        self.backend = TPUContentBackend(cfg, weights_dir=weights_dir)
+        self.backend = TPUContentBackend(cfg, weights_dir=weights_dir,
+                                         mesh=mesh)
         self.score_queue: BatchingQueue = BatchingQueue(
             handler=self._score_batch,
             max_batch=max(cfg.serving.score_batch_sizes),
