@@ -43,6 +43,7 @@ DATA_DIR = os.path.join(
 )
 
 _GAME = web.AppKey("game", Game)
+_HEALTH = web.AppKey("health", object)
 
 
 def _client_ip(request: web.Request) -> str:
@@ -182,6 +183,35 @@ async def handle_metrics(request: web.Request) -> web.Response:
     return web.json_response(metrics.snapshot())
 
 
+async def handle_healthz(request: web.Request) -> web.Response:
+    """Liveness: process up + store reachable + device responsive. Both
+    probes carry deadlines (a wedged store connection or chip reports
+    unhealthy instead of hanging the endpoint) and run concurrently."""
+    game = request.app[_GAME]
+    health = request.app.get(_HEALTH)
+
+    async def store_probe() -> bool:
+        try:
+            await asyncio.wait_for(game.store.exists("healthz"), timeout=2.0)
+            return True
+        except Exception:
+            return False
+
+    async def device_probe() -> bool:
+        if health is None:
+            return True  # fake backend: no device to probe
+        loop = asyncio.get_running_loop()
+        ok, _ = await loop.run_in_executor(None, health.check)
+        return ok
+
+    store_ok, device_ok = await asyncio.gather(store_probe(), device_probe())
+    ok = store_ok and device_ok
+    return web.json_response(
+        {"ok": ok, "store": store_ok, "device": device_ok},
+        status=200 if ok else 503,
+    )
+
+
 async def handle_wordlist(request: web.Request) -> web.Response:
     """Dictionary + stopwords for client-side spellcheck (replaces the
     reference's vendored hunspell dictionary + typo.js, §2 F3; the client
@@ -197,11 +227,16 @@ async def handle_wordlist(request: web.Request) -> web.Response:
 
 
 def create_app(game: Game, cfg: FrameworkConfig,
-               start_timer: bool = True) -> web.Application:
+               start_timer: bool = True,
+               device_health: bool = False) -> web.Application:
     app = web.Application(middlewares=[
         cors_middleware, make_ratelimit_middleware(cfg)
     ])
     app[_GAME] = game
+    if device_health:
+        from cassmantle_tpu.utils.health import DeviceHealth
+
+        app[_HEALTH] = DeviceHealth()
     app.router.add_get("/", handle_root)
     app.router.add_get("/init", handle_init)
     app.router.add_get("/client/status", handle_status)
@@ -209,6 +244,7 @@ def create_app(game: Game, cfg: FrameworkConfig,
     app.router.add_post("/compute_score", handle_compute_score)
     app.router.add_get("/clock", handle_clock)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/wordlist", handle_wordlist)
     if os.path.isdir(STATIC_DIR):
         app.router.add_static("/static", STATIC_DIR)
@@ -295,7 +331,8 @@ def main() -> None:
         )
     game = build_game(cfg, fake=args.fake, weights_dir=args.weights,
                       store_addr=args.store)
-    web.run_app(create_app(game, cfg), host=args.host, port=args.port)
+    web.run_app(create_app(game, cfg, device_health=not args.fake),
+                host=args.host, port=args.port)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,89 @@
+"""Device liveness / health checks (SURVEY.md §5.3).
+
+The reference's failure handling is per-call retry + skip-don't-crash
+(utils.py:43-61, backend.py:123-129); it has no health surface at all.
+Here the serving layer gets one: a tiny jitted probe computation runs on
+the default device with a wall-clock deadline (a wedged TPU tunnel or a
+dying chip makes device calls hang rather than raise — exactly the
+failure this detects), and the result is cached briefly so `/healthz`
+polling can't pile probes onto the device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("health")
+
+
+def _probe_once() -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    y = jax.jit(lambda v: (v * 2.0).sum())(x)
+    return float(jax.block_until_ready(y)) == 56.0
+
+
+class _Probe:
+    """One probe on a DAEMON thread: a stuck XLA call can't be cancelled,
+    only disowned — daemon threads never pin process exit."""
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.ok = False
+        threading.Thread(
+            target=self._run, daemon=True, name="device-probe"
+        ).start()
+
+    def _run(self) -> None:
+        try:
+            self.ok = bool(_probe_once())
+        except Exception as exc:
+            log.warning("device probe failed: %s", exc)
+            self.ok = False
+        self.done.set()
+
+
+class DeviceHealth:
+    """Cached device-liveness prober.
+
+    ``check()`` returns (healthy, age_s). A probe that exceeds
+    ``timeout_s`` marks the device unhealthy WITHOUT blocking the caller
+    beyond the timeout; the hung probe thread is left behind (daemon)
+    and reused if it ever completes.
+    """
+
+    def __init__(self, timeout_s: float = 10.0, cache_s: float = 15.0):
+        self.timeout_s = timeout_s
+        self.cache_s = cache_s
+        self._lock = threading.Lock()
+        self._healthy: Optional[bool] = None
+        self._checked_at = 0.0
+        self._inflight: Optional[_Probe] = None
+
+    def check(self) -> tuple:
+        with self._lock:
+            age = time.monotonic() - self._checked_at
+            if self._healthy is not None and age < self.cache_s:
+                return self._healthy, age
+            if self._inflight is None:
+                self._inflight = _Probe()
+            probe = self._inflight
+        if probe.done.wait(timeout=self.timeout_s):
+            ok = probe.ok
+        else:
+            ok = False
+            log.warning("device probe exceeded %.1fs (device hung?)",
+                        self.timeout_s)
+        with self._lock:
+            if probe.done.is_set():
+                self._inflight = None
+            self._healthy = ok
+            self._checked_at = time.monotonic()
+        metrics.gauge("health.device_ok", 1.0 if ok else 0.0)
+        return ok, 0.0

@@ -126,6 +126,32 @@ def test_train_step_runs_and_learns(cfg):
     assert np.mean(losses[-2:]) < np.mean(losses[:2])
 
 
+def test_ulysses_attention_matches_reference():
+    from cassmantle_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    b, s, h, d = 2, 64, 8, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, h, d))
+    v = jax.random.normal(ks[2], (b, s, h, d))
+    ref = xla_attention(q, k, v)
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from cassmantle_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    q = jnp.zeros((1, 16, 6, 8))  # 6 heads, sp=8
+    with pytest.raises(AssertionError):
+        ulysses_attention(q, q, q, mesh)
+
+
 def test_train_step_remat_matches(cfg):
     """jax.checkpoint trades FLOPs for memory without changing the math."""
     mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=2))

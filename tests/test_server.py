@@ -177,6 +177,44 @@ async def test_wordlist_endpoint():
 
 
 @pytest.mark.asyncio
+async def test_healthz_endpoint():
+    client, _ = await make_client(make_cfg())
+    try:
+        res = await client.get("/healthz")
+        data = await res.json()
+        assert res.status == 200
+        assert data == {"ok": True, "store": True, "device": True}
+    finally:
+        await client.close()
+
+
+def test_device_health_probe():
+    from cassmantle_tpu.utils.health import DeviceHealth
+
+    h = DeviceHealth(timeout_s=60.0, cache_s=0.0)
+    ok, _ = h.check()
+    assert ok  # CPU device answers the probe
+    # cached path
+    h2 = DeviceHealth(timeout_s=60.0, cache_s=60.0)
+    assert h2.check()[0] and h2.check()[0]
+
+
+def test_device_health_timeout_marks_unhealthy(monkeypatch):
+    import cassmantle_tpu.utils.health as health_mod
+
+    def hang():
+        import time as t
+
+        t.sleep(0.5)
+        return True
+
+    monkeypatch.setattr(health_mod, "_probe_once", hang)
+    h = health_mod.DeviceHealth(timeout_s=0.2, cache_s=0.0)
+    ok, _ = h.check()
+    assert not ok
+
+
+@pytest.mark.asyncio
 async def test_index_served():
     client, _ = await make_client(make_cfg())
     try:
