@@ -80,7 +80,9 @@ class VAEConfig:
     channel_mults: Tuple[int, ...] = (1, 2, 4, 4)
     blocks_per_level: int = 2
     scaling_factor: float = 0.18215  # SD1.5; SDXL uses 0.13025
-    dtype: str = "float32"
+    # bf16 compute (fp32 GroupNorm statistics via GroupNorm32): the decode
+    # is a one-shot memory-bound pass; bf16 halves its HBM traffic.
+    dtype: str = "bfloat16"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +95,38 @@ class GPT2Config:
     num_heads: int = 12
     max_positions: int = 1024
     dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass(frozen=True)
+class MistralConfig:
+    """Mistral-7B-Instruct-class causal LM — the reference's actual prompt
+    model (backend.py:25 calls the hosted Mistral-7B-Instruct-v0.1 endpoint).
+
+    Architecture: RoPE positions, grouped-query attention (8 KV heads),
+    sliding-window attention, RMSNorm, SwiGLU MLP. Defaults are the 7B
+    geometry; ``tiny()`` is the CPU-test variant.
+    """
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_positions: int = 4096
+    sliding_window: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def tiny() -> "MistralConfig":
+        return MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_positions=64, sliding_window=16, dtype="float32",
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,6 +150,10 @@ class ModelZooConfig:
     unet: UNetConfig = dataclasses.field(default_factory=UNetConfig)
     vae: VAEConfig = dataclasses.field(default_factory=VAEConfig)
     gpt2: GPT2Config = dataclasses.field(default_factory=GPT2Config)
+    # Optional Mistral-7B-class prompt LM; when set, the serving layer
+    # generates story episodes with it instead of GPT-2 (the reference's
+    # actual LLM family, backend.py:25).
+    mistral: Optional[MistralConfig] = None
     minilm: MiniLMConfig = dataclasses.field(default_factory=MiniLMConfig)
     # Directory holding safetensors checkpoints; None -> deterministic
     # random-init (fixed PRNG) so the full pipeline runs without artifacts.
@@ -128,8 +166,14 @@ class ModelZooConfig:
 
 @dataclasses.dataclass(frozen=True)
 class SamplerConfig:
-    """DDIM image sampler + greedy text decode settings."""
+    """Image sampler + greedy text decode settings.
 
+    ``kind``: "ddim" (default), "euler", or "dpmpp_2m" (ops/samplers.py;
+    DPM++(2M) reaches DDIM-50 quality in ~20-25 steps — the fast-serving
+    configuration).
+    """
+
+    kind: str = "ddim"
     num_steps: int = 50
     guidance_scale: float = 7.5
     eta: float = 0.0
@@ -236,7 +280,7 @@ def test_config() -> FrameworkConfig:
                 dtype="float32",
             ),
             vae=VAEConfig(base_channels=32, channel_mults=(1, 2),
-                          blocks_per_level=1),
+                          blocks_per_level=1, dtype="float32"),
             gpt2=GPT2Config(vocab_size=256, hidden_size=64, num_layers=2,
                             num_heads=4, max_positions=64, dtype="float32"),
             minilm=MiniLMConfig(vocab_size=512, hidden_size=64,

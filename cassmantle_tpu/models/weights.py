@@ -179,6 +179,40 @@ def convert_gpt2(tensors: Tensors, num_layers: int, hidden: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Mistral (transformers Llama-family naming: model.layers.N.*)
+# ---------------------------------------------------------------------------
+
+def convert_mistral(tensors: Tensors, num_layers: int) -> dict:
+    """Mistral-7B-Instruct safetensors -> models/mistral.py tree.
+
+    RMSNorm has scale only (no bias); all projections are bias-free.
+    """
+    c = Converter(tensors, "mistral")
+
+    def rmsnorm(src: str, dst: str) -> None:
+        c.put(f"{dst}/scale", c.take(f"{src}.weight"))
+
+    c.embed("model.embed_tokens", "embed")
+    for i in range(num_layers):
+        src, dst = f"model.layers.{i}", f"block_{i}"
+        rmsnorm(f"{src}.input_layernorm", f"{dst}/ln1")
+        c.dense(f"{src}.self_attn.q_proj", f"{dst}/attn/q")
+        c.dense(f"{src}.self_attn.k_proj", f"{dst}/attn/k")
+        c.dense(f"{src}.self_attn.v_proj", f"{dst}/attn/v")
+        c.dense(f"{src}.self_attn.o_proj", f"{dst}/attn/out")
+        rmsnorm(f"{src}.post_attention_layernorm", f"{dst}/ln2")
+        c.dense(f"{src}.mlp.gate_proj", f"{dst}/mlp/gate")
+        c.dense(f"{src}.mlp.up_proj", f"{dst}/mlp/up")
+        c.dense(f"{src}.mlp.down_proj", f"{dst}/mlp/down")
+    rmsnorm("model.norm", "ln_f")
+    if c.has("lm_head.weight"):
+        c.dense("lm_head", "lm_head")
+    else:  # tied-embedding checkpoints
+        c.put("lm_head/kernel", _t(c.take("model.embed_tokens.weight")))
+    return c.tree()
+
+
+# ---------------------------------------------------------------------------
 # MiniLM / BERT encoder (sentence-transformers all-MiniLM-L6-v2 naming)
 # ---------------------------------------------------------------------------
 
@@ -408,13 +442,35 @@ def maybe_load(
     if not weights_dir:
         return None
     path = os.path.join(weights_dir, filename)
-    if not os.path.exists(path):
-        log.info("%s: no checkpoint at %s; using random init",
-                 model_name, path)
+    if os.path.exists(path):
+        log.info("%s: loading %s", model_name, path)
+        tensors = load_safetensors(path)
+    else:
+        # sharded checkpoints: <stem>-*.safetensors merge into one dict
+        import glob
+
+        stem = filename.rsplit(".", 1)[0]
+        shards = sorted(
+            glob.glob(os.path.join(weights_dir, f"{stem}-*.safetensors"))
+        )
+        if not shards:
+            log.info("%s: no checkpoint at %s; using random init",
+                     model_name, path)
+            return None
+        log.info("%s: loading %d shards for %s", model_name, len(shards),
+                 stem)
+        tensors = {}
+        for shard in shards:
+            tensors.update(load_safetensors(shard))
+    try:
+        params = converter(tensors)
+    except KeyError as exc:
+        # incomplete checkpoint (e.g. interrupted shard download): degrade
+        # to the documented random-init fallback instead of crashing the
+        # server deep inside conversion
+        log.error("%s: checkpoint at %s is missing tensors (%s); "
+                  "falling back to random init", model_name, path, exc)
         return None
-    log.info("%s: loading %s", model_name, path)
-    tensors = load_safetensors(path)
-    params = converter(tensors)
     if cast_to:
         params = cast_params(params, cast_to)
     return jax.tree_util.tree_map(jnp.asarray, params)

@@ -1,0 +1,199 @@
+"""Diffusion samplers beyond DDIM: Euler and DPM-Solver++(2M).
+
+The reference's hosted SDXL endpoint (backend.py:270-295) exposes no
+sampler choice; serving locally we can trade steps for latency — DPM++(2M)
+at 20-25 steps matches 50-step DDIM quality, roughly halving image latency
+on the same chip. All samplers here keep the DDIM contract from ops/ddim.py:
+
+- ``denoise(x_t, t) -> eps`` with x_t in VP space (unit-variance latents),
+  ``t`` an int train-timestep — so the CFG denoiser and the UNet are shared
+  unchanged across samplers;
+- the full trajectory is ONE ``lax.scan`` under jit: per-step coefficients
+  are precomputed host-side into fixed-shape arrays (no data-dependent
+  control flow, no recompiles per step).
+
+Schedules use SD's scaled-linear betas (ops/ddim.py) with trailing-uniform
+timestep spacing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cassmantle_tpu.ops.ddim import DDIMSchedule, ddim_sample
+
+SAMPLER_KINDS = ("ddim", "euler", "dpmpp_2m")
+
+
+def _alpha_bars(num_train_steps: int = 1000, beta_start: float = 0.00085,
+                beta_end: float = 0.012) -> np.ndarray:
+    betas = np.linspace(beta_start**0.5, beta_end**0.5, num_train_steps,
+                        dtype=np.float64) ** 2
+    return np.cumprod(1.0 - betas)
+
+
+def _strided_timesteps(num_steps: int, num_train_steps: int = 1000
+                       ) -> np.ndarray:
+    stride = num_train_steps // num_steps
+    return (np.arange(num_steps) * stride)[::-1].astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class EulerSchedule:
+    """k-diffusion sigma ladder; x evolves in k-space (x_vp * sqrt(1+s²))."""
+
+    timesteps: jnp.ndarray   # (T,) int32 descending
+    sigmas: jnp.ndarray      # (T+1,) float32, sigmas[-1] == 0
+
+    @staticmethod
+    def create(num_steps: int) -> "EulerSchedule":
+        ab = _alpha_bars()
+        ts = _strided_timesteps(num_steps)
+        sig = np.sqrt((1.0 - ab[ts]) / ab[ts])
+        sig = np.concatenate([sig, [0.0]]).astype(np.float32)
+        return EulerSchedule(timesteps=jnp.asarray(ts),
+                             sigmas=jnp.asarray(sig))
+
+
+def euler_sample(
+    denoise: Callable[[jax.Array, jax.Array], jax.Array],
+    latents: jax.Array,
+    schedule: EulerSchedule,
+) -> jax.Array:
+    """Deterministic Euler solver over the k-diffusion ODE.
+
+    ``latents`` is standard normal (VP convention, same as ddim_sample);
+    scaling by sigma_max happens here. Returns VP-space x_0 latents.
+    """
+    x = latents * schedule.sigmas[0]
+
+    def step(x, per_step):
+        t, sigma, sigma_next = per_step
+        x_vp = x / jnp.sqrt(1.0 + sigma * sigma)
+        eps = denoise(x_vp, t)
+        # k-diffusion derivative for eps-prediction is eps itself
+        x = x + (sigma_next - sigma) * eps
+        return x, None
+
+    final, _ = jax.lax.scan(
+        step, x,
+        (schedule.timesteps, schedule.sigmas[:-1], schedule.sigmas[1:]),
+    )
+    return final  # sigma -> 0 lands in VP space already
+
+
+@dataclasses.dataclass(frozen=True)
+class DPMppSchedule:
+    """DPM-Solver++(2M) with all step math precomputed host-side.
+
+    Update (data-prediction form): x <- c_skip·x + c_d0·m0 + c_d1·m1
+    where m0/m1 are this/previous step's predicted x0. First and last
+    steps are first-order (c_d1 = 0) — the standard multistep warmup and
+    ``lower_order_final`` boundary handling, which also keeps every
+    coefficient finite (the final step's h is infinite only in the
+    analytic form; here it resolves to c_skip=0, c_d0=1).
+    """
+
+    timesteps: jnp.ndarray  # (T,) int32 descending
+    alphas: jnp.ndarray     # (T,) sqrt(abar) at each step (for x0 recovery)
+    sigmas: jnp.ndarray     # (T,) sqrt(1-abar)
+    c_skip: jnp.ndarray     # (T,)
+    c_d0: jnp.ndarray       # (T,)
+    c_d1: jnp.ndarray       # (T,)
+
+    @staticmethod
+    def create(num_steps: int) -> "DPMppSchedule":
+        ab = _alpha_bars()
+        ts = _strided_timesteps(num_steps)
+        alpha = np.sqrt(ab[ts])
+        sigma = np.sqrt(1.0 - ab[ts])
+        # targets: step i maps state at ts[i] -> ts[i+1] (final -> clean)
+        alpha_next = np.concatenate([alpha[1:], [1.0]])
+        sigma_next = np.concatenate([sigma[1:], [0.0]])
+        lam = np.log(alpha) - np.log(sigma)
+        with np.errstate(divide="ignore"):
+            lam_next = np.log(alpha_next) - np.log(
+                np.where(sigma_next > 0, sigma_next, 1e-300)
+            )
+        h = lam_next - lam                       # (T,), last is huge/inf
+        h_prev = np.concatenate([[np.nan], h[:-1]])
+        em1 = np.where(np.isfinite(h), np.expm1(-h), -1.0)  # exp(-h)-1
+
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratio = h_prev / h                  # r0 in the 2M formula
+            inv2r = np.where(np.isfinite(ratio), ratio / 2.0, 0.0)
+        first_order = np.zeros(len(ts), dtype=bool)
+        first_order[0] = True                    # multistep warmup
+        first_order[-1] = True                   # lower_order_final
+        inv2r = np.where(first_order, 0.0, inv2r)
+
+        c_skip = np.where(sigma > 0, sigma_next / sigma, 0.0)
+        c_d0 = -alpha_next * em1 * (1.0 + inv2r)
+        c_d1 = alpha_next * em1 * inv2r
+        f32 = lambda a: jnp.asarray(a.astype(np.float32))  # noqa: E731
+        return DPMppSchedule(
+            timesteps=jnp.asarray(ts), alphas=f32(alpha), sigmas=f32(sigma),
+            c_skip=f32(c_skip), c_d0=f32(c_d0), c_d1=f32(c_d1),
+        )
+
+
+def dpmpp_2m_sample(
+    denoise: Callable[[jax.Array, jax.Array], jax.Array],
+    latents: jax.Array,
+    schedule: DPMppSchedule,
+) -> jax.Array:
+    """DPM-Solver++(2M): 2nd-order multistep in data-prediction form.
+
+    ``latents`` standard normal; x stays in VP space throughout.
+    """
+
+    def step(carry, per_step):
+        x, m1 = carry
+        t, alpha, sigma, c_skip, c_d0, c_d1 = per_step
+        eps = denoise(x, t)
+        m0 = (x - sigma * eps) / alpha
+        x = c_skip * x + c_d0 * m0 + c_d1 * m1
+        return (x, m0), None
+
+    (final, _), _ = jax.lax.scan(
+        step, (latents, jnp.zeros_like(latents)),
+        (schedule.timesteps, schedule.alphas, schedule.sigmas,
+         schedule.c_skip, schedule.c_d0, schedule.c_d1),
+    )
+    return final
+
+
+def make_sampler(kind: str, num_steps: int, eta: float = 0.0):
+    """(kind, steps) -> ``sample(denoise, latents, rng) -> x0 latents``.
+
+    ``latents`` standard normal in every case, so pipelines switch
+    samplers by config without touching their latent setup.
+    """
+    if kind == "ddim":
+        schedule = DDIMSchedule.create(num_steps)
+
+        def sample(denoise, latents, rng=None):
+            return ddim_sample(denoise, latents, schedule, eta=eta, rng=rng)
+
+        return sample
+    if kind == "euler":
+        eschedule = EulerSchedule.create(num_steps)
+
+        def sample(denoise, latents, rng=None):
+            return euler_sample(denoise, latents, eschedule)
+
+        return sample
+    if kind == "dpmpp_2m":
+        dschedule = DPMppSchedule.create(num_steps)
+
+        def sample(denoise, latents, rng=None):
+            return dpmpp_2m_sample(denoise, latents, dschedule)
+
+        return sample
+    raise ValueError(f"unknown sampler kind {kind!r}; "
+                     f"choose from {SAMPLER_KINDS}")

@@ -29,10 +29,10 @@ TP_RULES: List[Tuple[str, P]] = [
     (r".*/(self_attn|cross_attn|attn)/(q|k|v)/kernel$", P(None, "tp")),
     (r".*/(self_attn|cross_attn|attn)/(q|k|v)/bias$", P("tp")),
     (r".*/(self_attn|cross_attn|attn)/out/kernel$", P("tp", None)),
-    # MLP / GEGLU
-    (r".*/(mlp|ff)/(fc1|proj)/kernel$", P(None, "tp")),
-    (r".*/(mlp|ff)/(fc1|proj)/bias$", P("tp")),
-    (r".*/(mlp|ff)/(fc2|out)/kernel$", P("tp", None)),
+    # MLP / GEGLU / SwiGLU (Mistral gate+up shard columns, down rows)
+    (r".*/(mlp|ff)/(fc1|proj|gate|up)/kernel$", P(None, "tp")),
+    (r".*/(mlp|ff)/(fc1|proj|gate|up)/bias$", P("tp")),
+    (r".*/(mlp|ff)/(fc2|out|down)/kernel$", P("tp", None)),
     # everything else replicated
     (r".*", P()),
 ]

@@ -38,11 +38,10 @@ from cassmantle_tpu.utils.compile_cache import (
     param_cache_path,
 )
 from cassmantle_tpu.ops.ddim import (
-    DDIMSchedule,
-    ddim_sample,
     initial_latents,
     make_cfg_denoiser,
 )
+from cassmantle_tpu.ops.samplers import make_sampler
 from cassmantle_tpu.ops.decode import greedy_decode
 from cassmantle_tpu.utils.logging import get_logger, metrics
 from cassmantle_tpu.utils.profiling import annotate
@@ -152,7 +151,9 @@ class Text2ImagePipeline:
                 cache_path=param_cache_path(
                     f"vae{cfg.sampler.image_size}", m.vae))
         )
-        self.schedule = DDIMSchedule.create(cfg.sampler.num_steps)
+        self.sample_latents = make_sampler(
+            cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
+        )
         # Params enter the jit as ARGUMENTS (device buffers), never as
         # captured constants — capturing bakes ~4 GB of weights into the
         # HLO, blowing up compile payloads (fatal through a remote-compile
@@ -171,9 +172,8 @@ class Text2ImagePipeline:
         )
         lat = initial_latents(rng, ids.shape[0], self.cfg.sampler.image_size,
                               self.vae_scale)
-        with annotate("ddim_scan"):
-            final = ddim_sample(denoise, lat, self.schedule,
-                                eta=self.cfg.sampler.eta)
+        with annotate("denoise_scan"):
+            final = self.sample_latents(denoise, lat)
         with annotate("vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)
@@ -198,34 +198,56 @@ class Text2ImagePipeline:
 
 
 class PromptGenerator:
-    """Story-episode text generation: GPT-2 greedy decode, bucketed."""
+    """Story-episode text generation: greedy decode, bucketed.
+
+    The LM family is config-selected: GPT-2 by default, or a
+    Mistral-7B-class model (RoPE/GQA/sliding-window — the reference's
+    actual prompt model, backend.py:25) when ``cfg.models.mistral`` is
+    set. Both expose the same prefill/decode_step contract, so the scan
+    in ops/decode.py drives either."""
 
     PROMPT_BUCKETS = (32, 64, 128, 256)
 
     def __init__(self, cfg: FrameworkConfig,
                  weights_dir: Optional[str] = None) -> None:
+        from cassmantle_tpu.models.mistral import MistralLM
+        from cassmantle_tpu.models.weights import convert_mistral
+
         enable_compile_cache()
-        m = cfg.models.gpt2
         self.cfg = cfg
-        self.model = GPT2LM(m)
-        self.tokenizer = load_tokenizer(weights_dir, "gpt2", m.vocab_size)
+        if cfg.models.mistral is not None:
+            m = cfg.models.mistral
+            self.model = MistralLM(m)
+            self.tokenizer = load_tokenizer(
+                weights_dir, "mistral", m.vocab_size
+            )
+            loader = ("mistral.safetensors",
+                      lambda t: convert_mistral(t, m.num_layers), "mistral")
+        else:
+            m = cfg.models.gpt2
+            self.model = GPT2LM(m)
+            self.tokenizer = load_tokenizer(weights_dir, "gpt2", m.vocab_size)
+            loader = ("gpt2.safetensors",
+                      lambda t: convert_gpt2(t, m.num_layers, m.hidden_size),
+                      "gpt2")
+        self.mcfg = m
         ids = jnp.zeros((1, 8), dtype=jnp.int32)
         self.params = (
-            maybe_load(weights_dir, "gpt2.safetensors",
-                       lambda t: convert_gpt2(t, m.num_layers, m.hidden_size),
-                       "gpt2", cast_to=cfg.models.param_dtype)
+            maybe_load(weights_dir, loader[0], loader[1], loader[2],
+                       cast_to=cfg.models.param_dtype)
             or init_params_cached(
                 self.model, 5, ids,
-                cache_path=param_cache_path("gpt2", m),
+                cache_path=param_cache_path(loader[2], m),
                 cast_to=cfg.models.param_dtype)
         )
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
+        cls = type(self.model)
         self._prefill = lambda p, ids_, len_, max_len: self.model.apply(
-            p, ids_, len_, max_len, method=GPT2LM.prefill
+            p, ids_, len_, max_len, method=cls.prefill
         )
         self._step = lambda p, tok, idx, cache, valid: self.model.apply(
-            p, tok, idx, cache, valid, method=GPT2LM.decode_step
+            p, tok, idx, cache, valid, method=cls.decode_step
         )
 
     def decode_ids(self, seed_text: str,
@@ -234,7 +256,7 @@ class PromptGenerator:
         prefill + cached decode; returns (tokens (1, max_new), gen_len
         (1,)). The serving path and the benchmark both use this, so they
         measure the same computation."""
-        m = self.cfg.models.gpt2
+        m = self.mcfg
         max_new = max_new_tokens or self.cfg.sampler.max_new_tokens
         toks = self.tokenizer.encode(seed_text)
         limit = m.max_positions - max_new - 1

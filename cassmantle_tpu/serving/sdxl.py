@@ -42,11 +42,10 @@ from cassmantle_tpu.models.weights import (
     maybe_load,
 )
 from cassmantle_tpu.ops.ddim import (
-    DDIMSchedule,
-    ddim_sample,
     initial_latents,
     make_cfg_denoiser,
 )
+from cassmantle_tpu.ops.samplers import make_sampler
 from cassmantle_tpu.utils.compile_cache import (
     enable_compile_cache,
     param_cache_path,
@@ -143,7 +142,9 @@ class SDXLPipeline:
                 cache_path=param_cache_path(
                     f"vae_xl{cfg.sampler.image_size}", m.vae))
         )
-        self.schedule = DDIMSchedule.create(cfg.sampler.num_steps)
+        self.sample_latents = make_sampler(
+            cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
+        )
         # Params are jit ARGUMENTS (device buffers), not captured constants
         # (see Text2ImagePipeline note on compile payloads).
         self._params = {
@@ -192,9 +193,8 @@ class SDXLPipeline:
         )
         lat = initial_latents(rng, b, self.cfg.sampler.image_size,
                               self.vae_scale)
-        with annotate("sdxl_ddim_scan"):
-            final = ddim_sample(denoise, lat, self.schedule,
-                                eta=self.cfg.sampler.eta)
+        with annotate("sdxl_denoise_scan"):
+            final = self.sample_latents(denoise, lat)
         with annotate("sdxl_vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)

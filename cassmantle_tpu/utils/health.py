@@ -20,12 +20,20 @@ from cassmantle_tpu.utils.logging import get_logger, metrics
 log = get_logger("health")
 
 
+_probe_jit = None
+
+
 def _probe_once() -> bool:
     import jax
     import jax.numpy as jnp
 
+    # One process-wide jitted probe: a fresh lambda per call would miss
+    # the jit cache (identity-keyed) and re-trace/compile every probe.
+    global _probe_jit
+    if _probe_jit is None:
+        _probe_jit = jax.jit(lambda v: (v * 2.0).sum())
     x = jnp.arange(8, dtype=jnp.float32)
-    y = jax.jit(lambda v: (v * 2.0).sum())(x)
+    y = _probe_jit(x)
     return float(jax.block_until_ready(y)) == 56.0
 
 
@@ -36,6 +44,7 @@ class _Probe:
     def __init__(self) -> None:
         self.done = threading.Event()
         self.ok = False
+        self.started_at = time.monotonic()
         threading.Thread(
             target=self._run, daemon=True, name="device-probe"
         ).start()
@@ -71,7 +80,16 @@ class DeviceHealth:
             age = time.monotonic() - self._checked_at
             if self._healthy is not None and age < self.cache_s:
                 return self._healthy, age
-            if self._inflight is None:
+            stale = (
+                self._inflight is not None
+                and not self._inflight.done.is_set()
+                and time.monotonic() - self._inflight.started_at
+                > 2 * self.timeout_s
+            )
+            if self._inflight is None or stale:
+                # a probe hung past its deadline is disowned (daemon
+                # thread) and replaced, so a device that RECOVERS is
+                # re-detected instead of being pinned unhealthy forever
                 self._inflight = _Probe()
             probe = self._inflight
         if probe.done.wait(timeout=self.timeout_s):

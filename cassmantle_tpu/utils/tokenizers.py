@@ -189,6 +189,119 @@ class BPETokenizer(Tokenizer):
 
 
 # ---------------------------------------------------------------------------
+# SentencePiece-BPE (Llama/Mistral family)
+# ---------------------------------------------------------------------------
+
+class SentencePieceBPETokenizer(Tokenizer):
+    """SentencePiece-style BPE: ▁ word-boundary markers + <0xXX> byte
+    fallback, loaded from an HF ``tokenizer.json`` (plain JSON — no
+    sentencepiece/tokenizers wheel needed). Covers the Mistral/Llama vocab
+    format for models/mistral.py."""
+
+    WORD_MARK = "▁"  # ▁
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: List[Tuple[str, str]]) -> None:
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.vocab_size = max(vocab.values()) + 1
+        self.unk_id = vocab.get("<unk>", 0)
+        self.bos_id = vocab.get("<s>", 1)
+        self.eos_id = vocab.get("</s>", 2)
+        self.pad_id = self.eos_id
+        self._byte_ids = {
+            b: vocab[f"<0x{b:02X}>"]
+            for b in range(256) if f"<0x{b:02X}>" in vocab
+        }
+        self._cache: Dict[str, Tuple[str, ...]] = {}
+
+    @staticmethod
+    def from_file(tokenizer_json: str) -> "SentencePieceBPETokenizer":
+        with open(tokenizer_json) as f:
+            spec = json.load(f)
+        model = spec["model"]
+        vocab = dict(model["vocab"])
+        merges = []
+        for m in model.get("merges", []):
+            pair = tuple(m.split(" ")) if isinstance(m, str) else tuple(m)
+            if len(pair) == 2:
+                merges.append(pair)
+        for tok in spec.get("added_tokens", []):
+            vocab.setdefault(tok["content"], tok["id"])
+        return SentencePieceBPETokenizer(vocab, merges)
+
+    def _encode_word(self, word: str) -> List[int]:
+        """word (already ▁-prefixed) -> ids with byte fallback."""
+        if word in self._cache:
+            symbols = self._cache[word]
+        else:
+            symbols = _bpe_merge(tuple(word), self.ranks)
+            self._cache[word] = symbols
+        ids: List[int] = []
+        for s in symbols:
+            if s in self.vocab:
+                ids.append(self.vocab[s])
+            elif self._byte_ids:
+                ids.extend(
+                    self._byte_ids.get(b, self.unk_id)
+                    for b in s.encode("utf-8")
+                )
+            else:
+                ids.append(self.unk_id)
+        return ids
+
+    def _byte_fallback(self, s: str) -> List[int]:
+        return [self._byte_ids.get(b, self.unk_id)
+                for b in s.encode("utf-8")] if self._byte_ids \
+            else [self.unk_id]
+
+    def encode(self, text: str) -> List[int]:
+        import re
+
+        ids = [self.bos_id]
+        # words get a ▁ mark when preceded by a space (or start-of-text,
+        # SentencePiece's add_dummy_prefix); non-space whitespace
+        # (\n, \t, ...) is structure the model saw in training — encode it
+        # via byte fallback rather than silently dropping it
+        prev_end, prev_char = 0, " "
+        for m in re.finditer(r"[^\s]+|[^\S ]", text):
+            if m.start() > prev_end:
+                prev_char = text[m.start() - 1]
+            chunk = m.group(0)
+            if chunk.strip():
+                marked = prev_char == " " or m.start() == 0
+                ids.extend(self._encode_word(
+                    (self.WORD_MARK if marked else "") + chunk
+                ))
+            else:
+                ids.extend(self._byte_fallback(chunk))
+            prev_end, prev_char = m.end(), chunk[-1]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out: List[str] = []
+        pending: List[int] = []  # byte-fallback run
+
+        def flush():
+            if pending:
+                out.append(bytes(pending).decode("utf-8", errors="ignore"))
+                pending.clear()
+
+        for i in ids:
+            tok = self.inv_vocab.get(int(i), "")
+            if tok.startswith("<0x") and tok.endswith(">") and len(tok) == 6:
+                pending.append(int(tok[3:5], 16))
+                continue
+            flush()
+            if tok in ("<s>", "</s>", "<unk>", "<pad>"):
+                continue
+            out.append(tok.replace(self.WORD_MARK, " "))
+        flush()
+        return "".join(out).strip()
+
+
+# ---------------------------------------------------------------------------
 # WordPiece (BERT / MiniLM)
 # ---------------------------------------------------------------------------
 
@@ -260,8 +373,9 @@ class WordPieceTokenizer(Tokenizer):
 def load_tokenizer(
     weights_dir: Optional[str], kind: str, vocab_size: int
 ) -> Tokenizer:
-    """kind in {'gpt2', 'clip', 'minilm'}; byte fallback when artifacts are
-    missing (always the case under zero egress with no baked checkpoints)."""
+    """kind in {'gpt2', 'clip', 'minilm', 'mistral'}; byte fallback when
+    artifacts are missing (always the case under zero egress with no baked
+    checkpoints)."""
     if weights_dir:
         if kind in ("gpt2", "clip"):
             vocab = os.path.join(weights_dir, f"{kind}_vocab.json")
@@ -272,4 +386,8 @@ def load_tokenizer(
             vocab_txt = os.path.join(weights_dir, "minilm_vocab.txt")
             if os.path.exists(vocab_txt):
                 return WordPieceTokenizer.from_file(vocab_txt)
+        if kind == "mistral":
+            tok_json = os.path.join(weights_dir, "mistral_tokenizer.json")
+            if os.path.exists(tok_json):
+                return SentencePieceBPETokenizer.from_file(tok_json)
     return ByteTokenizer(max(vocab_size, 259))

@@ -188,3 +188,41 @@ def test_scorer_batch_padding_consistency(scorer):
 
 def test_scorer_empty(scorer):
     assert scorer.similarity([]).shape == (0,)
+
+
+def test_sentencepiece_bpe_tokenizer(tmp_path):
+    """SentencePiece-BPE (Mistral vocab format): ▁ word marks, merges,
+    byte fallback, tokenizer.json loading."""
+    import json
+
+    from cassmantle_tpu.utils.tokenizers import SentencePieceBPETokenizer
+
+    W = SentencePieceBPETokenizer.WORD_MARK
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for b in range(256):
+        vocab[f"<0x{b:02X}>"] = len(vocab)
+    for piece in (W, "l", "o", "w", W + "l", W + "lo", W + "low", "er"):
+        vocab[piece] = len(vocab)
+    merges = [(W, "l"), (W + "l", "o"), (W + "lo", "w"), ("e", "r")]
+    spec = {"model": {"type": "BPE", "vocab": vocab,
+                      "merges": [" ".join(m) for m in merges]},
+            "added_tokens": [{"content": "<s>", "id": 1}]}
+    path = tmp_path / "tokenizer.json"
+    path.write_text(json.dumps(spec))
+
+    t = SentencePieceBPETokenizer.from_file(str(path))
+    ids = t.encode("low low")
+    assert ids[0] == t.bos_id
+    assert ids[1:] == [vocab[W + "low"], vocab[W + "low"]]
+    assert t.decode(ids) == "low low"
+    # byte fallback: 'z' has no piece -> UTF-8 byte token, decode restores
+    ids_z = t.encode("z")
+    assert t.decode(ids_z) == "z"
+    assert all(i != t.unk_id for i in ids_z[1:])
+    # newlines survive round-trip via byte fallback (not dropped), and a
+    # word after \n carries no ▁ mark
+    ids_nl = t.encode("low\nlow")
+    assert t.decode(ids_nl) == "low\nlow"
+    assert vocab["<0x0A>"] in ids_nl
+    assert ids_nl[1:] == [vocab[W + "low"], vocab["<0x0A>"],
+                          vocab["l"], vocab["o"], vocab["w"]]

@@ -1,0 +1,120 @@
+"""Sampler family tests (ops/samplers.py): Euler and DPM-Solver++(2M)
+against the DDIM baseline.
+
+Key correctness property: for the probability-flow ODE with a *consistent*
+epsilon field — denoise(x_t, t) returning exactly the eps that places x_t
+on the trajectory of a fixed x0 — every solver must recover x0 (the ODE's
+solution keeps x0 invariant). This validates coefficients, spacing, and
+VP/k-space conversions without any model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cassmantle_tpu.ops.ddim import DDIMSchedule
+from cassmantle_tpu.ops.samplers import (
+    SAMPLER_KINDS,
+    DPMppSchedule,
+    EulerSchedule,
+    _alpha_bars,
+    make_sampler,
+)
+
+X0 = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 3))
+AB = jnp.asarray(_alpha_bars().astype(np.float32))
+
+
+def consistent_denoise(x, t):
+    """eps such that x = sqrt(ab)*x0 + sqrt(1-ab)*eps."""
+    ab = AB[t]
+    return (x - jnp.sqrt(ab) * X0) / jnp.sqrt(1.0 - ab)
+
+
+@pytest.mark.parametrize("kind", SAMPLER_KINDS)
+def test_solver_recovers_x0_under_consistent_field(kind):
+    sample = make_sampler(kind, 25)
+    noise = jax.random.normal(jax.random.PRNGKey(1), X0.shape)
+    out = sample(consistent_denoise, noise)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(X0),
+                               atol=5e-2, rtol=5e-2)
+
+
+@pytest.mark.parametrize("kind", ("euler", "dpmpp_2m"))
+def test_solver_jits_and_is_deterministic(kind):
+    sample = make_sampler(kind, 8)
+    noise = jax.random.normal(jax.random.PRNGKey(2), X0.shape)
+    f = jax.jit(lambda n: sample(consistent_denoise, n))
+    a, b = f(noise), f(noise)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(np.asarray(a)).all()
+
+
+def test_dpmpp_schedule_coefficients_finite():
+    s = DPMppSchedule.create(20)
+    for name in ("alphas", "sigmas", "c_skip", "c_d0", "c_d1"):
+        arr = np.asarray(getattr(s, name))
+        assert np.isfinite(arr).all(), name
+    # final step: c_skip 0 (sigma_next = 0), first-order (c_d1 = 0)
+    assert np.asarray(s.c_skip)[-1] == 0.0
+    assert np.asarray(s.c_d1)[-1] == 0.0
+    assert np.asarray(s.c_d1)[0] == 0.0  # multistep warmup
+
+
+def test_euler_schedule_monotone():
+    s = EulerSchedule.create(30)
+    sig = np.asarray(s.sigmas)
+    assert sig[-1] == 0.0
+    assert (np.diff(sig) < 0).all()
+    assert len(np.asarray(s.timesteps)) == 30
+
+
+def curved_denoise(x, t):
+    """eps field with t-dependent curvature (the consistent field is exact
+    for every solver, so order-of-accuracy needs a curved target)."""
+    ab = AB[t]
+    x0_t = X0 * (1.0 + 0.3 * jnp.sin(t.astype(jnp.float32) / 150.0))
+    return (x - jnp.sqrt(ab) * x0_t) / jnp.sqrt(1.0 - ab)
+
+
+def test_solvers_converge_to_common_limit_with_order():
+    """All solvers approach the same ODE solution as steps grow, and the
+    2nd-order multistep beats 1st-order Euler at equal low step count."""
+    noise = jax.random.normal(jax.random.PRNGKey(3), X0.shape)
+    ref = make_sampler("ddim", 500)(curved_denoise, noise)
+
+    def err(kind, steps):
+        out = make_sampler(kind, steps)(curved_denoise, noise)
+        return float(jnp.abs(out - ref).max())
+
+    # convergence: error shrinks with more steps
+    assert err("dpmpp_2m", 50) < err("dpmpp_2m", 10)
+    assert err("euler", 50) < err("euler", 10)
+    # order: 2nd-order multistep beats Euler at 10 steps
+    assert err("dpmpp_2m", 10) < err("euler", 10)
+    # all three agree at 50 steps to reasonable tolerance
+    assert err("euler", 50) < 0.15 and err("dpmpp_2m", 50) < 0.15
+
+
+def test_make_sampler_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_sampler("plms", 10)
+
+
+def test_pipeline_runs_with_each_sampler():
+    """Tiny end-to-end: Text2ImagePipeline under each sampler kind."""
+    import dataclasses
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    base = test_config()
+    for kind in ("euler", "dpmpp_2m"):
+        cfg = base.replace(
+            sampler=dataclasses.replace(base.sampler, kind=kind)
+        )
+        pipe = Text2ImagePipeline(cfg)
+        imgs = pipe.generate(["a red lighthouse"], seed=1)
+        assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
+        assert np.isfinite(imgs.astype(np.float32)).all()

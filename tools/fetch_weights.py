@@ -48,6 +48,17 @@ SOURCES = {
     "clip_merges.txt": ("openai/clip-vit-large-patch14", "merges.txt"),
     "minilm_vocab.txt": (
         "sentence-transformers/all-MiniLM-L6-v2", "vocab.txt"),
+    # Mistral-7B-Instruct (models/mistral.py) — the reference's actual
+    # prompt LLM (backend.py:25). Sharded checkpoint: fetch both shards;
+    # load_safetensors callers merge dicts.
+    "mistral-00001.safetensors": (
+        "mistralai/Mistral-7B-Instruct-v0.1",
+        "model-00001-of-00002.safetensors"),
+    "mistral-00002.safetensors": (
+        "mistralai/Mistral-7B-Instruct-v0.1",
+        "model-00002-of-00002.safetensors"),
+    "mistral_tokenizer.json": (
+        "mistralai/Mistral-7B-Instruct-v0.1", "tokenizer.json"),
     # SDXL-base (serving/sdxl.py): second text tower + XL UNet/VAE
     "clip_text_2.safetensors": (
         "stabilityai/stable-diffusion-xl-base-1.0",
