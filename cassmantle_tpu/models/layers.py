@@ -21,6 +21,15 @@ import jax.numpy as jnp
 from cassmantle_tpu.ops.attention import multi_head_attention
 
 
+def nearest_upsample_2x(x: jax.Array) -> jax.Array:
+    """2x nearest-neighbor upsample via broadcast+reshape (pure data
+    movement XLA fuses well; jax.image.resize lowers to gathers, which
+    the TPU executes much more slowly)."""
+    b, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (b, h, 2, w, 2, c))
+    return x.reshape(b, h * 2, w * 2, c)
+
+
 def timestep_embedding(
     timesteps: jax.Array, dim: int, max_period: float = 10000.0
 ) -> jax.Array:
@@ -137,18 +146,90 @@ def quick_gelu(x):
     return x * jax.nn.sigmoid(1.702 * x)
 
 
+class LayerNorm32(nn.Module):
+    """LayerNorm with fp32 statistics applied in the activation dtype.
+
+    ``nn.LayerNorm(dtype=fp32)`` on a bf16 tensor casts the whole tensor
+    up and back, doubling elementwise HBM traffic per norm — with 3 norms
+    per transformer block this is real money on the UNet's token tensors.
+    Stats (mean/var) reduce in fp32; the affine applies as one FMA in the
+    input dtype. Param layout matches nn.LayerNorm (scale/bias (C,)).
+    """
+
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True) \
+            - jnp.square(mean)
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        scale32 = scale.astype(jnp.float32)
+        a = (inv * scale32).astype(x.dtype)
+        b = (bias.astype(jnp.float32) - (mean * inv) * scale32
+             ).astype(x.dtype)
+        return x * a + b
+
+
+class _GroupNormCore(nn.Module):
+    """GroupNorm with fp32 statistics and activation-dtype application.
+
+    The straightforward ``cast-to-fp32 -> nn.GroupNorm -> cast-back``
+    doubles elementwise HBM traffic on the UNet's biggest tensors and the
+    cast boundaries block XLA fusion; at SD1.5-512 the UNet step is
+    memory-bound (23 GB accessed/step), so this matters. Here only the
+    mean/var *reductions* run in fp32; the normalize folds into one
+    multiply-add applied in the input dtype:
+
+        out = x * a + b,  a = inv*scale,  b = bias - mean*inv*scale
+
+    with ``a``/``b`` computed in fp32 at (B, G|C) size — numerically the
+    sensitive part — then cast once. Param layout matches nn.GroupNorm
+    (scale/bias of shape (C,)) so checkpoints load unchanged.
+    """
+
+    num_groups: int
+    epsilon: float
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        g = self.num_groups
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+
+        spatial = x.shape[1:-1]
+        xg = x.reshape(x.shape[0], -1, g, c // g)
+        x32 = xg.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(1, 3))                    # (B, G)
+        var = jnp.mean(jnp.square(x32), axis=(1, 3)) - jnp.square(mean)
+        inv = jax.lax.rsqrt(var + self.epsilon)              # (B, G)
+
+        # per-(batch, channel) affine in fp32, one cast, one fused FMA
+        inv_c = jnp.repeat(inv, c // g, axis=-1)             # (B, C)
+        mean_c = jnp.repeat(mean, c // g, axis=-1)
+        a = inv_c * scale.astype(jnp.float32)[None, :]
+        b = bias.astype(jnp.float32)[None, :] - mean_c * a
+        shape = (x.shape[0],) + (1,) * len(spatial) + (c,)
+        a = a.reshape(shape).astype(x.dtype)
+        b = b.reshape(shape).astype(x.dtype)
+        return x * a + b
+
+
 class GroupNorm32(nn.Module):
-    """GroupNorm computed in fp32 regardless of module dtype (diffusion
-    UNets are numerically sensitive here)."""
+    """GroupNorm with fp32 statistics (diffusion UNets are numerically
+    sensitive here) applied in the activation dtype — see _GroupNormCore.
+    Nests the core under ``norm`` to keep the nn.GroupNorm param paths."""
 
     num_groups: int = 32
     epsilon: float = 1e-5
 
     @nn.compact
     def __call__(self, x):
-        orig_dtype = x.dtype
-        out = nn.GroupNorm(
-            num_groups=self.num_groups, epsilon=self.epsilon,
-            dtype=jnp.float32, name="norm",
-        )(x.astype(jnp.float32))
-        return out.astype(orig_dtype)
+        return _GroupNormCore(
+            num_groups=self.num_groups, epsilon=self.epsilon, name="norm"
+        )(x)

@@ -32,7 +32,9 @@ from cassmantle_tpu.config import UNetConfig
 from cassmantle_tpu.models.layers import (
     GEGLU,
     GroupNorm32,
+    LayerNorm32,
     MultiHeadAttention,
+    nearest_upsample_2x,
     timestep_embedding,
 )
 
@@ -67,17 +69,17 @@ class BasicTransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, context):
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = LayerNorm32(name="ln1")(x)
         x = x + MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype, use_bias=False,
             name="self_attn",
         )(h)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = LayerNorm32(name="ln2")(x)
         x = x + MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype, use_bias=False,
             name="cross_attn",
         )(h, context=context)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln3")(x)
+        h = LayerNorm32(name="ln3")(x)
         x = x + GEGLU(
             intermediate=x.shape[-1] * 4, dtype=self.dtype, name="ff"
         )(h)
@@ -194,8 +196,7 @@ class UNet(nn.Module):
                         name=f"up_{lvl}_attn_{blk}",
                     )(x, context)
             if lvl != 0:
-                b, h, w, c = x.shape
-                x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                x = nearest_upsample_2x(x)
                 x = nn.Conv(ch, (3, 3), padding=1, dtype=dtype,
                             name=f"up_{lvl}_upsample")(x)
 

@@ -18,7 +18,11 @@ import jax
 import jax.numpy as jnp
 
 from cassmantle_tpu.config import VAEConfig
-from cassmantle_tpu.models.layers import GroupNorm32, MultiHeadAttention
+from cassmantle_tpu.models.layers import (
+    GroupNorm32,
+    MultiHeadAttention,
+    nearest_upsample_2x,
+)
 
 
 class VAEResBlock(nn.Module):
@@ -79,8 +83,7 @@ class VAEDecoder(nn.Module):
             for blk in range(cfg.blocks_per_level + 1):
                 x = VAEResBlock(ch, dtype, name=f"up_{lvl}_res_{blk}")(x)
             if lvl != 0:
-                b, h, w, c = x.shape
-                x = jax.image.resize(x, (b, h * 2, w * 2, c), "nearest")
+                x = nearest_upsample_2x(x)
                 x = nn.Conv(ch, (3, 3), padding=1, dtype=dtype,
                             name=f"up_{lvl}_upsample")(x)
 

@@ -24,6 +24,33 @@ import jax.numpy as jnp
 from cassmantle_tpu.config import SamplerConfig
 
 
+def alpha_bars_full(
+    num_train_steps: int = 1000,
+    beta_start: float = 0.00085,
+    beta_end: float = 0.012,
+):
+    """ᾱ_t for SD's scaled-linear beta schedule, fp64 numpy (host-side).
+
+    The single source of the schedule constants — every sampler kind
+    (DDIM here, Euler/DPM++ in ops/samplers.py) derives from this so
+    they all integrate the same discretization of the same ODE.
+    """
+    import numpy as np
+
+    betas = np.linspace(beta_start**0.5, beta_end**0.5, num_train_steps,
+                        dtype=np.float64) ** 2
+    return np.cumprod(1.0 - betas)
+
+
+def strided_timesteps(num_steps: int, num_train_steps: int = 1000):
+    """Descending int32 inference timesteps, diffusers "leading" spacing
+    (t = i·stride)."""
+    import numpy as np
+
+    stride = num_train_steps // num_steps
+    return (np.arange(num_steps) * stride)[::-1].astype(np.int32)
+
+
 @dataclasses.dataclass(frozen=True)
 class DDIMSchedule:
     """Precomputed per-inference-step coefficients (host-side, tiny)."""
@@ -39,23 +66,19 @@ class DDIMSchedule:
         beta_start: float = 0.00085,
         beta_end: float = 0.012,
     ) -> "DDIMSchedule":
-        betas = (
-            jnp.linspace(
-                beta_start**0.5, beta_end**0.5, num_train_steps,
-                dtype=jnp.float32,
-            )
-            ** 2
+        import numpy as np
+
+        ab_full = alpha_bars_full(num_train_steps, beta_start, beta_end)
+        ts = strided_timesteps(num_steps, num_train_steps)
+        ab = ab_full[ts].astype(np.float32)
+        ab_prev = np.concatenate(
+            [ab_full[ts[1:]], [1.0]]
+        ).astype(np.float32)
+        return DDIMSchedule(
+            timesteps=jnp.asarray(ts),
+            alpha_bars=jnp.asarray(ab),
+            alpha_bars_prev=jnp.asarray(ab_prev),
         )
-        alpha_bars_full = jnp.cumprod(1.0 - betas)
-        stride = num_train_steps // num_steps
-        # diffusers "leading" spacing: t = i*stride, descending at use time
-        ts = (jnp.arange(num_steps) * stride).astype(jnp.int32)[::-1]
-        ab = alpha_bars_full[ts]
-        ab_prev = jnp.concatenate(
-            [alpha_bars_full[ts[1:]], jnp.ones((1,), jnp.float32)]
-        )
-        return DDIMSchedule(timesteps=ts, alpha_bars=ab,
-                            alpha_bars_prev=ab_prev)
 
 
 def ddim_sample(

@@ -27,8 +27,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BLOCK_Q = 512
-BLOCK_K = 512
+# 1024-blocks measured ~2x faster than 512 at the UNet's level-0 site
+# (S=4096, d=40, bh=64) on v5e: fewer grid programs amortize the per-
+# program MXU setup over more work. (1024, 40)-bf16 q/k/v tiles plus two
+# (1024, 1024)-fp32 intermediates stay well inside VMEM.
+BLOCK_Q = 1024
+BLOCK_K = 1024
 MAX_HEAD_DIM = 256
 _NEG_INF = -1e30
 

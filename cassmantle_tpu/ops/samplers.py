@@ -12,8 +12,9 @@ on the same chip. All samplers here keep the DDIM contract from ops/ddim.py:
   are precomputed host-side into fixed-shape arrays (no data-dependent
   control flow, no recompiles per step).
 
-Schedules use SD's scaled-linear betas (ops/ddim.py) with trailing-uniform
-timestep spacing.
+Schedules use SD's scaled-linear betas with "leading" uniform timestep
+spacing (t = i·stride, the same spacing DDIMSchedule.create uses, so all
+sampler kinds integrate the same discretization of the same ODE).
 """
 
 from __future__ import annotations
@@ -25,22 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cassmantle_tpu.ops.ddim import DDIMSchedule, ddim_sample
+from cassmantle_tpu.ops.ddim import (
+    DDIMSchedule,
+    alpha_bars_full as _alpha_bars,
+    ddim_sample,
+    strided_timesteps as _strided_timesteps,
+)
 
 SAMPLER_KINDS = ("ddim", "euler", "dpmpp_2m")
-
-
-def _alpha_bars(num_train_steps: int = 1000, beta_start: float = 0.00085,
-                beta_end: float = 0.012) -> np.ndarray:
-    betas = np.linspace(beta_start**0.5, beta_end**0.5, num_train_steps,
-                        dtype=np.float64) ** 2
-    return np.cumprod(1.0 - betas)
-
-
-def _strided_timesteps(num_steps: int, num_train_steps: int = 1000
-                       ) -> np.ndarray:
-    stride = num_train_steps // num_steps
-    return (np.arange(num_steps) * stride)[::-1].astype(np.int32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,9 +117,10 @@ class DPMppSchedule:
         h_prev = np.concatenate([[np.nan], h[:-1]])
         em1 = np.where(np.isfinite(h), np.expm1(-h), -1.0)  # exp(-h)-1
 
+        # 2M correction weight 1/(2·r0) with r0 = h_prev/h, i.e. h/(2·h_prev)
         with np.errstate(divide="ignore", invalid="ignore"):
-            ratio = h_prev / h                  # r0 in the 2M formula
-            inv2r = np.where(np.isfinite(ratio), ratio / 2.0, 0.0)
+            inv2r = h / (2.0 * h_prev)
+            inv2r = np.where(np.isfinite(inv2r), inv2r, 0.0)
         first_order = np.zeros(len(ts), dtype=bool)
         first_order[0] = True                    # multistep warmup
         first_order[-1] = True                   # lower_order_final

@@ -62,6 +62,36 @@ def test_dpmpp_schedule_coefficients_finite():
     assert np.asarray(s.c_d1)[0] == 0.0  # multistep warmup
 
 
+def test_dpmpp_2m_interior_coefficients_match_formula():
+    """Regression for the 2M correction weight: for an interior step,
+    c_d0/c_d1 must equal the DPM-Solver++(2M) formula with weight
+    1/(2·r0), r0 = h_prev/h (computed independently here)."""
+    steps = 10
+    s = DPMppSchedule.create(steps)
+    ab = _alpha_bars()
+    ts = np.asarray(s.timesteps)
+    i = 5  # interior: not warmup, not final
+    a = np.sqrt(ab[ts])
+    sg = np.sqrt(1.0 - ab[ts])
+    lam = np.log(a) - np.log(sg)
+    a_next, sg_next = a[i + 1], sg[i + 1]
+    lam_next = np.log(a_next) - np.log(sg_next)
+    h = lam_next - lam[i]
+    h_prev = lam[i] - lam[i - 1]
+    r0 = h_prev / h
+    em1 = np.expm1(-h)
+    w = 1.0 / (2.0 * r0)
+    np.testing.assert_allclose(
+        float(np.asarray(s.c_d0)[i]), -a_next * em1 * (1.0 + w), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(np.asarray(s.c_d1)[i]), a_next * em1 * w, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(np.asarray(s.c_skip)[i]), sg_next / sg[i], rtol=1e-5
+    )
+
+
 def test_euler_schedule_monotone():
     s = EulerSchedule.create(30)
     sig = np.asarray(s.sigmas)
