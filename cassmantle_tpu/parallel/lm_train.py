@@ -1,0 +1,93 @@
+"""Distributed causal-LM training step (dp × tp over one mesh).
+
+The reference never trains its LLM — it rents Mistral-7B through the HF
+Inference API (reference backend.py:25, 240-268). A complete framework
+owns the other half of that model's lifecycle: fine-tuning the prompt LM
+(GPT-2 or the Mistral family — both expose the same ``__call__``) on
+story text. Design mirrors DiffusionTrainer (parallel/train.py):
+
+- **loss**: next-token cross-entropy, pad positions masked out; logits
+  computed fp32 by the models' LM heads for a stable softmax.
+- **dp**: batch sharded; GSPMD inserts the gradient all-reduce (ICI).
+- **tp**: attention q/k/v columns and MLP (fc/SwiGLU) kernels sharded
+  per parallel/sharding.py — the same rule table serves both families.
+- remat option recomputes the forward in backward (HBM for FLOPs);
+  ``donate_argnums`` updates params/opt state in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cassmantle_tpu.parallel.sharding import shard_params
+from cassmantle_tpu.parallel.train import make_optimizer
+
+
+def next_token_loss(logits: jax.Array, input_ids: jax.Array,
+                    loss_mask: jax.Array) -> jax.Array:
+    """Mean masked cross-entropy of logits[:, :-1] against ids[:, 1:]."""
+    targets = input_ids[:, 1:]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1].astype(jnp.float32), targets
+    )
+    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class LMTrainer:
+    """Owns sharded params/opt state and the compiled LM train step.
+
+    ``model`` is any module with ``__call__(input_ids, valid) -> logits``
+    — GPT2LM and MistralLM both qualify (models/gpt2.py, models/mistral.py).
+    """
+
+    def __init__(self, model, mesh: Mesh, lr: float = 3e-4,
+                 remat: bool = False) -> None:
+        self.model = model
+        self.mesh = mesh
+        self._apply = (jax.checkpoint(model.apply) if remat
+                       else model.apply)
+        self.optimizer = make_optimizer(lr)
+        self._step = jax.jit(self._train_step_impl, donate_argnums=(0, 1))
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, sample_ids: jax.Array, seed: int = 0
+                   ) -> Tuple[Any, Any]:
+        params = self.model.init(jax.random.PRNGKey(seed), sample_ids)
+        params = shard_params(params, self.mesh)
+        opt_state = self.optimizer.init(params)
+        return params, opt_state
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("dp"))
+
+    def shard_batch(self, batch: Dict[str, jax.Array]
+                    ) -> Dict[str, jax.Array]:
+        sh = self.batch_sharding()
+        return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+    # -- step -------------------------------------------------------------
+    def _train_step_impl(self, params, opt_state, batch, rng):
+        del rng  # deterministic forward; kept for API parity with
+        # DiffusionTrainer.step so drivers treat both uniformly
+
+        def loss_fn(p):
+            logits = self._apply(
+                p, batch["input_ids"], batch["loss_mask"].astype(bool)
+            )
+            return next_token_loss(
+                logits, batch["input_ids"], batch["loss_mask"]
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = self.optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    def step(self, params, opt_state, batch, rng):
+        return self._step(params, opt_state, batch, rng)

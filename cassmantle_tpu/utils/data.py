@@ -1,0 +1,126 @@
+"""Training data pipeline: token packing + background device prefetch.
+
+The reference has no dataset machinery at all (its only data files are
+17 seed titles and 7 styles, reference data/seeds.txt, data/styles.txt);
+training a prompt LM on story text needs one. TPU-first shape:
+
+- **pack_tokens**: corpus -> fixed-length rows. Documents are tokenized,
+  joined with EOS separators into one stream, and reshaped to
+  (rows, seq_len) — every row is fully dense (no padding waste on the
+  MXU), the standard LM packing layout. A ``loss_mask`` marks real
+  tokens (everything but the tail pad of the final partial row).
+- **PrefetchLoader**: wraps any host-batch iterator; a daemon thread
+  stages the NEXT batch onto device (with the trainer's sharding) while
+  the current step runs — host tokenization/IO overlaps device compute,
+  so the scan never waits on the loader. Depth-bounded queue gives
+  backpressure.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def pack_tokens(
+    texts: Sequence[str],
+    encode: Callable[[str], Sequence[int]],
+    seq_len: int,
+    eos_id: int,
+) -> Dict[str, np.ndarray]:
+    """Documents -> dense packed LM rows.
+
+    Returns ``{"input_ids": (N, seq_len) int32, "loss_mask": (N, seq_len)
+    int32}``; the stream is ``doc0 EOS doc1 EOS ...`` padded with EOS to a
+    row boundary, mask 0 only on that tail pad.
+    """
+    stream: list = []
+    for text in texts:
+        stream.extend(int(t) for t in encode(text))
+        stream.append(eos_id)
+    if not stream:
+        return {
+            "input_ids": np.zeros((0, seq_len), np.int32),
+            "loss_mask": np.zeros((0, seq_len), np.int32),
+        }
+    n_rows = (len(stream) + seq_len - 1) // seq_len
+    pad = n_rows * seq_len - len(stream)
+    ids = np.asarray(stream + [eos_id] * pad, dtype=np.int32)
+    mask = np.ones(len(stream), dtype=np.int32)
+    mask = np.concatenate([mask, np.zeros(pad, dtype=np.int32)])
+    return {
+        "input_ids": ids.reshape(n_rows, seq_len),
+        "loss_mask": mask.reshape(n_rows, seq_len),
+    }
+
+
+def batches_from(
+    packed: Dict[str, np.ndarray],
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epochs: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Packed rows -> host batch dicts; drops the trailing partial batch.
+
+    ``epochs=None`` streams forever (reshuffling each epoch).
+    """
+    n = packed["input_ids"].shape[0]
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        for start in range(0, n - batch_size + 1, batch_size):
+            sel = order[start : start + batch_size]
+            yield {k: v[sel] for k, v in packed.items()}
+        epoch += 1
+
+
+class PrefetchLoader:
+    """Stage host batches onto device ahead of consumption.
+
+    ``place`` is typically ``trainer.shard_batch`` — it runs on the
+    prefetch thread, so the device transfer (and any sharded
+    device_put collateral) overlaps the previous train step.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        batches: Iterable[Dict[str, np.ndarray]],
+        place: Optional[Callable] = None,
+        depth: int = 2,
+    ) -> None:
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._place = place or (lambda b: b)
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(batches),), daemon=True,
+            name="data-prefetch",
+        )
+        self._thread.start()
+
+    def _run(self, it: Iterator) -> None:
+        try:
+            for batch in it:
+                self._queue.put(self._place(batch))
+        except BaseException as exc:  # surfaced on the consumer thread
+            self._err = exc
+        finally:
+            self._queue.put(self._DONE)
+
+    def __iter__(self) -> "PrefetchLoader":
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
