@@ -369,6 +369,28 @@ def convert_vae_decoder(tensors: Tensors, cfg) -> dict:
     return c.tree()
 
 
+def convert_vae_encoder(tensors: Tensors, cfg) -> dict:
+    """Encoder half of the same AutoencoderKL checkpoint (img2img path)."""
+    c = Converter(tensors, "vae_encoder")
+    c.conv("quant_conv", "quant_conv")
+    c.conv("encoder.conv_in", "conv_in")
+    levels = len(cfg.channel_mults)
+    for lvl in range(levels):
+        for blk in range(cfg.blocks_per_level):
+            _convert_vae_resblock(
+                c, f"encoder.down_blocks.{lvl}.resnets.{blk}",
+                f"down_{lvl}_res_{blk}")
+        if lvl != levels - 1:
+            c.conv(f"encoder.down_blocks.{lvl}.downsamplers.0.conv",
+                   f"down_{lvl}_downsample")
+    _convert_vae_resblock(c, "encoder.mid_block.resnets.0", "mid_res_0")
+    _convert_vae_attn(c, "encoder.mid_block.attentions.0", "mid_attn")
+    _convert_vae_resblock(c, "encoder.mid_block.resnets.1", "mid_res_1")
+    c.groupnorm("encoder.conv_norm_out", "norm_out")
+    c.conv("encoder.conv_out", "conv_out")
+    return c.tree()
+
+
 # ---------------------------------------------------------------------------
 # Init + loading entry points
 # ---------------------------------------------------------------------------

@@ -65,11 +65,13 @@ class DDIMSchedule:
         num_train_steps: int = 1000,
         beta_start: float = 0.00085,
         beta_end: float = 0.012,
+        start: int = 0,
     ) -> "DDIMSchedule":
+        """``start`` > 0 drops the first inference steps (img2img tails)."""
         import numpy as np
 
         ab_full = alpha_bars_full(num_train_steps, beta_start, beta_end)
-        ts = strided_timesteps(num_steps, num_train_steps)
+        ts = strided_timesteps(num_steps, num_train_steps)[start:]
         ab = ab_full[ts].astype(np.float32)
         ab_prev = np.concatenate(
             [ab_full[ts[1:]], [1.0]]

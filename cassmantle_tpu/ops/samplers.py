@@ -44,9 +44,10 @@ class EulerSchedule:
     sigmas: jnp.ndarray      # (T+1,) float32, sigmas[-1] == 0
 
     @staticmethod
-    def create(num_steps: int) -> "EulerSchedule":
+    def create(num_steps: int, start: int = 0) -> "EulerSchedule":
+        """``start`` > 0 drops the first steps (img2img tails)."""
         ab = _alpha_bars()
-        ts = _strided_timesteps(num_steps)
+        ts = _strided_timesteps(num_steps)[start:]
         sig = np.sqrt((1.0 - ab[ts]) / ab[ts])
         sig = np.concatenate([sig, [0.0]]).astype(np.float32)
         return EulerSchedule(timesteps=jnp.asarray(ts),
@@ -57,13 +58,16 @@ def euler_sample(
     denoise: Callable[[jax.Array, jax.Array], jax.Array],
     latents: jax.Array,
     schedule: EulerSchedule,
+    prescaled: bool = False,
 ) -> jax.Array:
     """Deterministic Euler solver over the k-diffusion ODE.
 
-    ``latents`` is standard normal (VP convention, same as ddim_sample);
-    scaling by sigma_max happens here. Returns VP-space x_0 latents.
+    ``latents`` is standard normal (VP convention, same as ddim_sample)
+    and gets scaled by sigma_max here — unless ``prescaled``, in which
+    case the caller already built the k-space state (img2img tails).
+    Returns VP-space x_0 latents.
     """
-    x = latents * schedule.sigmas[0]
+    x = latents if prescaled else latents * schedule.sigmas[0]
 
     def step(x, per_step):
         t, sigma, sigma_next = per_step
@@ -100,9 +104,12 @@ class DPMppSchedule:
     c_d1: jnp.ndarray       # (T,)
 
     @staticmethod
-    def create(num_steps: int) -> "DPMppSchedule":
+    def create(num_steps: int, start: int = 0) -> "DPMppSchedule":
+        """``start`` > 0 drops the first steps (img2img tails); the
+        first kept step is automatically first-order (its h_prev is
+        undefined), which is exactly the multistep warmup."""
         ab = _alpha_bars()
-        ts = _strided_timesteps(num_steps)
+        ts = _strided_timesteps(num_steps)[start:]
         alpha = np.sqrt(ab[ts])
         sigma = np.sqrt(1.0 - ab[ts])
         # targets: step i maps state at ts[i] -> ts[i+1] (final -> clean)
@@ -160,6 +167,52 @@ def dpmpp_2m_sample(
          schedule.c_skip, schedule.c_d0, schedule.c_d1),
     )
     return final
+
+
+def make_img2img_sampler(kind: str, num_steps: int, start: int,
+                         eta: float = 0.0):
+    """Tail sampling from schedule position ``start`` (img2img).
+
+    Returns ``(prepare, sample)``: ``prepare(x0_latents, noise)`` builds
+    the solver-space state at the start step (VP for DDIM/DPM++, k-space
+    for Euler); ``sample(denoise, x, rng)`` runs the remaining steps and
+    returns x0 latents. Every kind integrates the same ODE as its full-
+    schedule counterpart in :func:`make_sampler`.
+    """
+    ab = _alpha_bars()
+    ts = _strided_timesteps(num_steps)
+    a0 = float(ab[ts[start]])
+    if kind == "euler":
+        es = EulerSchedule.create(num_steps, start)
+        sigma0 = float(np.sqrt((1.0 - a0) / a0))
+
+        def prepare(x0, noise):
+            return x0 + sigma0 * noise          # k-space
+
+        def sample(denoise, x, rng=None):
+            return euler_sample(denoise, x, es, prescaled=True)
+
+        return prepare, sample
+
+    def prepare(x0, noise):                      # VP space
+        return jnp.sqrt(a0) * x0 + jnp.sqrt(1.0 - a0) * noise
+
+    if kind == "ddim":
+        ds = DDIMSchedule.create(num_steps, start=start)
+
+        def sample(denoise, x, rng=None):
+            return ddim_sample(denoise, x, ds, eta=eta, rng=rng)
+
+        return prepare, sample
+    if kind == "dpmpp_2m":
+        ps = DPMppSchedule.create(num_steps, start)
+
+        def sample(denoise, x, rng=None):
+            return dpmpp_2m_sample(denoise, x, ps)
+
+        return prepare, sample
+    raise ValueError(f"unknown sampler kind {kind!r}; "
+                     f"choose from {SAMPLER_KINDS}")
 
 
 def make_sampler(kind: str, num_steps: int, eta: float = 0.0):

@@ -112,6 +112,21 @@ class EmbeddingScorer:
         n = len(pairs)
         return np.sum(emb[:n] * emb[n:], axis=-1)
 
+    def most_similar(self, word: str, candidates: Sequence[str],
+                     top_k: int = 5) -> List[Tuple[str, float]]:
+        """k nearest candidate words by embedding cosine (the reference's
+        word2vec ``most_similar`` surface, backend.py:297-301, over an
+        explicit candidate list instead of a fixed gensim vocabulary).
+
+        One padded device batch embeds the query and all candidates.
+        """
+        if not candidates:
+            return []
+        emb = self.embed([word] + list(candidates))
+        sims = emb[1:] @ emb[0]
+        order = np.argsort(-sims)[:top_k]
+        return [(candidates[i], float(sims[i])) for i in order]
+
     async def similarity_async(self, pairs) -> np.ndarray:
         """engine.scoring.SimilarityFn adapter."""
         return self.similarity(list(pairs))

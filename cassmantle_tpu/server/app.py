@@ -319,9 +319,24 @@ def main() -> None:
                              "[port] [snapshot_path [interval_s]]; a "
                              "snapshot path makes rounds survive store "
                              "restarts)")
+    parser.add_argument("--preset", default="sd15",
+                        choices=("sd15", "sdxl", "fast"),
+                        help="model/sampler preset: sd15 = SD1.5-512 "
+                             "DDIM-50; sdxl = SDXL-base 1024 (the "
+                             "reference's image model); fast = SD1.5 "
+                             "with DPM++(2M) @ 25 steps")
     args = parser.parse_args()
 
-    cfg = FrameworkConfig()
+    if args.preset == "sdxl":
+        from cassmantle_tpu.config import sdxl_config
+
+        cfg = sdxl_config()
+    elif args.preset == "fast":
+        from cassmantle_tpu.config import fast_serving_config
+
+        cfg = fast_serving_config()
+    else:
+        cfg = FrameworkConfig()
     if args.round_seconds:
         import dataclasses
 

@@ -108,6 +108,7 @@ class Text2ImagePipeline:
         enable_compile_cache()
         m = cfg.models
         self.cfg = cfg
+        self._weights_dir = weights_dir
         self.clip = ClipTextEncoder(m.clip_text)
         self.unet = UNet(m.unet)
         self.vae = VAEDecoder(m.vae)
@@ -195,6 +196,86 @@ class Text2ImagePipeline:
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.images", n)
         return np.asarray(images[:n])
+
+    # -- img2img ----------------------------------------------------------
+    def _ensure_encoder(self) -> None:
+        """Lazy VAE-encoder state: only img2img pays for it. The
+        attribute checked by callers (``vae_enc``) is assigned LAST so a
+        failed load leaves the pipeline retryable, not half-built."""
+        if getattr(self, "vae_enc", None) is not None:
+            return
+        from cassmantle_tpu.models.vae import VAEEncoder
+        from cassmantle_tpu.models.weights import convert_vae_encoder
+
+        m = self.cfg.models
+        encoder = VAEEncoder(m.vae)
+        size = self.cfg.sampler.image_size
+        img = jnp.zeros((1, size, size, 3), jnp.float32)
+        self.enc_params = (
+            maybe_load(self._weights_dir, "vae.safetensors",
+                       lambda t: convert_vae_encoder(t, m.vae),
+                       "vae_encoder")
+            or init_params_cached(
+                encoder, 4, img, jax.random.PRNGKey(0),
+                cache_path=param_cache_path(f"vae_enc{size}", m.vae))
+        )
+        self._i2i_fns = {}
+        self.vae_enc = encoder
+
+    def _img2img_impl(self, k: int, params, ids, uncond_ids, images, rng):
+        """Encode -> noise to the strength step -> run the schedule tail
+        under the CONFIGURED sampler kind (same solver txt2img uses).
+        ``k`` is static: one compiled graph per strength bucket."""
+        from cassmantle_tpu.ops.samplers import make_img2img_sampler
+
+        ctx = self.clip.apply(params["clip"], ids)["hidden"]
+        uncond = self.clip.apply(params["clip"], uncond_ids)["hidden"]
+        denoise = make_cfg_denoiser(
+            self.unet.apply, params["unet"], ctx, uncond,
+            self.cfg.sampler.guidance_scale,
+        )
+        rng_enc, rng_noise = jax.random.split(rng)
+        lat0 = self.vae_enc.apply(params["vae_enc"], images, rng_enc)
+        s = self.cfg.sampler
+        prepare, sample = make_img2img_sampler(
+            s.kind, s.num_steps, s.num_steps - k, eta=s.eta
+        )
+        noise = jax.random.normal(rng_noise, lat0.shape, lat0.dtype)
+        final = sample(denoise, prepare(lat0, noise))
+        decoded = self.vae.apply(params["vae"], final)
+        return postprocess_images(decoded)
+
+    def generate_img2img(
+        self,
+        images: np.ndarray,          # (B, H, W, 3) uint8
+        prompts: Sequence[str],
+        strength: float = 0.6,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Image-conditioned generation (DDIM tail from a noised VAE
+        encoding — e.g. episode-to-episode visual continuity, an ability
+        the reference's remote txt2img call could not offer). ``strength``
+        in (0, 1]: fraction of the schedule re-run; higher = less of the
+        input survives. Single-chip path (no dp sharding)."""
+        assert 0.0 < strength <= 1.0
+        self._ensure_encoder()
+        steps = self.cfg.sampler.num_steps
+        k = max(1, min(steps, int(round(strength * steps))))
+        if k not in self._i2i_fns:
+            self._i2i_fns[k] = jax.jit(partial(self._img2img_impl, k))
+        imgf = jnp.asarray(
+            np.asarray(images, dtype=np.float32) / 127.5 - 1.0
+        )
+        ids = jnp.asarray(self._tokenize(list(prompts)))
+        uncond = jnp.asarray(self._tokenize([""] * len(prompts)))
+        params = dict(self._params, vae_enc=self.enc_params)
+        with metrics.timer("pipeline.i2i_s"):
+            out = self._i2i_fns[k](
+                params, ids, uncond, imgf, jax.random.PRNGKey(seed)
+            )
+            out = jax.block_until_ready(out)
+        metrics.inc("pipeline.images", len(prompts))
+        return np.asarray(out)
 
 
 class PromptGenerator:

@@ -70,6 +70,11 @@ def batches_from(
     ``epochs=None`` streams forever (reshuffling each epoch).
     """
     n = packed["input_ids"].shape[0]
+    if n < batch_size:
+        raise ValueError(
+            f"corpus packs to {n} rows < batch_size {batch_size}; "
+            "no batch can ever be yielded"
+        )
     rng = np.random.default_rng(seed)
     epoch = 0
     while epochs is None or epoch < epochs:
@@ -99,6 +104,7 @@ class PrefetchLoader:
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._place = place or (lambda b: b)
         self._err: Optional[BaseException] = None
+        self._exhausted = False
         self._thread = threading.Thread(
             target=self._run, args=(iter(batches),), daemon=True,
             name="data-prefetch",
@@ -118,8 +124,13 @@ class PrefetchLoader:
         return self
 
     def __next__(self):
+        if self._exhausted:
+            # the _DONE sentinel is consumed exactly once; without this
+            # flag a second next() would block forever on the empty queue
+            raise StopIteration
         item = self._queue.get()
         if item is self._DONE:
+            self._exhausted = True
             if self._err is not None:
                 raise self._err
             raise StopIteration

@@ -43,10 +43,21 @@ def test_batches_from_epochs_and_shapes():
     n = packed["input_ids"].shape[0]
     assert len(batches) == 2 * (n // 8)
     assert all(b["input_ids"].shape == (8, 4) for b in batches)
-    # shuffling: two epochs see different row orders (overwhelmingly)
-    e1 = np.concatenate([b["input_ids"] for b in batches[: n // 8]])
-    e2 = np.concatenate([b["input_ids"] for b in batches[n // 8:]])
+    # shuffling: two epochs see different row orders; rows must use
+    # distinguishable content for the assertion to mean anything
+    packed2 = {
+        "input_ids": np.arange(64, dtype=np.int32).reshape(16, 4),
+        "loss_mask": np.ones((16, 4), np.int32),
+    }
+    two = list(batches_from(packed2, 8, epochs=2, seed=3))
+    e1 = np.concatenate([b["input_ids"] for b in two[:2]])
+    e2 = np.concatenate([b["input_ids"] for b in two[2:]])
     assert e1.shape == e2.shape
+    assert not np.array_equal(e1, e2)
+    # and unshuffled epochs repeat exactly
+    two_ns = list(batches_from(packed2, 8, epochs=2, shuffle=False))
+    np.testing.assert_array_equal(two_ns[0]["input_ids"],
+                                  two_ns[2]["input_ids"])
 
 
 def test_prefetch_loader_order_and_error():
@@ -61,6 +72,21 @@ def test_prefetch_loader_order_and_error():
     loader = PrefetchLoader(bad())
     next(loader)
     with pytest.raises(RuntimeError, match="boom"):
+        next(loader)
+
+
+def test_batches_from_rejects_undersized_corpus():
+    packed = pack_tokens(["ab"], ENC, seq_len=4, eos_id=255)
+    with pytest.raises(ValueError, match="batch_size"):
+        next(batches_from(packed, 8))
+
+
+def test_prefetch_loader_exhaustion_is_sticky():
+    loader = PrefetchLoader([{"x": np.zeros(1)}])
+    assert len(list(loader)) == 1
+    with pytest.raises(StopIteration):
+        next(loader)  # second next() raises again instead of deadlocking
+    with pytest.raises(StopIteration):
         next(loader)
 
 

@@ -226,3 +226,16 @@ def test_sentencepiece_bpe_tokenizer(tmp_path):
     assert vocab["<0x0A>"] in ids_nl
     assert ids_nl[1:] == [vocab[W + "low"], vocab["<0x0A>"],
                           vocab["l"], vocab["o"], vocab["w"]]
+
+
+def test_scorer_most_similar(scorer):
+    """Parity surface for the reference's word2vec most_similar
+    (backend.py:297-301): exact word ranks first, top_k bounds output."""
+    cands = ["storm", "stormy", "calm", "glass"]
+    out = scorer.most_similar("stormy", cands, top_k=2)
+    assert len(out) == 2
+    words = [w for w, _ in out]
+    assert "stormy" in words  # identical text embeds identically
+    top_word, top_sim = out[0]
+    assert top_word == "stormy" and top_sim == pytest.approx(1.0, abs=1e-3)
+    assert scorer.most_similar("x", [], top_k=3) == []
