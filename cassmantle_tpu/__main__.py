@@ -1,0 +1,293 @@
+"""Framework CLI: ``python -m cassmantle_tpu <command>``.
+
+The reference has no CLI layer at all — it launches as ``uvicorn
+main:app`` (reference requirements.txt:2, main.py:18) and its one tool is
+a bare script (download_model.py). A standalone framework needs a front
+door; this one wraps every runnable surface:
+
+- ``serve``           game server (presets: sd15 / sdxl / fast; --fake)
+- ``bench``           the BASELINE.md workload ladder (repo-root bench.py)
+- ``fetch-weights``   checkpoint/tokenizer bootstrap (tools/fetch_weights.py)
+- ``train-diffusion`` dp×tp×sp UNet fine-tuning loop (synthetic or .npy data)
+- ``train-lm``        LM fine-tuning loop (GPT-2 by default)
+- ``version``
+
+Training commands are thin loops over parallel/train.py and
+parallel/lm_train.py with orbax checkpointing — the same step functions
+the multi-chip dryrun compiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _exit_code(e: SystemExit) -> int:
+    """sys.exit accepts any object; non-int codes print to stderr."""
+    if e.code is None:
+        return 0
+    if isinstance(e.code, int):
+        return e.code
+    print(e.code, file=sys.stderr)
+    return 1
+
+
+def cmd_serve(argv) -> int:
+    from cassmantle_tpu.server.app import main as serve_main
+
+    saved = sys.argv
+    sys.argv = ["cassmantle-tpu serve"] + list(argv)
+    try:
+        serve_main()
+    except SystemExit as e:
+        return _exit_code(e)
+    finally:
+        sys.argv = saved
+    return 0
+
+
+def _run_script(relpath: str, argv) -> int:
+    """Exec a repo-root script (bench.py, tools/*) in-process."""
+    import runpy
+
+    path = os.path.join(_repo_root(), relpath)
+    if not os.path.exists(path):
+        print(f"{relpath} not found (not a source checkout?)",
+              file=sys.stderr)
+        return 2
+    saved = sys.argv
+    sys.argv = [path] + list(argv)
+    try:
+        runpy.run_path(path, run_name="__main__")
+    except SystemExit as e:
+        return _exit_code(e)
+    finally:
+        sys.argv = saved
+    return 0
+
+
+def cmd_bench(argv) -> int:
+    return _run_script("bench.py", argv)
+
+
+def cmd_fetch_weights(argv) -> int:
+    return _run_script(os.path.join("tools", "fetch_weights.py"), argv)
+
+
+def _train_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize the forward in backward (fits "
+                        "bigger batches per chip)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint directory (resumes if present)")
+    p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--config", default="default",
+                   choices=("default", "test"),
+                   help="'test' = the tiny-model config (smoke runs on "
+                        "CPU devices)")
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu"),
+                   help="'cpu' pins jax to host devices (with the "
+                        "8-virtual-device flag) — smoke-test sharded "
+                        "training without touching an accelerator")
+    return p
+
+
+def _apply_platform(args) -> None:
+    if args.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=True)
+
+
+def _framework_config(args):
+    if args.config == "test":
+        from cassmantle_tpu.config import test_config
+
+        return test_config()
+    from cassmantle_tpu.config import FrameworkConfig
+
+    return FrameworkConfig()
+
+
+def _checkpointer(args):
+    if not args.checkpoint_dir:
+        return None
+    from cassmantle_tpu.utils.checkpoint import TrainCheckpointer
+
+    return TrainCheckpointer(args.checkpoint_dir)
+
+
+def _train_loop(name, args, trainer, params, opt_state, next_batch):
+    """Shared driver: step/log/checkpoint. ``next_batch(step)`` returns a
+    sharded batch dict."""
+    import jax
+
+    ckpt = _checkpointer(args)
+    start = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        start = ckpt.latest_step()
+        restored = ckpt.restore(
+            template={"params": params, "opt_state": opt_state})
+        params, opt_state = restored["params"], restored["opt_state"]
+        print(f"resumed from step {start}")
+    rng = jax.random.PRNGKey(args.seed)
+    for step in range(start, args.steps):
+        rng, sub = jax.random.split(rng)
+        params, opt_state, loss = trainer.step(
+            params, opt_state, next_batch(step), sub)
+        if step % args.log_every == 0 or step == args.steps - 1:
+            print(f"[{name}] step {step} loss {float(loss):.5f}")
+        if ckpt is not None and (step + 1) % args.checkpoint_every == 0:
+            ckpt.save(step + 1, params, opt_state)
+    if ckpt is not None:
+        ckpt.save(args.steps, params, opt_state)
+        ckpt.close()
+    return 0
+
+
+def cmd_train_diffusion(argv) -> int:
+    p = _train_parser("UNet denoising fine-tune (dp × tp × sp)")
+    p.add_argument("--latents", default=None,
+                   help=".npy of clean latents (N, H, W, 4); synthetic "
+                        "data when omitted")
+    p.add_argument("--context", default=None,
+                   help=".npy of text states (N, S, context_dim)")
+    p.add_argument("--image-size", type=int, default=512)
+    args = p.parse_args(argv)
+    if bool(args.latents) != bool(args.context):
+        p.error("--latents and --context must be given together")
+    _apply_platform(args)
+
+    import jax.numpy as jnp
+
+    from cassmantle_tpu.config import MeshConfig
+    from cassmantle_tpu.parallel.mesh import make_mesh
+    from cassmantle_tpu.parallel.train import DiffusionTrainer
+
+    cfg = _framework_config(args)
+    mesh = make_mesh(MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp))
+    trainer = DiffusionTrainer(cfg, mesh, lr=args.lr, remat=args.remat)
+
+    hw = args.image_size // 8
+    ctx_dim = cfg.models.unet.context_dim
+    if args.latents:
+        lat_all = np.load(args.latents).astype(np.float32)
+        ctx_all = np.load(args.context).astype(np.float32)
+    else:
+        rng = np.random.default_rng(args.seed)
+        lat_all = rng.standard_normal((args.batch * 4, hw, hw, 4),
+                                      dtype=np.float32)
+        ctx_all = rng.standard_normal((args.batch * 4, 77, ctx_dim),
+                                      dtype=np.float32)
+
+    sample = trainer.shard_batch({
+        "latents": jnp.asarray(lat_all[: args.batch]),
+        "context": jnp.asarray(ctx_all[: args.batch]),
+    })
+    params, opt_state = trainer.init_state(sample, seed=args.seed)
+
+    n = lat_all.shape[0]
+
+    def next_batch(step):
+        idx = np.arange(step * args.batch, (step + 1) * args.batch) % n
+        return trainer.shard_batch({
+            "latents": jnp.asarray(lat_all[idx]),
+            "context": jnp.asarray(ctx_all[idx]),
+        })
+
+    return _train_loop("diffusion", args, trainer, params, opt_state,
+                       next_batch)
+
+
+def cmd_train_lm(argv) -> int:
+    p = _train_parser("LM next-token fine-tune (GPT-2 family)")
+    p.add_argument("--tokens", default=None,
+                   help=".npy int32 token stream; synthetic when omitted")
+    p.add_argument("--seq-len", type=int, default=256)
+    args = p.parse_args(argv)
+    _apply_platform(args)
+
+    import jax.numpy as jnp
+
+    from cassmantle_tpu.config import MeshConfig
+    from cassmantle_tpu.models.gpt2 import GPT2LM
+    from cassmantle_tpu.parallel.mesh import make_mesh
+    from cassmantle_tpu.parallel.lm_train import LMTrainer
+
+    cfg = _framework_config(args)
+    mesh = make_mesh(MeshConfig(dp=args.dp, tp=args.tp, sp=args.sp))
+    model = GPT2LM(cfg.models.gpt2)
+    trainer = LMTrainer(model, mesh, lr=args.lr, remat=args.remat)
+
+    if args.tokens:
+        stream = np.load(args.tokens).astype(np.int32)
+    else:
+        rng = np.random.default_rng(args.seed)
+        stream = rng.integers(
+            0, cfg.models.gpt2.vocab_size,
+            size=args.batch * args.seq_len * 4, dtype=np.int32)
+    rows = len(stream) // args.seq_len
+    ids = stream[: rows * args.seq_len].reshape(rows, args.seq_len)
+    mask = np.ones_like(ids)
+    n = ids.shape[0]
+
+    sample = trainer.shard_batch({
+        "input_ids": jnp.asarray(ids[: args.batch]),
+        "loss_mask": jnp.asarray(mask[: args.batch]),
+    })
+    params, opt_state = trainer.init_state(sample["input_ids"],
+                                           seed=args.seed)
+
+    def next_batch(step):
+        idx = np.arange(step * args.batch, (step + 1) * args.batch) % n
+        return trainer.shard_batch({
+            "input_ids": jnp.asarray(ids[idx]),
+            "loss_mask": jnp.asarray(mask[idx]),
+        })
+
+    return _train_loop("lm", args, trainer, params, opt_state, next_batch)
+
+
+COMMANDS = {
+    "serve": cmd_serve,
+    "bench": cmd_bench,
+    "fetch-weights": cmd_fetch_weights,
+    "train-diffusion": cmd_train_diffusion,
+    "train-lm": cmd_train_lm,
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "version":
+        from cassmantle_tpu import __version__
+
+        print(__version__)
+        return 0
+    if not argv or argv[0] in ("-h", "--help") or argv[0] not in COMMANDS:
+        names = " | ".join(list(COMMANDS) + ["version"])
+        print(f"usage: python -m cassmantle_tpu {{{names}}} [args]",
+              file=sys.stderr)
+        return 0 if argv and argv[0] in ("-h", "--help") else 2
+    return COMMANDS[argv[0]](argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,3 +28,29 @@ def append_xla_flags(*flags: str) -> None:
         if name not in current:
             current = (current + " " + flag).strip()
     os.environ["XLA_FLAGS"] = current
+
+
+def pin_cpu_platform(virtual_devices: bool = True) -> None:
+    """Force jax onto host CPU devices, robustly against plugin backends.
+
+    The one place the subtle ordering rules live (used by
+    tests/conftest.py, the CLI's ``--platform cpu``, and the dryrun):
+
+    - XLA flags must land in the env before the first backend init;
+    - the environment may pin JAX_PLATFORMS to an accelerator plugin
+      (e.g. a tunneled device) and a sitecustomize may have imported jax
+      already, so the env var alone is not enough;
+    - ``jax_platforms`` (plural) must be forced through the config API —
+      ``jax_platform_name`` only picks the *default*, while backend
+      discovery still initializes every allowed platform, which blocks
+      forever when the tunnel behind a plugin is down.
+    """
+    if virtual_devices:
+        append_xla_flags(VIRTUAL_8_DEVICE_FLAG, *COLLECTIVE_TIMEOUT_FLAGS)
+    else:
+        append_xla_flags(*COLLECTIVE_TIMEOUT_FLAGS)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platform_name", "cpu")

@@ -5,26 +5,16 @@ collective/sharding tests exercise real XLA collectives on 8 host devices; the
 real-chip path is covered by bench.py and the driver's dryrun.
 """
 
-import os  # noqa: F401  (kept for tests that monkeypatch env)
+# 8 virtual CPU devices + raised collective timeouts (on few-core hosts
+# the devices' programs serialize past XLA's default 40 s rendezvous
+# timeout), pinned hermetically: the suite must never initialize an
+# accelerator-plugin backend — that blocks forever when the tunnel
+# behind it is down. The ordering rules live in pin_cpu_platform.
+from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
 
-# Raised collective timeouts: on few-core hosts the 8 virtual devices'
-# programs serialize and XLA's default 40 s termination timeout kills the
-# process mid-rendezvous. The helper is jax-free, so this import cannot
-# initialize a backend before the flags land.
-from cassmantle_tpu.utils.xla_flags import (
-    COLLECTIVE_TIMEOUT_FLAGS,
-    VIRTUAL_8_DEVICE_FLAG,
-    append_xla_flags,
-)
+pin_cpu_platform(virtual_devices=True)
 
-append_xla_flags(VIRTUAL_8_DEVICE_FLAG, *COLLECTIVE_TIMEOUT_FLAGS)
-
-import jax  # noqa: E402
-
-# The environment may pin JAX_PLATFORMS to a TPU plugin (e.g. axon); the
-# config override below beats the env var and forces the 8 virtual CPU
-# devices for every test.
-jax.config.update("jax_platform_name", "cpu")
+import jax  # noqa: E402, F401
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

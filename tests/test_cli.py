@@ -1,0 +1,70 @@
+"""CLI surface (`python -m cassmantle_tpu`): dispatch + train smoke runs.
+
+The reference has no CLI (launch is `uvicorn main:app`, reference
+requirements.txt:2); this framework fronts every runnable surface through
+one entry point, so the dispatch table and both training loops get tests.
+Training smoke runs use the tiny test config on the virtual CPU devices.
+"""
+
+import numpy as np
+import pytest
+
+from cassmantle_tpu.__main__ import main
+
+
+def test_usage_and_unknown_command(capsys):
+    assert main([]) == 2
+    assert main(["no-such-command"]) == 2
+    assert main(["--help"]) == 0
+    out = capsys.readouterr()
+    assert "train-diffusion" in out.err
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    from cassmantle_tpu import __version__
+
+    assert __version__ in capsys.readouterr().out
+
+
+def test_train_diffusion_smoke(tmp_path, capsys):
+    rc = main([
+        "train-diffusion", "--config", "test", "--steps", "3",
+        "--batch", "8", "--image-size", "64", "--dp", "-1",
+        "--log-every", "1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--checkpoint-every", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[diffusion] step 2 loss" in out
+    # resume path: a second run starts from the saved final step
+    rc = main([
+        "train-diffusion", "--config", "test", "--steps", "3",
+        "--batch", "8", "--image-size", "64",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    assert rc == 0
+    assert "resumed from step 3" in capsys.readouterr().out
+
+
+def test_train_lm_smoke(capsys):
+    rc = main([
+        "train-lm", "--config", "test", "--steps", "2", "--batch", "8",
+        "--seq-len", "32", "--log-every", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[lm] step 1 loss" in out
+
+
+def test_train_lm_token_file(tmp_path, capsys):
+    stream = np.arange(8 * 32 * 2, dtype=np.int32) % 50
+    path = tmp_path / "tokens.npy"
+    np.save(path, stream)
+    rc = main([
+        "train-lm", "--config", "test", "--steps", "1", "--batch", "8",
+        "--seq-len", "32", "--tokens", str(path), "--log-every", "1",
+    ])
+    assert rc == 0
+    assert "[lm] step 0 loss" in capsys.readouterr().out

@@ -1,0 +1,85 @@
+"""Profile the SD1.5 UNet denoise step on the attached TPU.
+
+Prints per-config step time, achieved TFLOP/s (from XLA's cost analysis),
+and a flash-vs-XLA attention A/B at each spatial resolution, to target
+optimization work. Usage: python tools/profile_unet.py [batch]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.models.unet import UNet
+from cassmantle_tpu.ops import attention as attn_mod
+from cassmantle_tpu.utils.compile_cache import enable_compile_cache
+
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    enable_compile_cache()
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    cfg = FrameworkConfig()
+    ucfg = cfg.models.unet
+    model = UNet(ucfg)
+
+    rng = jax.random.PRNGKey(0)
+    lat = jax.random.normal(rng, (batch, 64, 64, 4), jnp.bfloat16)
+    ts = jnp.full((batch,), 500, jnp.int32)
+    ctx = jax.random.normal(rng, (batch, 77, ucfg.context_dim), jnp.bfloat16)
+
+    from cassmantle_tpu.models.weights import init_params_cached
+    from cassmantle_tpu.utils.compile_cache import param_cache_path
+
+    params = init_params_cached(
+        model, 2, lat[:1], ts[:1], ctx[:1],
+        cache_path=param_cache_path("unet", ucfg),
+        cast_to="bfloat16")
+
+    step = jax.jit(lambda p, l, t, c: model.apply(p, l, t, c))
+    lowered = step.lower(params, lat, ts, ctx)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = ca.get("flops", 0.0)
+    bytes_ = ca.get("bytes accessed", 0.0)
+
+    dt = timeit(step, params, lat, ts, ctx)
+    print(f"batch={batch} step={dt*1e3:.2f} ms  "
+          f"flops={flops/1e12:.3f} TF  -> {flops/dt/1e12:.1f} TFLOP/s  "
+          f"bytes={bytes_/1e9:.2f} GB -> {bytes_/dt/1e9:.0f} GB/s")
+
+    # flash vs XLA attention A/B per UNet resolution (self-attn shapes)
+    for (s, heads, d) in [(4096, 8, 40), (1024, 8, 80), (256, 8, 160),
+                          (64, 8, 160)]:
+        q = jax.random.normal(rng, (batch, s, heads, d), jnp.bfloat16)
+        fa = jax.jit(lambda q, k, v: attn_mod.multi_head_attention(
+            q, k, v, use_flash=True))
+        xa = jax.jit(lambda q, k, v: attn_mod.multi_head_attention(
+            q, k, v, use_flash=False))
+        tf_ = timeit(fa, q, q, q)
+        tx = timeit(xa, q, q, q)
+        # cross-attn: kv len 77
+        k77 = jax.random.normal(rng, (batch, 77, heads, d), jnp.bfloat16)
+        txc = timeit(jax.jit(lambda q, k, v: attn_mod.multi_head_attention(
+            q, k, v, use_flash=False)), q, k77, k77)
+        print(f"S={s:5d} D={d:3d}: flash={tf_*1e6:8.1f} us  "
+              f"xla={tx*1e6:8.1f} us  cross77(xla)={txc*1e6:8.1f} us")
+
+
+if __name__ == "__main__":
+    main()
