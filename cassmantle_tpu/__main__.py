@@ -147,9 +147,11 @@ def _train_loop(name, args, trainer, params, opt_state, next_batch):
             template={"params": params, "opt_state": opt_state})
         params, opt_state = restored["params"], restored["opt_state"]
         print(f"resumed from step {start}")
-    rng = jax.random.PRNGKey(args.seed)
+    root_rng = jax.random.PRNGKey(args.seed)
     for step in range(start, args.steps):
-        rng, sub = jax.random.split(rng)
+        # fold the step index in (not a split chain): a resumed run at
+        # step N draws the same subkey an uninterrupted run would
+        sub = jax.random.fold_in(root_rng, step)
         params, opt_state, loss = trainer.step(
             params, opt_state, next_batch(step), sub)
         if step % args.log_every == 0 or step == args.steps - 1:

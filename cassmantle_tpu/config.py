@@ -162,6 +162,11 @@ class ModelZooConfig:
     # weight traffic per denoise step — the TPU-standard serving layout;
     # norm layers still compute fp32 internally). "float32" to disable.
     param_dtype: str = "bfloat16"
+    # Weights-only int8 for the prompt LM's matmul kernels (ops/quant.py):
+    # halves weight HBM footprint and streaming bytes — what makes the
+    # Mistral-7B-class prompt model (the reference's LLM family) fit and
+    # decode fast on a single 16 GB chip. Embeddings/norms stay bf16.
+    lm_int8: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

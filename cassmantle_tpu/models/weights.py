@@ -427,7 +427,8 @@ def load_params(path: str) -> dict:
 
 def init_params_cached(model, rng_seed: int, *sample_args,
                        cache_path: Optional[str] = None,
-                       cast_to: Optional[str] = None) -> dict:
+                       cast_to: Optional[str] = None,
+                       transform=None) -> dict:
     """Big-model init: run the init program on CPU (the on-device init
     graph for an 860M-param UNet takes minutes through a TPU tunnel, the
     CPU path ~1 min), cache to disk, and push the tree to the default
@@ -435,7 +436,8 @@ def init_params_cached(model, rng_seed: int, *sample_args,
 
     ``cast_to`` applies the storage dtype (e.g. bf16 serving layout) at
     this single production point so no caller ships a forgotten tree in
-    fp32. The disk cache stays fp32."""
+    fp32. The disk cache stays fp32. ``transform``: host-side tree
+    transform applied before the device transfer (see maybe_load)."""
     if cache_path and os.path.exists(cache_path):
         log.info("loading cached init params from %s", cache_path)
         tree = load_params(cache_path)
@@ -450,17 +452,22 @@ def init_params_cached(model, rng_seed: int, *sample_args,
             save_params(tree, cache_path)
     if cast_to:
         tree = cast_params(tree, cast_to)
+    if transform is not None:
+        tree = transform(tree)
     return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
 def maybe_load(
     weights_dir: Optional[str], filename: str, converter, model_name: str,
     cast_to: Optional[str] = None,
+    transform=None,
 ) -> Optional[dict]:
     """Load+convert a checkpoint if present, else None (random init).
 
     ``cast_to``: storage dtype applied after conversion (see
-    init_params_cached)."""
+    init_params_cached). ``transform``: host-side tree transform (e.g.
+    ops.quant.quantize_tree_host) applied BEFORE device placement, so
+    only the transformed tree ever occupies HBM."""
     if not weights_dir:
         return None
     path = os.path.join(weights_dir, filename)
@@ -495,6 +502,8 @@ def maybe_load(
         return None
     if cast_to:
         params = cast_params(params, cast_to)
+    if transform is not None:
+        params = transform(params)
     return jax.tree_util.tree_map(jnp.asarray, params)
 
 

@@ -1,0 +1,150 @@
+"""Weights-only int8 quantization for serving (w8a16).
+
+The reference rents its LLM (Mistral-7B-Instruct, reference backend.py:25)
+so it never faces the on-box memory/bandwidth question. Serving that model
+locally does: 7B bf16 params are ~14 GB — at the edge of one v5e chip's
+16 GB HBM before activations — and single-stream greedy decode is
+weight-streaming-bound, so weight bytes ARE the step time. Per-channel
+symmetric int8 storage halves both.
+
+Design (TPU-first):
+- ``QTensor``: a registered pytree (int8 data + per-out-channel fp32
+  scale). Param trees keep their exact structure; only large matmul
+  kernels are swapped for QTensors, so one tree works for any model.
+- Dequantization happens INSIDE the jitted computation
+  (``dequantize_tree`` at the top of the wrapped apply): HBM holds int8,
+  and XLA fuses the ``convert+scale`` producer into each kernel's
+  consumer ops, upcasting tiles in VMEM rather than materializing a
+  persistent bf16 copy of the weights.
+- Per-OUTPUT-channel scales (last axis): row x @ W column j sees one
+  scale s_j, preserving matmul semantics exactly:
+  x @ (s ⊙ W8) == (x @ W8) ⊙ s.
+- Symmetric (no zero-point): zero-points force an extra correction
+  matmul; absmax/127 keeps the kernel a pure dot.
+
+Embeddings, norms, biases, and small kernels stay in the storage dtype —
+they're a rounding error of the footprint and disproportionately
+quality-sensitive.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 data + broadcastable fp32 scale. A pytree by construction."""
+
+    data: jax.Array    # int8, original shape
+    scale: jax.Array   # fp32, shape broadcastable to data (per out-channel)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.data.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize_tensor(w: jax.Array, axis: int = -1) -> QTensor:
+    """Symmetric per-channel int8: scale = absmax/127 along all axes
+    except ``axis`` (the output-feature axis, kept per-channel)."""
+    w32 = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(i for i in range(w32.ndim)
+                        if i != (axis % w32.ndim))
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    data = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QTensor(data=data, scale=scale)
+
+
+def default_predicate(path: tuple, leaf: Any) -> bool:
+    """Quantize large matmul kernels only: param named 'kernel' with
+    >=2 dims and enough elements to matter. Embeddings (named
+    'embedding'), norms ('scale'/'bias'), and tiny projections pass
+    through."""
+    name = str(path[-1]) if path else ""
+    return (
+        "kernel" in name
+        and hasattr(leaf, "ndim") and leaf.ndim >= 2
+        and leaf.size >= 1 << 16
+    )
+
+
+def _walk(tree: Any, fn: Callable[[tuple, Any], Any], path: tuple = ()):
+    if isinstance(tree, dict):
+        return {k: _walk(v, fn, path + (k,)) for k, v in tree.items()}
+    return fn(path, tree)
+
+
+def quantize_tree(
+    params: Any,
+    predicate: Optional[Callable[[tuple, Any], bool]] = None,
+) -> Any:
+    """Swap selected leaves of a param tree for QTensors (same structure
+    otherwise). Works on the plain-dict trees flax produces. The default
+    predicate is resolved at call time (module attribute) so policy is
+    overridable in one place."""
+    if predicate is None:
+        predicate = default_predicate
+
+    def visit(path, leaf):
+        if predicate(path, leaf):
+            return quantize_tensor(leaf)
+        return leaf
+
+    return _walk(params, visit)
+
+
+def quantize_tree_host(
+    params: Any,
+    predicate: Optional[Callable[[tuple, Any], bool]] = None,
+) -> Any:
+    """quantize_tree pinned to host CPU — the form to use as a loader
+    ``transform`` (models/weights.py): quantizing BEFORE device placement
+    keeps peak HBM at the int8 footprint. Quantizing after would hold the
+    full fp tree and the int8 tree resident together, which is exactly
+    what breaks a 7B-class model on a 16 GB chip."""
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        return quantize_tree(params, predicate)
+
+
+def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Inverse of quantize_tree — call INSIDE jit so XLA fuses the
+    upcast into each kernel's consumers (int8 stays the HBM format)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.dequantize(dtype) if isinstance(leaf, QTensor)
+        else leaf,
+        params,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+
+
+def quantized_apply(apply_fn: Callable, dtype=jnp.bfloat16) -> Callable:
+    """Wrap ``apply_fn(params, *args, **kw)`` to accept a quantized tree:
+    the returned function dequantizes first, so it drops into any
+    call site that jits apply (decode prefill/step, pipelines)."""
+    def wrapped(params, *args, **kwargs):
+        return apply_fn(dequantize_tree(params, dtype), *args, **kwargs)
+
+    return wrapped
+
+
+def tree_nbytes(params: Any) -> int:
+    """HBM footprint of a (possibly quantized) tree, in bytes."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += getattr(leaf, "nbytes", 0)
+    return total
+
+
+def quantization_error(w: jax.Array, axis: int = -1) -> float:
+    """Relative L2 reconstruction error (diagnostics/tests)."""
+    q = quantize_tensor(w, axis)
+    w32 = jnp.asarray(w, jnp.float32)
+    err = jnp.linalg.norm(q.dequantize(jnp.float32) - w32)
+    return float(err / (jnp.linalg.norm(w32) + 1e-9))

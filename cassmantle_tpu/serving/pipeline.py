@@ -313,13 +313,22 @@ class PromptGenerator:
                       "gpt2")
         self.mcfg = m
         ids = jnp.zeros((1, 8), dtype=jnp.int32)
+        transform = None
+        if cfg.models.lm_int8:
+            # Quantize on HOST, before device placement: peak HBM stays
+            # at the int8 footprint (quantizing after would briefly hold
+            # the fp and int8 trees resident together — fatal for a
+            # 7B-class model on a 16 GB chip).
+            from cassmantle_tpu.ops.quant import quantize_tree_host
+
+            transform = quantize_tree_host
         self.params = (
             maybe_load(weights_dir, loader[0], loader[1], loader[2],
-                       cast_to=cfg.models.param_dtype)
+                       cast_to=cfg.models.param_dtype, transform=transform)
             or init_params_cached(
                 self.model, 5, ids,
                 cache_path=param_cache_path(loader[2], m),
-                cast_to=cfg.models.param_dtype)
+                cast_to=cfg.models.param_dtype, transform=transform)
         )
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
@@ -330,6 +339,16 @@ class PromptGenerator:
         self._step = lambda p, tok, idx, cache, valid: self.model.apply(
             p, tok, idx, cache, valid, method=cls.decode_step
         )
+        if cfg.models.lm_int8:
+            from cassmantle_tpu.ops.quant import (
+                quantized_apply,
+                tree_nbytes,
+            )
+
+            self._prefill = quantized_apply(self._prefill)
+            self._step = quantized_apply(self._step)
+            log.info("lm_int8: serving %.2f GB quantized param tree",
+                     tree_nbytes(self.params) / 1e9)
 
     def decode_ids(self, seed_text: str,
                    max_new_tokens: Optional[int] = None):

@@ -1,0 +1,148 @@
+"""Weights-only int8 quantization (ops/quant.py): reconstruction error,
+tree transforms, jit/pytree compatibility, and the quantized LM serving
+path end to end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cassmantle_tpu.ops.quant import (
+    QTensor,
+    default_predicate,
+    dequantize_tree,
+    quantization_error,
+    quantize_tensor,
+    quantize_tree,
+    quantized_apply,
+    tree_nbytes,
+)
+
+
+def test_quantize_tensor_roundtrip_error():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 512), jnp.float32)
+    q = quantize_tensor(w)
+    assert q.data.dtype == jnp.int8
+    assert q.scale.shape == (1, 512)          # per-out-channel
+    # int8 symmetric quantization of a gaussian: ~0.2-0.7% relative L2
+    assert quantization_error(w) < 0.01
+
+
+def test_quantize_exact_for_scaled_ints():
+    # values that are exact multiples of absmax/127 reconstruct exactly
+    base = jnp.asarray(np.arange(-127, 128, dtype=np.float32))[:, None]
+    w = jnp.tile(base, (1, 4)) * 0.037
+    q = quantize_tensor(w)
+    np.testing.assert_allclose(np.asarray(q.dequantize(jnp.float32)),
+                               np.asarray(w), rtol=1e-6)
+
+
+def test_matmul_semantics_per_channel():
+    # x @ dequant(W) must equal (x @ W8) * s: per-output-channel scales
+    rng = jax.random.PRNGKey(1)
+    w = jax.random.normal(rng, (64, 32), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 64), jnp.float32)
+    q = quantize_tensor(w)
+    lhs = x @ q.dequantize(jnp.float32)
+    rhs = (x @ q.data.astype(jnp.float32)) * q.scale[0][None, :]
+    np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zero_channel_safe():
+    w = jnp.zeros((16, 8), jnp.float32)
+    q = quantize_tensor(w)
+    assert np.all(np.isfinite(np.asarray(q.scale)))
+    np.testing.assert_array_equal(np.asarray(q.dequantize(jnp.float32)), 0)
+
+
+def test_tree_transform_selects_kernels_only():
+    tree = {
+        "dense": {"kernel": jnp.ones((512, 512)), "bias": jnp.ones((512,))},
+        "emb": {"embedding": jnp.ones((1000, 512))},
+        "tiny": {"kernel": jnp.ones((4, 4))},
+        "ln": {"scale": jnp.ones((512,))},
+    }
+    qt = quantize_tree(tree)
+    assert isinstance(qt["dense"]["kernel"], QTensor)
+    assert not isinstance(qt["emb"]["embedding"], QTensor)   # embeddings stay
+    assert not isinstance(qt["tiny"]["kernel"], QTensor)     # too small
+    assert not isinstance(qt["ln"]["scale"], QTensor)
+    # footprint: the big kernel shrinks ~4x (fp32 -> int8 + scales);
+    # untouched leaves (embedding here) keep their bytes
+    assert tree_nbytes(qt["dense"]) < 0.3 * tree_nbytes(tree["dense"])
+    assert tree_nbytes(qt["emb"]) == tree_nbytes(tree["emb"])
+    back = dequantize_tree(qt, jnp.float32)
+    assert back["dense"]["kernel"].dtype == jnp.float32
+    assert back["dense"]["kernel"].shape == (512, 512)
+
+
+def test_default_predicate_paths():
+    big = jnp.ones((512, 512))
+    assert default_predicate(("layer", "kernel"), big)
+    assert not default_predicate(("layer", "bias"), jnp.ones((512,)))
+    assert not default_predicate((), big) is True or True  # no path: False
+    assert default_predicate((), big) is False
+
+
+def test_qtensor_through_jit():
+    # QTensor trees cross the jit boundary as pytrees; dequant inside
+    w = jax.random.normal(jax.random.PRNGKey(3), (128, 512), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 128), jnp.float32)
+    tree = quantize_tree({"m": {"kernel": w}})
+
+    @jax.jit
+    def f(qt, x):
+        d = dequantize_tree(qt, jnp.float32)
+        return x @ d["m"]["kernel"]
+
+    out = f(tree, x)
+    ref = x @ quantize_tensor(w).dequantize(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_quantized_apply_wrapper():
+    w = jax.random.normal(jax.random.PRNGKey(5), (300, 300), jnp.float32)
+    tree = {"m": {"kernel": w}}
+
+    def apply_fn(params, x):
+        return x @ params["m"]["kernel"]
+
+    x = jax.random.normal(jax.random.PRNGKey(6), (4, 300), jnp.float32)
+    qout = quantized_apply(apply_fn, jnp.float32)(quantize_tree(tree), x)
+    ref = apply_fn(tree, x)
+    # w8a16 noise on a 300-dim contraction stays ~1%
+    err = float(jnp.linalg.norm(qout - ref) / jnp.linalg.norm(ref))
+    assert err < 0.02
+
+
+def test_quantized_lm_decode_end_to_end(cfg, monkeypatch):
+    """The serving path with lm_int8: quantized GPT-2 decodes sane tokens
+    with int8 kernels in the tree. The test config's kernels sit below
+    the production size threshold, so drop it for this test."""
+    import dataclasses
+
+    import cassmantle_tpu.ops.quant as quant
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    monkeypatch.setattr(
+        quant, "default_predicate",
+        lambda path, leaf: "kernel" in str(path[-1] if path else "")
+        and getattr(leaf, "ndim", 0) >= 2)
+
+    qcfg = cfg.replace(
+        models=dataclasses.replace(cfg.models, lm_int8=True))
+    gen_fp = PromptGenerator(cfg)
+    gen_q = PromptGenerator(qcfg)
+
+    toks_fp, len_fp = gen_fp.decode_ids("the storm rose", max_new_tokens=8)
+    toks_q, len_q = gen_q.decode_ids("the storm rose", max_new_tokens=8)
+    assert toks_q.shape == toks_fp.shape
+    assert int(len_q[0]) >= 1
+    # tiny random-init model: quantization noise may flip argmaxes, so
+    # assert the mechanism (int8 storage) rather than token equality
+    from cassmantle_tpu.ops.quant import QTensor as QT
+
+    leaves = jax.tree_util.tree_leaves(
+        gen_q.params, is_leaf=lambda x: isinstance(x, QT))
+    assert any(isinstance(leaf, QT) for leaf in leaves)

@@ -20,13 +20,18 @@ from cassmantle_tpu.utils.compile_cache import enable_compile_cache
 
 
 def timeit(fn, *args, reps=10):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+    """Thin adapter over tools/bench_parts.timeit (one timing
+    methodology for all profilers), silencing its per-line print."""
+    import contextlib
+    import io
+
+    try:
+        from tools.bench_parts import timeit as _timeit
+    except ImportError:  # run as `python tools/profile_unet.py`
+        from bench_parts import timeit as _timeit
+
+    with contextlib.redirect_stdout(io.StringIO()):
+        return _timeit("", fn, *args, reps=reps)
 
 
 def main():
@@ -63,7 +68,11 @@ def main():
           f"flops={flops/1e12:.3f} TF  -> {flops/dt/1e12:.1f} TFLOP/s  "
           f"bytes={bytes_/1e9:.2f} GB -> {bytes_/dt/1e9:.0f} GB/s")
 
-    # flash vs XLA attention A/B per UNet resolution (self-attn shapes)
+    # flash vs XLA attention A/B per UNet resolution (self-attn shapes);
+    # rows whose seq length the Pallas kernel won't tile fall back to the
+    # XLA path inside the dispatcher — label them so the A/B can't lie
+    from cassmantle_tpu.ops.flash_attention import flash_attention_ok
+
     for (s, heads, d) in [(4096, 8, 40), (1024, 8, 80), (256, 8, 160),
                           (64, 8, 160)]:
         q = jax.random.normal(rng, (batch, s, heads, d), jnp.bfloat16)
@@ -71,13 +80,14 @@ def main():
             q, k, v, use_flash=True))
         xa = jax.jit(lambda q, k, v: attn_mod.multi_head_attention(
             q, k, v, use_flash=False))
+        flabel = "flash" if flash_attention_ok(q, q) else "xla-fallback"
         tf_ = timeit(fa, q, q, q)
         tx = timeit(xa, q, q, q)
         # cross-attn: kv len 77
         k77 = jax.random.normal(rng, (batch, 77, heads, d), jnp.bfloat16)
         txc = timeit(jax.jit(lambda q, k, v: attn_mod.multi_head_attention(
             q, k, v, use_flash=False)), q, k77, k77)
-        print(f"S={s:5d} D={d:3d}: flash={tf_*1e6:8.1f} us  "
+        print(f"S={s:5d} D={d:3d}: {flabel}={tf_*1e6:8.1f} us  "
               f"xla={tx*1e6:8.1f} us  cross77(xla)={txc*1e6:8.1f} us")
 
 
