@@ -45,13 +45,14 @@ def _setup_jax():
     return jax
 
 
-def bench_sd15(weights_dir: str) -> dict:
-    """North-star: SD1.5 512², 50-step CFG DDIM, images/sec/chip."""
+def _bench_txt2img(config_factory, metric: str, weights_dir: str) -> dict:
+    """Shared txt2img harness (one timing methodology for every image
+    preset): build pipeline, warmup compile, TIMED_ROUNDS batches,
+    report images/sec/chip."""
     jax = _setup_jax()
-    from cassmantle_tpu.config import FrameworkConfig
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
-    pipe = Text2ImagePipeline(FrameworkConfig(), weights_dir=weights_dir)
+    pipe = Text2ImagePipeline(config_factory(), weights_dir=weights_dir)
     prompts = (PROMPTS * ((BATCH + len(PROMPTS) - 1) // len(PROMPTS)))[:BATCH]
     pipe.generate(prompts, seed=0)  # warmup / compile
 
@@ -62,14 +63,33 @@ def bench_sd15(weights_dir: str) -> dict:
         n_images += images.shape[0]
     elapsed = time.perf_counter() - t0
 
-    n_chips = jax.local_device_count()
-    ips_per_chip = n_images / elapsed / max(1, n_chips)
+    ips_per_chip = n_images / elapsed / max(1, jax.local_device_count())
     return {
-        "metric": "sd15_512px_ddim50_images_per_sec_per_chip",
+        "metric": metric,
         "value": round(ips_per_chip, 4),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC, 4),
     }
+
+
+def bench_sd15(weights_dir: str) -> dict:
+    """North-star: SD1.5 512², 50-step CFG DDIM, images/sec/chip."""
+    from cassmantle_tpu.config import FrameworkConfig
+
+    return _bench_txt2img(
+        FrameworkConfig, "sd15_512px_ddim50_images_per_sec_per_chip",
+        weights_dir)
+
+
+def bench_sd15_fast(weights_dir: str) -> dict:
+    """Fast-serving preset: DPM-Solver++(2M) @ 25 steps (the quality-
+    equivalent low-latency sampler — BASELINE.md's workload-level path
+    past the bf16 FLOP ceiling of the fixed 50-step DDIM config)."""
+    from cassmantle_tpu.config import fast_serving_config
+
+    return _bench_txt2img(
+        fast_serving_config, "sd15_512px_dpmpp25_images_per_sec_per_chip",
+        weights_dir)
 
 
 def bench_scorer(weights_dir: str) -> dict:
@@ -199,6 +219,7 @@ SUITE = {
     "scorer": bench_scorer,
     "gpt2": bench_gpt2,
     "sd15": bench_sd15,
+    "sd15_fast": bench_sd15_fast,
     "sdxl": bench_sdxl,
     "e2e": bench_e2e_round,
 }

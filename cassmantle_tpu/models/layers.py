@@ -203,10 +203,21 @@ class _GroupNormCore(nn.Module):
         bias = self.param("bias", nn.initializers.zeros, (c,))
 
         spatial = x.shape[1:-1]
-        xg = x.reshape(x.shape[0], -1, g, c // g)
-        x32 = xg.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=(1, 3))                    # (B, G)
-        var = jnp.mean(jnp.square(x32), axis=(1, 3)) - jnp.square(mean)
+        # Reduce in the tensor's native channels-last layout: per-channel
+        # sum and sum-of-squares over the spatial axis — the minor (lane)
+        # dimension stays C (a few hundred, tiles well), not C/G (10-80,
+        # which pads each 128-lane vector op mostly empty). The tiny
+        # (B, C) moments then fold into (B, G) group stats exactly
+        # (groups are equal-sized, so the group mean is the mean of its
+        # channel means).
+        x2 = x.reshape(x.shape[0], -1, c).astype(jnp.float32)
+        n_spatial = x2.shape[1]
+        sum_c = jnp.sum(x2, axis=1)                          # (B, C)
+        sumsq_c = jnp.sum(jnp.square(x2), axis=1)            # (B, C)
+        n_group = n_spatial * (c // g)
+        mean = jnp.sum(sum_c.reshape(-1, g, c // g), axis=-1) / n_group
+        ex2 = jnp.sum(sumsq_c.reshape(-1, g, c // g), axis=-1) / n_group
+        var = ex2 - jnp.square(mean)                         # (B, G)
         inv = jax.lax.rsqrt(var + self.epsilon)              # (B, G)
 
         # per-(batch, channel) affine in fp32, one cast, one fused FMA

@@ -345,8 +345,9 @@ class PromptGenerator:
                 tree_nbytes,
             )
 
-            self._prefill = quantized_apply(self._prefill)
-            self._step = quantized_apply(self._step)
+            dq_dtype = jnp.dtype(cfg.models.param_dtype)
+            self._prefill = quantized_apply(self._prefill, dq_dtype)
+            self._step = quantized_apply(self._step, dq_dtype)
             log.info("lm_int8: serving %.2f GB quantized param tree",
                      tree_nbytes(self.params) / 1e9)
 

@@ -1,0 +1,70 @@
+"""Normalization layers vs the flax reference implementations.
+
+GroupNorm32/LayerNorm32 restructure the statistics computation for TPU
+layout/bandwidth (channels-last reductions, affine folded to one FMA) —
+these tests pin them to nn.GroupNorm/nn.LayerNorm numerics so layout
+optimizations can never drift the math.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cassmantle_tpu.models.layers import GroupNorm32, LayerNorm32
+
+
+def test_groupnorm_matches_flax_fp32():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 64),
+                          jnp.float32) * 3.0 + 1.5
+    ours = GroupNorm32(num_groups=16)
+    ref = nn.GroupNorm(num_groups=16, epsilon=1e-5)
+    p_ours = ours.init(jax.random.PRNGKey(1), x)
+    p_ref = ref.init(jax.random.PRNGKey(1), x)
+    # non-trivial affine params, mapped into each layout
+    scale = jax.random.normal(jax.random.PRNGKey(2), (64,)) + 1.0
+    bias = jax.random.normal(jax.random.PRNGKey(3), (64,))
+    p_ours = {"params": {"norm": {"scale": scale, "bias": bias}}}
+    p_ref = {"params": {"scale": scale, "bias": bias}}
+    np.testing.assert_allclose(
+        np.asarray(ours.apply(p_ours, x)),
+        np.asarray(ref.apply(p_ref, x)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_groupnorm_bf16_activation_close_to_fp32_ref():
+    x32 = jax.random.normal(jax.random.PRNGKey(4), (2, 16, 16, 32),
+                            jnp.float32)
+    gn = GroupNorm32(num_groups=8)
+    params = gn.init(jax.random.PRNGKey(5), x32)
+    out32 = gn.apply(params, x32)
+    out16 = gn.apply(params, x32.astype(jnp.bfloat16))
+    assert out16.dtype == jnp.bfloat16
+    # fp32 statistics keep bf16 activations within bf16 rounding error
+    np.testing.assert_allclose(np.asarray(out16, dtype=np.float32),
+                               np.asarray(out32), atol=0.06)
+
+
+def test_groupnorm_constant_input_is_bias():
+    # zero variance: output must be exactly the bias (rsqrt(eps) * 0)
+    x = jnp.full((1, 4, 4, 16), 7.0, jnp.float32)
+    gn = GroupNorm32(num_groups=4)
+    params = gn.init(jax.random.PRNGKey(6), x)
+    out = gn.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-3)
+
+
+def test_layernorm_matches_flax_fp32():
+    x = jax.random.normal(jax.random.PRNGKey(7), (3, 17, 96),
+                          jnp.float32) * 2.0 - 0.5
+    ours = LayerNorm32()
+    ref = nn.LayerNorm(epsilon=1e-5)
+    scale = jax.random.normal(jax.random.PRNGKey(8), (96,)) + 1.0
+    bias = jax.random.normal(jax.random.PRNGKey(9), (96,))
+    p = {"params": {"scale": scale, "bias": bias}}
+    np.testing.assert_allclose(
+        np.asarray(ours.apply(p, x)),
+        np.asarray(ref.apply(p, x)),
+        rtol=2e-5, atol=2e-5,
+    )

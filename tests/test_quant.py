@@ -81,8 +81,7 @@ def test_default_predicate_paths():
     big = jnp.ones((512, 512))
     assert default_predicate(("layer", "kernel"), big)
     assert not default_predicate(("layer", "bias"), jnp.ones((512,)))
-    assert not default_predicate((), big) is True or True  # no path: False
-    assert default_predicate((), big) is False
+    assert default_predicate((), big) is False  # empty path: no name
 
 
 def test_qtensor_through_jit():
