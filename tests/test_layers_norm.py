@@ -19,8 +19,6 @@ def test_groupnorm_matches_flax_fp32():
                           jnp.float32) * 3.0 + 1.5
     ours = GroupNorm32(num_groups=16)
     ref = nn.GroupNorm(num_groups=16, epsilon=1e-5)
-    p_ours = ours.init(jax.random.PRNGKey(1), x)
-    p_ref = ref.init(jax.random.PRNGKey(1), x)
     # non-trivial affine params, mapped into each layout
     scale = jax.random.normal(jax.random.PRNGKey(2), (64,)) + 1.0
     bias = jax.random.normal(jax.random.PRNGKey(3), (64,))
