@@ -123,12 +123,16 @@ class RoundManager:
     async def _generate(self, seed: str, is_seed: bool) -> RoundContent:
         """Generation with regeneration-retry (reference retries failed API
         calls ≤5x, utils.py:43-61; here failed device generations retry the
-        same way before the round falls back to a replay)."""
+        same way before the round falls back to a replay). Callers hold
+        startup/buffer locks, so total retry time is deadline-bounded
+        below the lock timeout — the lock can't lapse mid-retry and let a
+        second worker interleave writes into the same slot."""
         return await retry_async(
             lambda: self.backend.generate(seed, is_seed),
             max_retries=self.max_retries,
             backoff=linear_backoff(self.retry_backoff_s),
             name="generate",
+            deadline_s=0.8 * self.lock_timeout,
         )
 
     # -- content helpers --------------------------------------------------
@@ -171,7 +175,7 @@ class RoundManager:
                 title = self.select_seed()
                 await self.init_story(title)
                 with metrics.timer("round.generate_s"):
-                    content = await self.backend.generate(title, is_seed=True)
+                    content = await self._generate(title, is_seed=True)
                 await self._store_content("current", content)
                 await self.store.hincrby(STORY_KEY, "episode", 1)
                 metrics.inc("rounds.generated")
@@ -193,7 +197,7 @@ class RoundManager:
                     log.info("restarting storyline")
                     await self.store.hset(STORY_KEY, "next", seed)
                 with metrics.timer("round.generate_s"):
-                    content = await self.backend.generate(seed, is_seed)
+                    content = await self._generate(seed, is_seed)
                 await self._store_content("next", content)
                 metrics.inc("rounds.buffered")
                 log.info("content buffering complete")
@@ -216,8 +220,24 @@ class RoundManager:
                     log.warning("no buffered content; replaying round")
                     metrics.inc("rounds.replays")
                     return
-                await self.store.hset(PROMPT_KEY, "current", prompt_next)
-                await self.store.hset(IMAGE_KEY, "current", image_next)
+                prompt_prev = await self.store.hget(PROMPT_KEY, "current")
+                image_prev = await self.store.hget(IMAGE_KEY, "current")
+                try:
+                    await self.store.hset(PROMPT_KEY, "current", prompt_next)
+                    await self.store.hset(IMAGE_KEY, "current", image_next)
+                except Exception:
+                    # the two current-slot writes span two store keys and
+                    # are not atomic; a failure between them would serve a
+                    # prompt that doesn't match the image for a whole
+                    # round. Best-effort rollback to the consistent old
+                    # pair keeps the replay contract true.
+                    log.exception("promotion write failed; rolling back")
+                    if prompt_prev is not None and image_prev is not None:
+                        await self.store.hset(
+                            PROMPT_KEY, "current", prompt_prev)
+                        await self.store.hset(
+                            IMAGE_KEY, "current", image_prev)
+                    raise
                 await self.store.hdel(PROMPT_KEY, "next")
                 await self.store.hdel(IMAGE_KEY, "next")
                 next_story = await self.store.hget(STORY_KEY, "next")
@@ -229,6 +249,11 @@ class RoundManager:
                 log.info("buffer promotion complete")
         except LockTimeout:
             log.info("promotion lock held elsewhere; skipping")
+        except Exception:
+            # reference semantics: promotion failures log and abandon the
+            # round update (backend.py:236-238); the old round replays
+            log.exception("promotion failed; old round will replay")
+            metrics.inc("rounds.promote_failures")
 
     # -- clock ------------------------------------------------------------
     async def start_countdown(self) -> None:
@@ -255,15 +280,23 @@ class RoundManager:
         buffered_this_round = False
         while True:
             await asyncio.sleep(tick)
-            remaining = await self.store.ttl(COUNTDOWN_KEY)
-            metrics.gauge("round.remaining_s", remaining)
-            if remaining <= 0:
-                await self.rollover()
-                buffered_this_round = False
-                continue
-            if remaining <= buffer_trigger and not buffered_this_round:
-                buffered_this_round = True
-                asyncio.ensure_future(self.buffer_contents())
+            try:
+                remaining = await self.store.ttl(COUNTDOWN_KEY)
+                metrics.gauge("round.remaining_s", remaining)
+                if remaining <= 0:
+                    await self.rollover()
+                    buffered_this_round = False
+                    continue
+                if remaining <= buffer_trigger and not buffered_this_round:
+                    buffered_this_round = True
+                    asyncio.ensure_future(self.buffer_contents())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the clock is the one task that must never die: a store
+                # hiccup skips this tick and the next tick retries
+                log.exception("timer tick failed; continuing")
+                metrics.inc("rounds.timer_tick_failures")
 
     def start(self, tick: float = 1.0) -> asyncio.Task:
         self._timer_task = asyncio.ensure_future(self.global_timer(tick))

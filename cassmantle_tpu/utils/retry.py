@@ -35,10 +35,19 @@ async def retry_async(
     backoff: Optional[Callable[[int], float]] = None,
     sleep: Callable[[float], Awaitable[None]] = asyncio.sleep,
     name: str = "op",
+    deadline_s: Optional[float] = None,
 ) -> T:
     """Run ``op`` with up to ``max_retries`` attempts; re-raises the last
-    failure (callers keep skip-don't-crash semantics at their level)."""
+    failure (callers keep skip-don't-crash semantics at their level).
+
+    ``deadline_s`` bounds total wall time: no further attempt starts once
+    elapsed + the next backoff would pass it. Callers that retry while
+    holding an expiring lock set this below the lock timeout, so the lock
+    cannot lapse mid-retry and admit a second worker (a started attempt
+    can still overrun — an in-flight device call is not preemptible)."""
     backoff = backoff or linear_backoff()
+    loop = asyncio.get_event_loop()
+    start = loop.time()
     last: Optional[BaseException] = None
     for attempt in range(max_retries):
         try:
@@ -49,6 +58,12 @@ async def retry_async(
             log.warning("%s attempt %d/%d failed: %s",
                         name, attempt + 1, max_retries, exc)
             if attempt + 1 < max_retries:
-                await sleep(backoff(attempt))
+                pause = backoff(attempt)
+                if deadline_s is not None and \
+                        loop.time() - start + pause >= deadline_s:
+                    log.warning("%s: deadline %.0fs reached after %d "
+                                "attempts", name, deadline_s, attempt + 1)
+                    break
+                await sleep(pause)
     assert last is not None
     raise last

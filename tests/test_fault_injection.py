@@ -1,0 +1,244 @@
+"""Fault injection for the round lifecycle (SURVEY.md §5.3).
+
+The reference's failure story is skip-don't-crash: failed generation
+leaves the buffer empty and the old round silently replays (reference
+backend.py:211-215), retries wrap each API call (utils.py:43-61), and
+lock contention skips rather than errors (backend.py:123-125). These
+tests inject faults — failing backends, flaky stores, contended locks —
+and assert the game keeps serving through all of them.
+"""
+
+import asyncio
+import dataclasses
+import random
+
+import pytest
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.engine.content import (
+    FakeContentBackend,
+    hash_embed,
+    hash_similarity,
+)
+from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.engine.rounds import ContentBackend
+from cassmantle_tpu.engine.store import MemoryStore
+
+
+class FlakyBackend(ContentBackend):
+    """Fails the first ``failures`` generate calls, then delegates."""
+
+    def __init__(self, failures: int, image_size: int = 32) -> None:
+        self.remaining_failures = failures
+        self.inner = FakeContentBackend(image_size=image_size)
+        self.calls = 0
+
+    async def generate(self, seed, is_seed):
+        self.calls += 1
+        if self.remaining_failures > 0:
+            self.remaining_failures -= 1
+            raise RuntimeError("injected generation failure")
+        return await self.inner.generate(seed, is_seed)
+
+
+class DeadBackend(ContentBackend):
+    async def generate(self, seed, is_seed):
+        raise RuntimeError("device lost")
+
+
+class FlakyStore(MemoryStore):
+    """MemoryStore that raises on a seeded fraction of mutating ops
+    AFTER startup completes (``arm()``)."""
+
+    def __init__(self, fail_rate: float, seed: int = 0) -> None:
+        super().__init__()
+        self.fail_rate = fail_rate
+        self.rng = random.Random(seed)
+        self.armed = False
+
+    def _maybe_fail(self):
+        if self.armed and self.rng.random() < self.fail_rate:
+            raise ConnectionError("injected store failure")
+
+    async def hset(self, key, field=None, value=None, mapping=None):
+        self._maybe_fail()
+        return await super().hset(key, field, value, mapping)
+
+    async def hdel(self, key, *fields):
+        self._maybe_fail()
+        return await super().hdel(key, *fields)
+
+    async def setex(self, key, ttl, value):
+        self._maybe_fail()
+        return await super().setex(key, ttl, value)
+
+
+def make_game(backend, store=None, time_per_prompt=2.0, retries=2):
+    cfg = _tiny_config()
+    cfg = cfg.replace(game=dataclasses.replace(
+        cfg.game, time_per_prompt=time_per_prompt,
+    ))
+    store = store if store is not None else MemoryStore()
+    game = Game(cfg, store, backend, hash_embed, hash_similarity)
+    game.rounds.max_retries = retries
+    game.rounds.retry_backoff_s = 0.0
+    return game
+
+
+@pytest.mark.asyncio
+async def test_transient_generation_failure_recovers_via_retry():
+    """A backend that fails once per call site still produces a round:
+    the regeneration retry (reference ≤5 API retries) absorbs it."""
+    backend = FlakyBackend(failures=1)
+    game = make_game(backend)
+    await game.rounds.startup()
+    assert await game.rounds.fetch_current_prompt() is not None
+    assert backend.calls >= 2              # one failure + one success
+
+
+@pytest.mark.asyncio
+async def test_buffer_failure_replays_old_round():
+    """Generation dead at buffer time -> promote is a no-op and the
+    current round replays unchanged (skip-don't-crash)."""
+    backend = FlakyBackend(failures=0)
+    game = make_game(backend)
+    await game.rounds.startup()
+    before = await game.rounds.fetch_current_prompt()
+
+    game.rounds.backend = DeadBackend()
+    await game.rounds.buffer_contents()     # swallows the failure
+    await game.rounds.promote_buffer()      # no buffer -> replay
+    after = await game.rounds.fetch_current_prompt()
+    assert after["tokens"] == before["tokens"]
+
+
+@pytest.mark.asyncio
+async def test_rollover_with_dead_backend_keeps_game_playable():
+    """Full rollover with a dead backend: clock restarts, reset flag
+    fires, sessions reset, content still served."""
+    game = make_game(FlakyBackend(failures=0))
+    await game.rounds.startup()
+    game.rounds.backend = DeadBackend()
+
+    await game.rounds.buffer_contents()
+    await game.rounds.rollover()
+    assert await game.rounds.remaining() > 0          # clock restarted
+    assert await game.rounds.fetch_current_prompt() is not None
+    img = await game.rounds.fetch_current_image()
+    assert img.shape[-1] == 3
+
+
+@pytest.mark.asyncio
+async def test_lock_contention_skips_not_errors():
+    """While another worker holds buffer/promotion locks, this worker's
+    buffer + promote SKIP silently (reference LockError -> skip,
+    backend.py:123-125) and leave state untouched."""
+    store = MemoryStore()
+    backend = FakeContentBackend(image_size=32)
+    game = make_game(backend, store=store)
+    await game.rounds.startup()
+    calls_before = backend.calls
+
+    async with store.lock("buffer_lock", timeout=30.0,
+                          blocking_timeout=0.05):
+        await game.rounds.buffer_contents()           # lock held: skip
+    assert backend.calls == calls_before
+
+    await game.rounds.buffer_contents()               # lock free: works
+    async with store.lock("promotion_lock", timeout=30.0,
+                          blocking_timeout=0.05):
+        before = await game.rounds.fetch_current_prompt()
+        await game.rounds.promote_buffer()            # lock held: skip
+        assert (await game.rounds.fetch_current_prompt())["tokens"] \
+            == before["tokens"]
+    await game.rounds.promote_buffer()                # lock free: promotes
+    assert (await game.rounds.fetch_current_prompt())["tokens"] \
+        != before["tokens"]
+
+
+class FailOnWrite(MemoryStore):
+    """Raises on the Nth hset call (counting from 1)."""
+
+    def __init__(self, fail_on_call: int) -> None:
+        super().__init__()
+        self.fail_on_call = fail_on_call
+        self.count = 0
+        self.armed = False
+
+    async def hset(self, key, field=None, value=None, mapping=None):
+        if self.armed:
+            self.count += 1
+            if self.count == self.fail_on_call:
+                raise ConnectionError("injected write failure")
+        return await super().hset(key, field, value, mapping)
+
+
+@pytest.mark.asyncio
+async def test_half_promotion_rolls_back_to_consistent_pair():
+    """Store dies between the prompt and image current-slot writes: the
+    prompt write must roll back so the served (prompt, image) pair stays
+    consistent, and the buffer survives for the next attempt."""
+    store = FailOnWrite(fail_on_call=2)   # 1st armed hset = prompt.current
+    game = make_game(FakeContentBackend(image_size=32), store=store)
+    await game.rounds.startup()
+    before = await game.rounds.fetch_current_prompt()
+    await game.rounds.buffer_contents()
+
+    store.armed = True                     # fail on the image write
+    await game.rounds.promote_buffer()     # swallowed by the broad except
+    store.armed = False
+
+    after = await game.rounds.fetch_current_prompt()
+    assert after["tokens"] == before["tokens"]          # rolled back
+    assert await store.hget("prompt", "next") is not None  # buffer intact
+    await game.rounds.promote_buffer()     # healthy store: promotes now
+    final = await game.rounds.fetch_current_prompt()
+    assert final["tokens"] != before["tokens"]
+
+
+@pytest.mark.asyncio
+async def test_retry_deadline_bounds_lock_hold_time():
+    """_generate's retry loop gives up before 0.8x lock_timeout so the
+    lock can't expire mid-retry (multi-worker write interleaving)."""
+    import time
+
+    backend = DeadBackend()
+    game = make_game(backend, retries=50)
+    game.rounds.retry_backoff_s = 0.2
+    game.rounds.lock_timeout = 1.0         # deadline = 0.8 s
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        await game.rounds._generate("seed", True)
+    assert time.monotonic() - t0 < 2.0     # not 50 x backoff
+
+
+@pytest.mark.asyncio
+async def test_chaos_rounds_with_random_faults():
+    """Chaos drive: several fast rounds with a backend failing ~40% of
+    calls and a store failing ~10% of mutations (content writes AND the
+    clock's setex). The invariant through every round: current content
+    exists — some rounds replay, some ticks skip, none crash, the timer
+    survives."""
+    store = FlakyStore(fail_rate=0.10, seed=7)
+    backend = FlakyBackend(failures=0)
+    game = make_game(backend, store=store, time_per_prompt=0.4, retries=1)
+    await game.rounds.startup()
+    store.armed = True
+    rng = random.Random(3)
+
+    task = game.rounds.start(tick=0.05)
+    try:
+        for _ in range(10):
+            # re-arm random failures on the generation path
+            if rng.random() < 0.4:
+                game.rounds.backend = DeadBackend()
+            else:
+                game.rounds.backend = backend
+            await asyncio.sleep(0.15)
+            prompt = await game.rounds.fetch_current_prompt()
+            assert prompt["tokens"]
+            img = await game.rounds.fetch_current_image()
+            assert img.size > 0
+        assert not task.done()                         # timer never died
+    finally:
+        await game.rounds.stop()
