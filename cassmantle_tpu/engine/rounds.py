@@ -97,6 +97,7 @@ class RoundManager:
         # sessions there, mirroring server.py:168).
         self.on_promote = on_promote
         self._timer_task: Optional[asyncio.Task] = None
+        self._buffer_task: Optional[asyncio.Task] = None
 
     # -- story ------------------------------------------------------------
     def select_seed(self) -> str:
@@ -284,12 +285,18 @@ class RoundManager:
                 remaining = await self.store.ttl(COUNTDOWN_KEY)
                 metrics.gauge("round.remaining_s", remaining)
                 if remaining <= 0:
-                    await self.rollover()
+                    # clear BEFORE rollover: if rollover partially fails
+                    # (clock restarted, reset flag lost), the new round
+                    # must still buffer rather than silently replay
                     buffered_this_round = False
+                    await self.rollover()
                     continue
                 if remaining <= buffer_trigger and not buffered_this_round:
                     buffered_this_round = True
-                    asyncio.ensure_future(self.buffer_contents())
+                    # strong reference: the loop only weakly references
+                    # tasks, and a GC'd task would vanish mid-generation
+                    self._buffer_task = asyncio.ensure_future(
+                        self.buffer_contents())
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -303,10 +310,12 @@ class RoundManager:
         return self._timer_task
 
     async def stop(self) -> None:
-        if self._timer_task is not None:
-            self._timer_task.cancel()
-            try:
-                await self._timer_task
-            except asyncio.CancelledError:
-                pass
-            self._timer_task = None
+        for attr in ("_timer_task", "_buffer_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                setattr(self, attr, None)

@@ -11,6 +11,8 @@ Route-for-route parity with the reference (SURVEY.md §1 L4, §3.3-3.5):
                             (main.py:113-120)
 - ``WS   /clock``          1 Hz {time, reset, conns} push (main.py:55-79)
 - ``GET  /metrics``        counters/timings (new; SURVEY.md §5.5)
+- ``POST /debug/trace``    on-demand jax.profiler capture (new; §5.1;
+                            loopback only)
 - static mounts ``/static`` and ``/data`` (main.py:25-27)
 
 Rate limits mirror the reference: 3/s default, 2/s API routes, per IP.
@@ -20,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import tempfile
 import uuid
 from typing import Optional
 
@@ -44,6 +47,7 @@ DATA_DIR = os.path.join(
 
 _GAME = web.AppKey("game", Game)
 _HEALTH = web.AppKey("health", object)
+_TRACE_ACTIVE = web.AppKey("trace_active", bool)
 
 
 def _client_ip(request: web.Request) -> str:
@@ -212,6 +216,45 @@ async def handle_healthz(request: web.Request) -> web.Response:
     )
 
 
+async def handle_debug_trace(request: web.Request) -> web.Response:
+    """On-demand jax.profiler capture (SURVEY.md §5.1 — the reference has
+    no tracing at all): ``POST /debug/trace?seconds=N[&dir=path]``
+    records N seconds of device+host activity to a TensorBoard trace
+    directory while live traffic runs, and returns its path. One capture
+    at a time; loopback only (an operator surface, not a player one)."""
+    # fail closed: an unresolvable peer (None — e.g. unix-socket behind a
+    # proxy) is NOT treated as local
+    if request.remote not in ("127.0.0.1", "::1"):
+        raise web.HTTPForbidden(text="loopback only")
+    try:
+        seconds = min(60.0, float(request.query.get("seconds", "5")))
+    except ValueError:
+        raise web.HTTPBadRequest(text="seconds must be a number")
+    log_dir = request.query.get(
+        "dir", os.path.join(tempfile.gettempdir(), "cassmantle_trace")
+    )
+    app = request.app
+    if app.get(_TRACE_ACTIVE):
+        raise web.HTTPConflict(text="a trace capture is already running")
+    app[_TRACE_ACTIVE] = True
+    try:
+        import jax
+
+        loop = asyncio.get_event_loop()
+        # start/stop in an executor: the first profiler call can trigger
+        # jax backend init, which must never block the serving event loop
+        await loop.run_in_executor(
+            None, jax.profiler.start_trace, log_dir)
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            await loop.run_in_executor(None, jax.profiler.stop_trace)
+    finally:
+        app[_TRACE_ACTIVE] = False
+    metrics.inc("server.trace_captures")
+    return web.json_response({"trace_dir": log_dir, "seconds": seconds})
+
+
 async def handle_wordlist(request: web.Request) -> web.Response:
     """Dictionary + stopwords for client-side spellcheck (replaces the
     reference's vendored hunspell dictionary + typo.js, §2 F3; the client
@@ -246,6 +289,7 @@ def create_app(game: Game, cfg: FrameworkConfig,
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/wordlist", handle_wordlist)
+    app.router.add_post("/debug/trace", handle_debug_trace)
     if os.path.isdir(STATIC_DIR):
         app.router.add_static("/static", STATIC_DIR)
     if os.path.isdir(DATA_DIR):
@@ -325,7 +369,17 @@ def main() -> None:
                              "DDIM-50; sdxl = SDXL-base 1024 (the "
                              "reference's image model); fast = SD1.5 "
                              "with DPM++(2M) @ 25 steps")
+    parser.add_argument("--platform", default="auto",
+                        choices=("auto", "cpu"),
+                        help="'cpu' pins jax to host devices — e.g. "
+                             "--fake serving on a box whose accelerator "
+                             "tunnel is absent or down")
     args = parser.parse_args()
+
+    if args.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
 
     if args.preset == "sdxl":
         from cassmantle_tpu.config import sdxl_config

@@ -224,3 +224,23 @@ async def test_index_served():
         assert "CassMantle" in text
     finally:
         await client.close()
+
+
+@pytest.mark.asyncio
+async def test_debug_trace_endpoint(tmp_path):
+    """POST /debug/trace captures a jax.profiler trace while traffic
+    runs and is single-flight + loopback-guarded."""
+    client, _ = await make_client(make_cfg())
+    try:
+        res = await client.post(
+            f"/debug/trace?seconds=0.2&dir={tmp_path / 'tr'}")
+        assert res.status == 200
+        data = await res.json()
+        assert data["trace_dir"].endswith("tr")
+        import os as _os
+
+        assert _os.path.isdir(data["trace_dir"])      # trace written
+        res = await client.post("/debug/trace?seconds=abc")
+        assert res.status == 400
+    finally:
+        await client.close()
