@@ -11,6 +11,7 @@ with the blur applied on device (ops/blur.py) instead of per-request PIL.
 from __future__ import annotations
 
 import asyncio
+import zlib
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -69,6 +70,11 @@ class Game:
             on_promote=self._reset_sessions,
         )
         self.blur_fn = blur_fn or _pil_blur
+        # blur bucket -> base64 JPEG, all for one round image identified
+        # by _image_cache_key (int version, or a byte fingerprint tuple
+        # for legacy stores)
+        self._image_cache: Dict[float, str] = {}
+        self._image_cache_key: object = None
 
     def _load_seeds(self) -> list:
         from cassmantle_tpu.server.assets import load_seeds
@@ -108,16 +114,64 @@ class Game:
         if not await self.sessions.exists(session):
             await self.init_client(session)
 
-    async def fetch_masked_image(self, session: str) -> np.ndarray:
-        """Per-session progressive reveal (server.py:129-133)."""
+    async def _reveal_radius(self, session: str) -> float:
+        """The one place the score -> blur-radius curve is applied."""
         scores = await self.sessions.fetch_scores(session)
-        image = await self.rounds.fetch_current_image()
         best = float(scores.get("max", self.cfg.game.min_score))
-        radius = score_to_blur(
+        return score_to_blur(
             best, self.cfg.game.min_blur, self.cfg.game.max_blur
         )
+
+    async def fetch_masked_image(self, session: str) -> np.ndarray:
+        """Per-session progressive reveal (server.py:129-133)."""
+        radius = await self._reveal_radius(session)
+        image = await self.rounds.fetch_current_image()
         with metrics.timer("game.blur_s"):
             return self.blur_fn(image, radius)
+
+    async def fetch_masked_image_b64(self, session: str) -> str:
+        """The hot-request form of the reveal: blur radii quantize to
+        0.5-px buckets and each (round image, bucket) renders ONCE —
+        later requests reuse the cached base64 JPEG. The reference
+        decoded, blurred (PIL), and re-encoded per request (SURVEY.md
+        §3.3 'CPU hot spot'); with ≤31 buckets a round's entire blur
+        ladder amortizes to 31 renders regardless of player count.
+
+        Invalidation keys on the round's monotonic image version
+        (rounds.py bumps it after every current-image write), so cache
+        hits cost a few store bytes, not the full JPEG — and promotions
+        by OTHER workers through a shared store invalidate too. The
+        version is read BEFORE the bytes, and versions bump only after
+        bytes land, so a (version, bytes) pair can never cache newer-
+        looking-than-it-is content; a render that straddles a promotion
+        is served but not cached (version 0 = legacy store: fall back
+        to fingerprinting the bytes)."""
+        radius = await self._reveal_radius(session)
+        bucket = round(radius * 2.0) / 2.0
+        ver: object = await self.rounds.current_image_version()
+        raw: Optional[bytes] = None
+        if ver == 0:
+            raw = await self.rounds.fetch_current_image_bytes()
+            ver = (len(raw), zlib.crc32(raw))
+        if ver != self._image_cache_key:
+            self._image_cache_key = ver
+            self._image_cache.clear()
+        cached = self._image_cache.get(bucket)
+        if cached is not None:
+            metrics.inc("game.image_cache_hits")
+            return cached
+        metrics.inc("game.image_cache_misses")
+        from cassmantle_tpu.utils.codec import decode_jpeg, image_to_base64
+
+        if raw is None:
+            raw = await self.rounds.fetch_current_image_bytes()
+        image = decode_jpeg(raw)
+        with metrics.timer("game.blur_s"):
+            blurred = self.blur_fn(image, bucket)
+        encoded = image_to_base64(np.asarray(blurred))
+        if ver == self._image_cache_key:
+            self._image_cache[bucket] = encoded
+        return encoded
 
     async def fetch_prompt_json(self, session: str) -> Dict[str, object]:
         """Client-visible prompt state (server.py:96-123): solved masks are

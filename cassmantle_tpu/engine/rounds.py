@@ -144,16 +144,33 @@ class RoundManager:
         await self.store.hset(PROMPT_KEY, "seed", content.prompt_text)
         await self.store.hset(PROMPT_KEY, slot, json.dumps(prompt_state))
         await self.store.hset(IMAGE_KEY, slot, encode_jpeg(content.image))
+        if slot == "current":
+            await self._bump_image_version()
+
+    async def _bump_image_version(self) -> None:
+        """Monotonic counter, bumped AFTER every current-image write (so
+        a version implies its bytes are already in place) — readers use
+        it as a cheap cross-worker cache-invalidation key instead of
+        fetching and fingerprinting the full JPEG per request."""
+        await self.store.hincrby(IMAGE_KEY, "version", 1)
+
+    async def current_image_version(self) -> int:
+        """0 means a store written before versioning (legacy/fresh)."""
+        raw = await self.store.hget(IMAGE_KEY, "version")
+        return int(raw) if raw is not None else 0
 
     async def fetch_current_prompt(self) -> Dict[str, object]:
         raw = await self.store.hget(PROMPT_KEY, "current")
         assert raw is not None, "no current prompt available"
         return json.loads(raw.decode())
 
-    async def fetch_current_image(self) -> np.ndarray:
+    async def fetch_current_image_bytes(self) -> bytes:
         raw = await self.store.hget(IMAGE_KEY, "current")
         assert raw is not None, "no current image available"
-        return decode_jpeg(raw)
+        return raw
+
+    async def fetch_current_image(self) -> np.ndarray:
+        return decode_jpeg(await self.fetch_current_image_bytes())
 
     async def current_masks(self) -> list:
         return list((await self.fetch_current_prompt())["masks"])
@@ -238,7 +255,10 @@ class RoundManager:
                             PROMPT_KEY, "current", prompt_prev)
                         await self.store.hset(
                             IMAGE_KEY, "current", image_prev)
+                        # the restore is also a current-image change
+                        await self._bump_image_version()
                     raise
+                await self._bump_image_version()
                 await self.store.hdel(PROMPT_KEY, "next")
                 await self.store.hdel(IMAGE_KEY, "next")
                 next_story = await self.store.hget(STORY_KEY, "next")

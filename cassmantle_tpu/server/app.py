@@ -26,12 +26,10 @@ import tempfile
 import uuid
 from typing import Optional
 
-import numpy as np
 from aiohttp import WSMsgType, web
 
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.engine.game import Game
-from cassmantle_tpu.utils.codec import image_to_base64
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("app")
@@ -119,11 +117,11 @@ async def handle_fetch_contents(request: web.Request) -> web.Response:
     session = _session_id(request) or str(uuid.uuid4())
     await game.ensure_client(session)
     with metrics.timer("http.fetch_contents_s"):
-        image = await game.fetch_masked_image(session)
+        image_b64 = await game.fetch_masked_image_b64(session)
         prompt = await game.fetch_prompt_json(session)
         story = await game.fetch_story()
     response = web.json_response({
-        "image": image_to_base64(np.asarray(image)),
+        "image": image_b64,
         "prompt": prompt,
         "story": story,
     })

@@ -211,3 +211,57 @@ async def test_clock_payload_shape():
     payload = await game.clock_payload()
     assert set(payload) == {"time", "reset", "conns"}
     assert ":" in payload["time"]
+
+
+@pytest.mark.asyncio
+async def test_masked_image_b64_cache_hit_and_promote_invalidation():
+    """The hot-path reveal caches (round image, blur bucket) -> base64:
+    same-bucket requests render once; a promotion (new image bytes)
+    invalidates the cache."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    game, _ = make_game()
+    await game.rounds.startup()
+    await game.init_client("s1")
+    await game.init_client("s2")
+
+    before = dict(metrics.snapshot()["counters"])
+    b1 = await game.fetch_masked_image_b64("s1")
+    b2 = await game.fetch_masked_image_b64("s2")     # same bucket -> hit
+    assert b1 == b2
+    after = dict(metrics.snapshot()["counters"])
+    assert after.get("game.image_cache_hits", 0) \
+        - before.get("game.image_cache_hits", 0) == 1
+    assert after.get("game.image_cache_misses", 0) \
+        - before.get("game.image_cache_misses", 0) == 1
+
+    # b64 payload decodes back to the round image shape
+    import base64
+
+    from cassmantle_tpu.utils.codec import decode_jpeg
+
+    img = decode_jpeg(base64.b64decode(b1))
+    assert img.shape[-1] == 3
+
+    # promotion swaps the bytes -> old cache entries must not serve
+    await game.rounds.buffer_contents()
+    await game.rounds.promote_buffer()
+    b3 = await game.fetch_masked_image_b64("s1")
+    assert b3 != b1
+
+
+@pytest.mark.asyncio
+async def test_masked_image_b64_bucket_separates_scores():
+    """A solved session (score 1 -> radius 0) must NOT be served the
+    blurred cache entry of an unsolved one."""
+    game, _ = make_game()
+    await game.rounds.startup()
+    await game.init_client("fresh")
+    await game.init_client("winner")
+    masks = await game.rounds.current_masks()
+    await game.sessions.set_scores(
+        "winner", {str(m): 1.0 for m in masks})
+
+    blurred = await game.fetch_masked_image_b64("fresh")
+    sharp = await game.fetch_masked_image_b64("winner")
+    assert blurred != sharp
