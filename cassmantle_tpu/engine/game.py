@@ -75,6 +75,8 @@ class Game:
         # for legacy stores)
         self._image_cache: Dict[float, str] = {}
         self._image_cache_key: object = None
+        # bucket -> in-flight render future (single-flight misses)
+        self._image_renders: Dict[float, asyncio.Future] = {}
 
     def _load_seeds(self) -> list:
         from cassmantle_tpu.server.assets import load_seeds
@@ -141,26 +143,52 @@ class Game:
         (rounds.py bumps it after every current-image write), so cache
         hits cost a few store bytes, not the full JPEG — and promotions
         by OTHER workers through a shared store invalidate too. The
-        version is read BEFORE the bytes, and versions bump only after
-        bytes land, so a (version, bytes) pair can never cache newer-
-        looking-than-it-is content; a render that straddles a promotion
-        is served but not cached (version 0 = legacy store: fall back
-        to fingerprinting the bytes)."""
+        version is read BEFORE the bytes and re-read AFTER rendering:
+        versions bump only after bytes land, so equality across the
+        render proves the bytes belonged to that version — a render
+        that straddles a promotion is served but never cached. Misses
+        are single-flight per bucket: the reset-flag refetch stampede
+        (every client at once, right after invalidation) coalesces to
+        one decode+blur+encode. (Version 0 = legacy store: fall back to
+        fingerprinting the bytes.)"""
         radius = await self._reveal_radius(session)
         bucket = round(radius * 2.0) / 2.0
         ver: object = await self.rounds.current_image_version()
-        raw: Optional[bytes] = None
+        legacy_raw: Optional[bytes] = None
         if ver == 0:
-            raw = await self.rounds.fetch_current_image_bytes()
-            ver = (len(raw), zlib.crc32(raw))
+            legacy_raw = await self.rounds.fetch_current_image_bytes()
+            ver = (len(legacy_raw), zlib.crc32(legacy_raw))
         if ver != self._image_cache_key:
             self._image_cache_key = ver
             self._image_cache.clear()
+            self._image_renders = {}
         cached = self._image_cache.get(bucket)
         if cached is not None:
             metrics.inc("game.image_cache_hits")
             return cached
+        inflight = self._image_renders.get(bucket)
+        if inflight is not None:
+            metrics.inc("game.image_cache_hits")
+            return await asyncio.shield(inflight)
         metrics.inc("game.image_cache_misses")
+        future = asyncio.get_event_loop().create_future()
+        self._image_renders[bucket] = future
+        try:
+            encoded = await self._render_bucket(bucket, ver, legacy_raw)
+            future.set_result(encoded)
+        except BaseException as exc:
+            future.set_exception(exc)
+            # a Future exception nobody awaits logs noisily at GC time;
+            # the waiters (if any) re-raise it, and we re-raise below
+            future.exception()
+            raise
+        finally:
+            if self._image_renders.get(bucket) is future:
+                del self._image_renders[bucket]
+        return encoded
+
+    async def _render_bucket(self, bucket: float, ver: object,
+                             raw: Optional[bytes]) -> str:
         from cassmantle_tpu.utils.codec import decode_jpeg, image_to_base64
 
         if raw is None:
@@ -169,8 +197,14 @@ class Game:
         with metrics.timer("game.blur_s"):
             blurred = self.blur_fn(image, bucket)
         encoded = image_to_base64(np.asarray(blurred))
-        if ver == self._image_cache_key:
-            self._image_cache[bucket] = encoded
+        # cache only if the version is provably still current: bumps
+        # happen after bytes land, so unchanged version == our bytes
+        # belong to it (isinstance check skips the re-read for legacy
+        # fingerprint keys, which are derived from the bytes anyway)
+        if not isinstance(ver, int) or \
+                ver == await self.rounds.current_image_version():
+            if ver == self._image_cache_key:
+                self._image_cache[bucket] = encoded
         return encoded
 
     async def fetch_prompt_json(self, session: str) -> Dict[str, object]:

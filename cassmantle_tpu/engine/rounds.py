@@ -151,7 +151,17 @@ class RoundManager:
         """Monotonic counter, bumped AFTER every current-image write (so
         a version implies its bytes are already in place) — readers use
         it as a cheap cross-worker cache-invalidation key instead of
-        fetching and fingerprinting the full JPEG per request."""
+        fetching and fingerprinting the full JPEG per request.
+
+        The counter starts at a RANDOM offset: after a store flush the
+        count would otherwise restart at 1 and collide with a version a
+        worker already cached for the pre-flush round, serving stale
+        images until the next promotion."""
+        if await self.store.hget(IMAGE_KEY, "version") is None:
+            await self.store.hset(
+                IMAGE_KEY, "version",
+                str(self.rng.getrandbits(48)),
+            )
         await self.store.hincrby(IMAGE_KEY, "version", 1)
 
     async def current_image_version(self) -> int:

@@ -23,16 +23,40 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, axis_name: str, scale: float):
-    """Per-shard body (runs under shard_map). q/k/v: (B, S_l, H, D)."""
-    n = jax.lax.psum(1, axis_name)
+def _ring_attention_local(q, k, v, axis_name: str, scale: float,
+                          causal: bool):
+    """Per-shard body (runs under shard_map). q/k/v: (B, S_l, H, D).
 
-    def step(carry, _):
+    Causal mode (the LM long-context path): with the sequence sharded
+    contiguously, at ring step ``i`` this device holds the K/V block that
+    ORIGINATED on device ``(j - i) mod n``; masking compares global
+    positions. Step 0 is the local (diagonal) block, where every query
+    sees at least itself — so the running max is finite from the first
+    step and fully-masked later blocks contribute exp(-1e30 - m) = 0,
+    keeping the online softmax NaN-free with additive finite masking.
+
+    Known trade-off: fully-masked blocks still compute their QK^T in
+    SPMD lockstep (wall-time neutral — at every ring step some device
+    computes a live block, so the critical path is one block either
+    way — but ~2x the attention FLOPs/energy of a load-balanced
+    zigzag layout, where each device holds two symmetric sequence
+    slices; that schedule is the planned upgrade for 16k+ training)."""
+    n = jax.lax.psum(1, axis_name)
+    j = jax.lax.axis_index(axis_name)
+    s_l = q.shape[1]
+    q_pos = j * s_l + jnp.arange(s_l)                      # global q idx
+
+    def step(carry, i):
         k_cur, v_cur, m, l, acc = carry
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_cur,
             preferred_element_type=jnp.float32,
         ) * scale
+        if causal:
+            origin = (j - i) % n                           # block owner
+            k_pos = origin * s_l + jnp.arange(s_l)
+            visible = q_pos[:, None] >= k_pos[None, :]     # (S_l, S_l)
+            s = jnp.where(visible[None, None], s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
         alpha = jnp.exp(m - m_new)
@@ -43,7 +67,7 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float):
             preferred_element_type=jnp.float32,
         )
         acc_new = acc * alpha + pv
-        perm = [(i, (i + 1) % n) for i in range(n)]
+        perm = [(r, (r + 1) % n) for r in range(n)]
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_next, v_next, m_new, l_new, acc_new), None
@@ -56,7 +80,7 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float):
     l0 = vary(jnp.zeros((b, h, s_l, 1), dtype=jnp.float32))
     acc0 = vary(jnp.zeros((b, h, s_l, d), dtype=jnp.float32))
     (_, _, _, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, acc0), None, length=n
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
     )
     out = acc / l
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
@@ -69,13 +93,18 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention. Global shapes (B, S, H, D); S shards
-    over ``axis_name``; every other dim is replicated across that axis."""
+    over ``axis_name``; every other dim is replicated across that axis.
+    ``causal=True`` applies the LM triangular mask on global positions
+    (sequence shards must be contiguous slices, which is how GSPMD
+    shards a P(None, 'sp', ...) spec)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, scale=float(scale)
+        _ring_attention_local, axis_name=axis_name, scale=float(scale),
+        causal=causal,
     )
     spec = P(None, axis_name, None, None)
     return jax.shard_map(

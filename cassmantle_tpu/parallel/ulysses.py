@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from cassmantle_tpu.ops.attention import xla_attention
 
 
-def _ulysses_local(q, k, v, axis_name: str, scale: float):
+def _ulysses_local(q, k, v, axis_name: str, scale: float, causal: bool):
     """Per-shard body. q/k/v: (B, S_l, H, D) — sequence-sharded in."""
 
     def seq_to_heads(t):
@@ -43,7 +43,13 @@ def _ulysses_local(q, k, v, axis_name: str, scale: float):
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = xla_attention(qh, kh, vh, scale=scale)
+    mask = None
+    if causal:
+        # after the all-to-all, each device sees the FULL sequence for
+        # its heads, so causal is the plain triangular mask
+        s = qh.shape[-3]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+    out = xla_attention(qh, kh, vh, mask=mask, scale=scale)
     return heads_to_seq(out)
 
 
@@ -54,11 +60,13 @@ def ulysses_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     scale=None,
+    causal: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention via head sharding.
 
     Global shapes (B, S, H, D); S shards over ``axis_name``; requires
-    ``H % mesh.shape[axis_name] == 0``.
+    ``H % mesh.shape[axis_name] == 0``. ``causal=True`` applies the LM
+    triangular mask.
     """
     n = int(mesh.shape[axis_name])
     h = q.shape[-2]
@@ -66,7 +74,8 @@ def ulysses_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     body = functools.partial(
-        _ulysses_local, axis_name=axis_name, scale=float(scale)
+        _ulysses_local, axis_name=axis_name, scale=float(scale),
+        causal=causal,
     )
     spec = P(None, axis_name, None, None)
     return jax.shard_map(

@@ -265,3 +265,31 @@ async def test_masked_image_b64_bucket_separates_scores():
     blurred = await game.fetch_masked_image_b64("fresh")
     sharp = await game.fetch_masked_image_b64("winner")
     assert blurred != sharp
+
+
+@pytest.mark.asyncio
+async def test_masked_image_b64_single_flight():
+    """Concurrent same-bucket misses coalesce to ONE render (the reset
+    stampede case: every client refetches the instant the cache was
+    invalidated)."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    game, _ = make_game()
+    await game.rounds.startup()
+    for i in range(5):
+        await game.init_client(f"c{i}")
+
+    renders = 0
+    orig = game.blur_fn
+
+    def counting_blur(image, radius):
+        nonlocal renders
+        renders += 1
+        return orig(image, radius)
+
+    game.blur_fn = counting_blur
+    results = await asyncio.gather(
+        *[game.fetch_masked_image_b64(f"c{i}") for i in range(5)]
+    )
+    assert len(set(results)) == 1
+    assert renders == 1
