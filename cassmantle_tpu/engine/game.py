@@ -75,8 +75,8 @@ class Game:
         # for legacy stores)
         self._image_cache: Dict[float, str] = {}
         self._image_cache_key: object = None
-        # bucket -> in-flight render future (single-flight misses)
-        self._image_renders: Dict[float, asyncio.Future] = {}
+        # bucket -> in-flight render task (single-flight misses)
+        self._image_renders: Dict[float, asyncio.Task] = {}
 
     def _load_seeds(self) -> list:
         from cassmantle_tpu.server.assets import load_seeds
@@ -166,26 +166,27 @@ class Game:
         if cached is not None:
             metrics.inc("game.image_cache_hits")
             return cached
-        inflight = self._image_renders.get(bucket)
-        if inflight is not None:
+        task = self._image_renders.get(bucket)
+        if task is not None:
             metrics.inc("game.image_cache_hits")
-            return await asyncio.shield(inflight)
-        metrics.inc("game.image_cache_misses")
-        future = asyncio.get_event_loop().create_future()
-        self._image_renders[bucket] = future
-        try:
-            encoded = await self._render_bucket(bucket, ver, legacy_raw)
-            future.set_result(encoded)
-        except BaseException as exc:
-            future.set_exception(exc)
-            # a Future exception nobody awaits logs noisily at GC time;
-            # the waiters (if any) re-raise it, and we re-raise below
-            future.exception()
-            raise
-        finally:
-            if self._image_renders.get(bucket) is future:
-                del self._image_renders[bucket]
-        return encoded
+        else:
+            metrics.inc("game.image_cache_misses")
+            # the render runs as its OWN task: a waiter's cancellation
+            # (client disconnect) must not cancel the shared render or
+            # propagate to the other coalesced waiters
+            task = asyncio.get_running_loop().create_task(
+                self._render_bucket(bucket, ver, legacy_raw)
+            )
+            self._image_renders[bucket] = task
+
+            def _cleanup(t: asyncio.Task, b=bucket) -> None:
+                if self._image_renders.get(b) is t:
+                    del self._image_renders[b]
+                if not t.cancelled():
+                    t.exception()   # mark retrieved (waiters re-raise it)
+
+            task.add_done_callback(_cleanup)
+        return await asyncio.shield(task)
 
     async def _render_bucket(self, bucket: float, ver: object,
                              raw: Optional[bytes]) -> str:

@@ -57,16 +57,7 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float,
             k_pos = origin * s_l + jnp.arange(s_l)
             visible = q_pos[:, None] >= k_pos[None, :]     # (S_l, S_l)
             s = jnp.where(visible[None, None], s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
-            preferred_element_type=jnp.float32,
-        )
-        acc_new = acc * alpha + pv
+        m_new, l_new, acc_new = _merge((m, l, acc), s, v_cur)
         perm = [(r, (r + 1) % n) for r in range(n)]
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
@@ -84,6 +75,168 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float,
     )
     out = acc / l
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def zigzag_permute(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Reorder the sequence axis into the zigzag layout: split into 2n
+    chunks c_0..c_{2n-1} and lay them out as [c_0, c_{2n-1}, c_1,
+    c_{2n-2}, ...] so that a contiguous n-way shard gives device j the
+    pair (c_j, c_{2n-1-j}). This balances causal-attention work: device
+    j's low chunk is early (few keys visible) exactly when its high
+    chunk is late (many keys visible)."""
+    s = x.shape[axis]
+    assert s % (2 * n) == 0, f"seq {s} not divisible by 2n={2 * n}"
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    order = [c for j in range(n) for c in (chunks[j], chunks[2 * n - 1 - j])]
+    return jnp.concatenate(order, axis=axis)
+
+
+def zigzag_unpermute(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_permute`."""
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    out: list = [None] * (2 * n)
+    for j in range(n):
+        out[j] = chunks[2 * j]
+        out[2 * n - 1 - j] = chunks[2 * j + 1]
+    return jnp.concatenate(out, axis=axis)
+
+
+def _merge(stats, logits, v_blk):
+    """Online-softmax merge of one (BQ, BK) logits block into carried
+    (m, l, acc); logits fp32 (B, H, S_q, S_k), v (B, S_k, H, D)."""
+    m, l, acc = stats
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc * alpha + pv
+
+
+def _zigzag_local(q, k, v, axis_name: str, scale: float, n: int):
+    """Per-shard body: local sequence is the pair [c_j, c_{2n-1-j}],
+    each of length S_c. Prologue handles the device's own (diagonal)
+    blocks with triangular masks; every scanned ring step then computes
+    exactly TWO fully-visible (S_c x S_c) blocks — no masking, no wasted
+    QK^T — which is the zigzag schedule's whole point:
+
+      at step i the received K/V pair originated on o = (j - i) mod n;
+      for j > o both local q chunks fully see k_low = c_o (and never
+      k_high = c_{2n-1-o}); for j < o only q_high = c_{2n-1-j} is live,
+      and it fully sees BOTH received chunks. Either way: two full
+      blocks, every device, every step.
+    """
+    j = jax.lax.axis_index(axis_name)
+    s2 = q.shape[1]
+    s_c = s2 // 2
+    ql, qh = q[:, :s_c], q[:, s_c:]
+
+    def logits(qb, kb):
+        return jnp.einsum(
+            "bqhd,bkhd->bhqk", qb, kb,
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    # -- prologue: the device's own diagonal blocks --------------------
+    tri = jnp.tril(jnp.ones((s_c, s_c), bool))[None, None]
+    b, _, h, d = q.shape
+    zeros = lambda: (  # noqa: E731
+        jnp.full((b, h, s_c, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s_c, 1), jnp.float32),
+        jnp.zeros((b, h, s_c, d), jnp.float32),
+    )
+    kl, kh, vl, vh = k[:, :s_c], k[:, s_c:], v[:, :s_c], v[:, s_c:]
+    low = _merge(zeros(), jnp.where(tri, logits(ql, kl), _NEG_INF), vl)
+    high = _merge(zeros(), jnp.where(tri, logits(qh, kh), _NEG_INF), vh)
+    high = _merge(high, logits(qh, kl), vl)   # c_{2n-1-j} fully sees c_j
+
+    # (carries derive from q/k/v, so they are already device-varying —
+    # no pcast needed, unlike _ring_attention_local's constant inits)
+
+    # -- ring: two full blocks per step --------------------------------
+    def step(carry, i):
+        kv, low, high = carry
+        k_cur, v_cur = kv
+        o = (j - i) % n
+        from_lower = j > o                     # scalar, device-varying
+        k_lo, k_hi = k_cur[:, :s_c], k_cur[:, s_c:]
+        v_lo, v_hi = v_cur[:, :s_c], v_cur[:, s_c:]
+
+        # block A: q = (j>o ? q_low : q_high), k = received low chunk.
+        # Select the DESTINATION stats first and merge once (one PV
+        # einsum), then scatter back — not merge-into-both-and-select,
+        # which would execute a third, discarded merge per step.
+        aq = jnp.where(from_lower, ql, qh)
+        sel = tuple(jnp.where(from_lower, lo, hi)
+                    for lo, hi in zip(low, high))
+        merged = _merge(sel, logits(aq, k_lo), v_lo)
+        low = tuple(jnp.where(from_lower, m, lo)
+                    for m, lo in zip(merged, low))
+        high = tuple(jnp.where(from_lower, hi, m)
+                     for m, hi in zip(merged, high))
+
+        # block B: q = q_high, k = (j>o ? received low : received high)
+        bk = jnp.where(from_lower, k_lo, k_hi)
+        bv = jnp.where(from_lower, v_lo, v_hi)
+        high = _merge(high, logits(qh, bk), bv)
+
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        kv = (jax.lax.ppermute(k_cur, axis_name, perm),
+              jax.lax.ppermute(v_cur, axis_name, perm))
+        return (kv, low, high), None
+
+    if n == 1:
+        out_low, out_high = low, high
+    else:
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        kv0 = (jax.lax.ppermute(k, axis_name, perm),
+               jax.lax.ppermute(v, axis_name, perm))
+        (_, out_low, out_high), _ = jax.lax.scan(
+            step, (kv0, low, high), jnp.arange(1, n)
+        )
+
+    def finish(stats):
+        m, l, acc = stats
+        return jnp.einsum("bhqd->bqhd", acc / l)
+
+    out = jnp.concatenate([finish(out_low), finish(out_high)], axis=1)
+    return out.astype(q.dtype)
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Load-balanced CAUSAL ring attention (zigzag schedule).
+
+    Takes/returns tensors in NATURAL sequence order, (B, S, H, D) with
+    S % 2n == 0; the zigzag permutation is applied and undone inside.
+    Halves critical-path attention compute vs contiguous causal ring:
+    every ring step computes two fully-live (S/2n)^2 blocks on every
+    device instead of one half-masked (S/n)^2 block on some of them.
+    """
+    n = int(mesh.shape[axis_name])
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qz = zigzag_permute(q, n)
+    kz = zigzag_permute(k, n)
+    vz = zigzag_permute(v, n)
+    body = functools.partial(
+        _zigzag_local, axis_name=axis_name, scale=float(scale), n=n
+    )
+    spec = P(None, axis_name, None, None)
+    out = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(qz, kz, vz)
+    return zigzag_unpermute(out, n)
 
 
 def ring_attention(

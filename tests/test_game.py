@@ -267,17 +267,31 @@ async def test_masked_image_b64_bucket_separates_scores():
     assert blurred != sharp
 
 
+def _slow_image_bytes(game, delay_s=0.05):
+    """Wrap the round's byte fetch with a real await so a render stays
+    in flight long enough for concurrent requests to pile onto it (the
+    in-memory store never yields, so without this every coroutine runs
+    to completion before the next starts and coalescing is never
+    exercised)."""
+    orig = game.rounds.fetch_current_image_bytes
+
+    async def slow():
+        await asyncio.sleep(delay_s)
+        return await orig()
+
+    game.rounds.fetch_current_image_bytes = slow
+
+
 @pytest.mark.asyncio
 async def test_masked_image_b64_single_flight():
-    """Concurrent same-bucket misses coalesce to ONE render (the reset
-    stampede case: every client refetches the instant the cache was
-    invalidated)."""
-    from cassmantle_tpu.utils.logging import metrics
-
+    """Concurrent same-bucket misses coalesce to ONE in-flight render
+    (the reset stampede case: every client refetches the instant the
+    cache was invalidated)."""
     game, _ = make_game()
     await game.rounds.startup()
     for i in range(5):
         await game.init_client(f"c{i}")
+    _slow_image_bytes(game)
 
     renders = 0
     orig = game.blur_fn
@@ -293,3 +307,22 @@ async def test_masked_image_b64_single_flight():
     )
     assert len(set(results)) == 1
     assert renders == 1
+
+
+@pytest.mark.asyncio
+async def test_masked_image_b64_waiter_cancellation_isolated():
+    """One waiter's cancellation (client disconnect mid-request) must
+    not cancel the shared render or fail the other coalesced waiters."""
+    game, _ = make_game()
+    await game.rounds.startup()
+    for i in range(3):
+        await game.init_client(f"c{i}")
+    _slow_image_bytes(game)
+
+    tasks = [asyncio.ensure_future(game.fetch_masked_image_b64(f"c{i}"))
+             for i in range(3)]
+    await asyncio.sleep(0.01)        # all three joined the in-flight render
+    tasks[0].cancel()
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    assert isinstance(results[0], asyncio.CancelledError)
+    assert isinstance(results[1], str) and results[1] == results[2]
