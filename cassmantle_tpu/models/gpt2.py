@@ -31,11 +31,13 @@ class GPT2Block(nn.Module):
     dtype: jnp.dtype
 
     @nn.compact
-    def __call__(self, x, mask=None, kv_cache=None, return_kv=False):
+    def __call__(self, x, mask=None, kv_cache=None, return_kv=False,
+                 causal=False):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         attn_out = MultiHeadAttention(
             num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
-        )(h, mask=mask, kv_cache=kv_cache, return_kv=return_kv)
+        )(h, mask=mask, kv_cache=kv_cache, return_kv=return_kv,
+          causal=causal)
         if kv_cache is not None or return_kv:
             a, kv = attn_out
         else:
@@ -74,16 +76,27 @@ class GPT2LM(nn.Module):
         return hidden.astype(jnp.float32) @ emb.T
 
     def __call__(self, input_ids: jax.Array,
-                 valid: Optional[jax.Array] = None) -> jax.Array:
-        """Plain forward: (B, S) [+ optional (B, S) validity] -> (B, S, V)."""
+                 valid: Optional[jax.Array] = None,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+        """Plain forward: (B, S) [+ optional (B, S) validity] -> (B, S, V).
+
+        With ``valid=None`` the causal mask is owned by the attention op
+        (never materialized here) — which also makes this forward
+        context-parallel capable: under ``ops.attention.context_parallel``
+        the attention runs sequence-sharded, and the caller supplies
+        zigzag-permuted ``positions`` matching its permuted input_ids
+        (parallel/lm_train.py)."""
         _, s = input_ids.shape
-        positions = jnp.arange(s)[None, :]
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
         x = self.wte(input_ids) + self.wpe(positions)
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
-        if valid is not None:
-            mask = mask & valid[:, None, None, :]
+        if valid is None:
+            mask = None
+        else:
+            causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None]
+            mask = causal & valid[:, None, None, :]
         for block in self.blocks:
-            x, _ = block(x, mask=mask)
+            x, _ = block(x, mask=mask, causal=mask is None)
         return self._logits(self.ln_f(x))
 
     def prefill(

@@ -59,7 +59,7 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, context=None, mask=None, kv_cache=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, causal: bool = False):
         """Attention with optional KV-cache decode.
 
         - Full mode: returns out, or (out, (k, v)) if ``return_kv`` (used by
@@ -101,7 +101,7 @@ class MultiHeadAttention(nn.Module):
         elif return_kv:
             kv_out = (k, v)
 
-        out = multi_head_attention(q, k, v, mask=mask)
+        out = multi_head_attention(q, k, v, mask=mask, causal=causal)
         out = out.reshape(out.shape[:-2] + (inner,))
         out = nn.Dense(
             out_dim, use_bias=self.use_bias, dtype=self.dtype, name="out"

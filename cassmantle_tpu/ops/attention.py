@@ -28,6 +28,14 @@ import jax.numpy as jnp
 # backend is TPU.
 _FORCE_XLA = contextvars.ContextVar("cassmantle_force_xla", default=False)
 
+# When set to (mesh, axis_name, batch_axis), CAUSAL self-attention sites
+# run sequence-parallel over that mesh axis (zigzag ring schedule). The
+# caller owns the data layout: sequences must already be zigzag-permuted
+# (parallel/ring.py) and stay permuted through the whole network.
+_CONTEXT_PARALLEL = contextvars.ContextVar(
+    "cassmantle_context_parallel", default=None
+)
+
 
 @contextlib.contextmanager
 def xla_only():
@@ -36,6 +44,19 @@ def xla_only():
         yield
     finally:
         _FORCE_XLA.reset(token)
+
+
+@contextlib.contextmanager
+def context_parallel(mesh, axis_name: str = "sp",
+                     batch_axis: Optional[str] = "dp"):
+    """Route every causal self-attention traced inside this context
+    through the sequence-parallel zigzag ring over ``mesh[axis_name]``
+    (the long-context trace context; see parallel/lm_train.py)."""
+    token = _CONTEXT_PARALLEL.set((mesh, axis_name, batch_axis))
+    try:
+        yield
+    finally:
+        _CONTEXT_PARALLEL.reset(token)
 
 
 def _on_tpu() -> bool:
@@ -76,11 +97,31 @@ def multi_head_attention(
     mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Attention entry point used by all models.
 
     Shapes: q (..., Sq, H, D); k, v (..., Sk, H, D); returns (..., Sq, H, D).
+    ``causal=True`` (with no explicit mask) lets this layer own the
+    triangular masking — and, inside a :func:`context_parallel` region,
+    dispatch to sequence-parallel zigzag ring attention instead of ever
+    materializing the (S, S) mask.
     """
+    if causal and mask is None and q.shape == k.shape:
+        cp = _CONTEXT_PARALLEL.get()
+        if cp is not None and q.ndim == 4:
+            from cassmantle_tpu.parallel.ring import (
+                zigzag_sharded_attention,
+            )
+
+            mesh, axis_name, batch_axis = cp
+            return zigzag_sharded_attention(
+                q, k, v, mesh, axis_name=axis_name, scale=scale,
+                batch_axis=batch_axis,
+            )
+    if causal and mask is None:
+        s_q, s_k = q.shape[-3], k.shape[-3]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
     if _FORCE_XLA.get():
         use_flash = False
     if use_flash is None:

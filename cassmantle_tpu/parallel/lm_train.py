@@ -28,15 +28,70 @@ from cassmantle_tpu.parallel.sharding import shard_params
 from cassmantle_tpu.parallel.train import make_optimizer
 
 
+def masked_ce(logits: jax.Array, targets: jax.Array,
+              mask: jax.Array) -> jax.Array:
+    """Mean cross-entropy over positions where ``mask`` is nonzero."""
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    maskf = mask.astype(jnp.float32)
+    return jnp.sum(losses * maskf) / jnp.maximum(jnp.sum(maskf), 1.0)
+
+
 def next_token_loss(logits: jax.Array, input_ids: jax.Array,
                     loss_mask: jax.Array) -> jax.Array:
     """Mean masked cross-entropy of logits[:, :-1] against ids[:, 1:]."""
-    targets = input_ids[:, 1:]
-    mask = loss_mask[:, 1:].astype(jnp.float32)
-    losses = optax.softmax_cross_entropy_with_integer_labels(
-        logits[:, :-1].astype(jnp.float32), targets
-    )
-    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return masked_ce(logits[:, :-1], input_ids[:, 1:], loss_mask[:, 1:])
+
+
+def prepare_long_context_batch(
+    input_ids, loss_mask, n_sp: int
+) -> Dict[str, Any]:
+    """Natural-order (B, S) rows -> the zigzag-permuted batch a
+    context-parallel train step consumes.
+
+    Targets are shifted in NATURAL order first (position t predicts
+    t+1), THEN permuted — a shift applied after permutation would cross
+    zigzag chunk boundaries into the wrong neighbor. Positions ride
+    along so the positional embedding sees each token's true index.
+
+    ``loss_mask`` must be tail-pad form (once 0, stays 0): the
+    context-parallel forward attends over ALL positions (the zigzag
+    kernel carries no validity mask), which is provably equivalent to
+    the plain path for tail pads — under causality a pad key is only
+    visible to later (pad, loss-masked) queries — but NOT for interior
+    zeros (e.g. instruction-tuning prompt masking), where the two modes
+    would silently train different models. Interior zeros raise."""
+    import numpy as np
+
+    from cassmantle_tpu.parallel.ring import zigzag_permute
+
+    mask_np = np.asarray(loss_mask)
+    # tail-pad check: the mask may only step 1 -> 0 (no 0 -> 1 rises)
+    if (mask_np[:, 1:] > mask_np[:, :-1]).any():
+        raise ValueError(
+            "context-parallel training requires a tail-pad loss_mask "
+            "(no interior zeros): the sequence-parallel attention "
+            "attends over all positions, which diverges from the plain "
+            "trainer's key-masking for interior-masked tokens"
+        )
+
+    ids = jnp.asarray(input_ids)
+    mask = jnp.asarray(loss_mask)
+    b, s = ids.shape
+    zeros = jnp.zeros((b, 1), ids.dtype)
+    targets = jnp.concatenate([ids[:, 1:], zeros], axis=1)
+    tmask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros((b, 1), mask.dtype)], axis=1)
+    positions = jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    perm = lambda t: zigzag_permute(t, n_sp, axis=1)  # noqa: E731
+    return {
+        "input_ids": perm(ids),
+        "targets": perm(targets),
+        "loss_mask": perm(tmask),
+        "positions": perm(positions),
+    }
 
 
 class LMTrainer:
@@ -44,16 +99,39 @@ class LMTrainer:
 
     ``model`` is any module with ``__call__(input_ids, valid) -> logits``
     — GPT2LM and MistralLM both qualify (models/gpt2.py, models/mistral.py).
+    ``context_parallel=True`` (long-context: sequence sharded over the
+    ``sp`` axis, zigzag ring attention) additionally requires explicit
+    ``positions`` support and plain causal attention — GPT2LM only; the
+    constructor rejects models that don't qualify.
     """
 
     def __init__(self, model, mesh: Mesh, lr: float = 3e-4,
-                 remat: bool = False) -> None:
+                 remat: bool = False,
+                 context_parallel: bool = False,
+                 sp_axis: str = "sp") -> None:
         self.model = model
         self.mesh = mesh
         self._apply = (jax.checkpoint(model.apply) if remat
                        else model.apply)
         self.optimizer = make_optimizer(lr)
-        self._step = jax.jit(self._train_step_impl, donate_argnums=(0, 1))
+        self.context_parallel = context_parallel
+        self.sp_axis = sp_axis
+        self.n_sp = int(mesh.shape[sp_axis]) if context_parallel else 1
+        if context_parallel:
+            import inspect
+
+            sig = inspect.signature(type(model).__call__)
+            if "positions" not in sig.parameters:
+                raise TypeError(
+                    f"context_parallel needs a model whose __call__ "
+                    f"takes explicit `positions` (zigzag-permuted "
+                    f"data); {type(model).__name__} does not — GPT2LM "
+                    f"qualifies, MistralLM (RoPE + sliding window) "
+                    f"does not yet"
+                )
+        impl = (self._cp_step_impl if context_parallel
+                else self._train_step_impl)
+        self._step = jax.jit(impl, donate_argnums=(0, 1))
 
     # -- state ------------------------------------------------------------
     def init_state(self, sample_ids: jax.Array, seed: int = 0
@@ -64,12 +142,27 @@ class LMTrainer:
         return params, opt_state
 
     def batch_sharding(self) -> NamedSharding:
+        if self.context_parallel:
+            # batch over dp AND sequence over sp: each device holds a
+            # (B/dp, S/sp) activation tile end to end
+            return NamedSharding(self.mesh, P("dp", self.sp_axis))
         return NamedSharding(self.mesh, P("dp"))
 
     def shard_batch(self, batch: Dict[str, jax.Array]
                     ) -> Dict[str, jax.Array]:
         sh = self.batch_sharding()
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+    def prepare_batch(self, input_ids, loss_mask) -> Dict[str, jax.Array]:
+        """Data prep + sharding for either mode: plain rows in, the
+        step's batch dict out (context-parallel mode zigzag-permutes and
+        adds targets/positions)."""
+        if not self.context_parallel:
+            return self.shard_batch(
+                {"input_ids": jnp.asarray(input_ids),
+                 "loss_mask": jnp.asarray(loss_mask)})
+        return self.shard_batch(
+            prepare_long_context_batch(input_ids, loss_mask, self.n_sp))
 
     # -- step -------------------------------------------------------------
     def _train_step_impl(self, params, opt_state, batch, rng):
@@ -83,6 +176,28 @@ class LMTrainer:
             return next_token_loss(
                 logits, batch["input_ids"], batch["loss_mask"]
             )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = self.optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    def _cp_step_impl(self, params, opt_state, batch, rng):
+        """Context-parallel step: activations stay zigzag-permuted and
+        sequence-sharded through the whole forward; attention runs the
+        sharded zigzag ring via the ops.attention context. Targets were
+        shifted in natural order before permutation, so the loss is
+        positionally exact."""
+        del rng
+        from cassmantle_tpu.ops.attention import context_parallel
+
+        def loss_fn(p):
+            with context_parallel(self.mesh, self.sp_axis,
+                                  batch_axis="dp"):
+                logits = self._apply(
+                    p, batch["input_ids"], None, batch["positions"]
+                )
+            return masked_ce(logits, batch["targets"], batch["loss_mask"])
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, new_opt = self.optimizer.update(grads, opt_state, params)

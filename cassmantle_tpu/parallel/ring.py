@@ -207,6 +207,36 @@ def _zigzag_local(q, k, v, axis_name: str, scale: float, n: int):
     return out.astype(q.dtype)
 
 
+def zigzag_sharded_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+    batch_axis: Optional[str] = None,
+) -> jax.Array:
+    """Causal zigzag attention over ALREADY-zigzag-permuted sequences.
+
+    The model-integration entry point: a long-context training step
+    permutes its data once on input (parallel/lm_train.py) and keeps
+    every layer's activations in zigzag order, so attention needs no
+    per-layer permute collectives. ``batch_axis`` lets the batch dim
+    ride an outer data-parallel axis (activations (B/dp, S/sp, H, D)
+    per device)."""
+    n = int(mesh.shape[axis_name])
+    assert q.shape[1] % (2 * n) == 0, (q.shape, n)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    body = functools.partial(
+        _zigzag_local, axis_name=axis_name, scale=float(scale), n=n
+    )
+    spec = P(batch_axis, axis_name, None, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
 def zigzag_ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -224,18 +254,12 @@ def zigzag_ring_attention(
     device instead of one half-masked (S/n)^2 block on some of them.
     """
     n = int(mesh.shape[axis_name])
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
     qz = zigzag_permute(q, n)
     kz = zigzag_permute(k, n)
     vz = zigzag_permute(v, n)
-    body = functools.partial(
-        _zigzag_local, axis_name=axis_name, scale=float(scale), n=n
+    out = zigzag_sharded_attention(
+        qz, kz, vz, mesh, axis_name=axis_name, scale=scale
     )
-    spec = P(None, axis_name, None, None)
-    out = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-    )(qz, kz, vz)
     return zigzag_unpermute(out, n)
 
 
