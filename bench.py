@@ -92,6 +92,18 @@ def bench_sd15_fast(weights_dir: str) -> dict:
         weights_dir)
 
 
+def bench_sd15_deepcache(weights_dir: str) -> dict:
+    """Deep-feature-reuse preset: full DDIM-50 trajectory, alternate
+    steps reusing the previous step's deepest-level activations (~60%
+    of the UNet compute; ops/ddim.py, models/unet.py)."""
+    from cassmantle_tpu.config import deepcache_serving_config
+
+    return _bench_txt2img(
+        deepcache_serving_config,
+        "sd15_512px_ddim50_deepcache_images_per_sec_per_chip",
+        weights_dir)
+
+
 def bench_scorer(weights_dir: str) -> dict:
     """BASELINE ladder #1: MiniLM guess scorer, 1k pairs coalesced."""
     _setup_jax()
@@ -220,6 +232,7 @@ SUITE = {
     "gpt2": bench_gpt2,
     "sd15": bench_sd15,
     "sd15_fast": bench_sd15_fast,
+    "sd15_deepcache": bench_sd15_deepcache,
     "sdxl": bench_sdxl,
     "e2e": bench_e2e_round,
 }

@@ -183,6 +183,10 @@ class SamplerConfig:
     guidance_scale: float = 7.5
     eta: float = 0.0
     image_size: int = 512
+    # Deep-feature reuse (DeepCache-style): steps run in full/shallow
+    # pairs, the shallow pass reusing the previous step's deepest-level
+    # activations (~60% of full compute; ddim only, even num_steps).
+    deepcache: bool = False
     # Text decode (reference decodes 32-96 new tokens, backend.py:250-255).
     min_new_tokens: int = 32
     max_new_tokens: int = 96
@@ -281,6 +285,16 @@ def fast_serving_config() -> FrameworkConfig:
     return FrameworkConfig(
         sampler=SamplerConfig(kind="dpmpp_2m", num_steps=25)
     )
+
+
+def deepcache_serving_config() -> FrameworkConfig:
+    """DDIM-50 with deep-feature reuse (SamplerConfig.deepcache): the
+    full 50-step trajectory at ~60% of the UNet compute — alternate
+    steps reuse the previous step's deepest-level activations
+    (models/unet.py, ops/ddim.py). The second workload-level serving
+    speedup next to fast_serving_config's fewer-steps route."""
+
+    return FrameworkConfig(sampler=SamplerConfig(deepcache=True))
 
 
 def test_config() -> FrameworkConfig:

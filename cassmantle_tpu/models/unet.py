@@ -126,11 +126,26 @@ class UNet(nn.Module):
         timesteps: jax.Array,                # (B,) int/float
         context: jax.Array,                  # (B, S, context_dim) text states
         addition_embeds: Optional[jax.Array] = None,  # SDXL micro-conds
+        deep_cache: Optional[jax.Array] = None,
+        return_deep: bool = False,
     ) -> jax.Array:
+        """Denoise forward. Two extra modes implement deep-feature reuse
+        (DeepCache-style serving: deep activations vary slowly across
+        adjacent diffusion steps, so a shallow step can reuse them —
+        see ops/samplers.py deepcache pairing and PARITY.md):
+
+        - ``return_deep=True``: also return the activation entering the
+          SHALLOWEST up level (captured after level 1's upsample conv).
+        - ``deep_cache=<that activation>``: run only conv_in + level-0
+          down blocks (fresh skips), substitute the cached deep
+          activation, and finish with level-0 up blocks + conv_out —
+          skipping every deeper level and the mid block entirely.
+        """
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         latents = latents.astype(dtype)
         context = context.astype(dtype)
+        shallow_only = deep_cache is not None
 
         # -- time embedding ------------------------------------------------
         temb = timestep_embedding(timesteps, cfg.base_channels)
@@ -151,8 +166,9 @@ class UNet(nn.Module):
 
         # -- down ----------------------------------------------------------
         skips = [x]
-        for lvl, mult in enumerate(cfg.channel_mults):
-            ch = cfg.base_channels * mult
+        down_levels = 1 if shallow_only else levels
+        for lvl in range(down_levels):
+            ch = cfg.base_channels * cfg.channel_mults[lvl]
             for blk in range(cfg.blocks_per_level):
                 x = ResBlock(ch, dtype, name=f"down_{lvl}_res_{blk}")(x, temb)
                 if cfg.attention_levels[lvl] and cfg.transformer_depth[lvl]:
@@ -163,26 +179,33 @@ class UNet(nn.Module):
                         name=f"down_{lvl}_attn_{blk}",
                     )(x, context)
                 skips.append(x)
-            if lvl != levels - 1:
+            if lvl != levels - 1 and not shallow_only:
                 x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=1,
                             dtype=dtype, name=f"down_{lvl}_downsample")(x)
                 skips.append(x)
 
-        # -- mid -----------------------------------------------------------
-        mid_ch = cfg.base_channels * cfg.channel_mults[-1]
-        mid_depth = max(
-            [d for lvl, d in enumerate(cfg.transformer_depth)
-             if cfg.attention_levels[lvl]] or [1]
-        )
-        x = ResBlock(mid_ch, dtype, name="mid_res_0")(x, temb)
-        x = SpatialTransformer(
-            num_heads=self._heads(mid_ch), depth=mid_depth,
-            context_dim=cfg.context_dim, dtype=dtype, name="mid_attn",
-        )(x, context)
-        x = ResBlock(mid_ch, dtype, name="mid_res_1")(x, temb)
+        if not shallow_only:
+            # -- mid -------------------------------------------------------
+            mid_ch = cfg.base_channels * cfg.channel_mults[-1]
+            mid_depth = max(
+                [d for lvl, d in enumerate(cfg.transformer_depth)
+                 if cfg.attention_levels[lvl]] or [1]
+            )
+            x = ResBlock(mid_ch, dtype, name="mid_res_0")(x, temb)
+            x = SpatialTransformer(
+                num_heads=self._heads(mid_ch), depth=mid_depth,
+                context_dim=cfg.context_dim, dtype=dtype, name="mid_attn",
+            )(x, context)
+            x = ResBlock(mid_ch, dtype, name="mid_res_1")(x, temb)
 
         # -- up ------------------------------------------------------------
-        for lvl in reversed(range(levels)):
+        deep_out = None
+        up_levels = [0] if shallow_only else list(reversed(range(levels)))
+        if shallow_only:
+            x = deep_cache.astype(dtype)
+        for lvl in up_levels:
+            if lvl == 0 and return_deep:
+                deep_out = x
             ch = cfg.base_channels * cfg.channel_mults[lvl]
             for blk in range(cfg.blocks_per_level + 1):
                 skip = skips.pop()
@@ -207,4 +230,7 @@ class UNet(nn.Module):
         x = nn.silu(x)
         x = nn.Conv(cfg.sample_channels, (3, 3), padding=1,
                     dtype=jnp.float32, name="conv_out")(x)
-        return x.astype(jnp.float32)
+        eps = x.astype(jnp.float32)
+        if return_deep:
+            return eps, deep_out
+        return eps

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +83,48 @@ class DDIMSchedule:
         )
 
 
+def ddim_update(x, eps, a_t, a_prev):
+    """One deterministic DDIM transition x_t -> x_{t-1} (eta = 0)."""
+    x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    dir_xt = jnp.sqrt(1.0 - a_prev) * eps
+    return jnp.sqrt(a_prev) * x0 + dir_xt
+
+
+def ddim_sample_deepcache(
+    denoise_full: Callable,       # (x, t) -> (eps, deep_features)
+    denoise_shallow: Callable,    # (x, t, deep_features) -> eps
+    latents: jax.Array,
+    schedule: DDIMSchedule,
+) -> jax.Array:
+    """DDIM with deep-feature reuse (DeepCache-style serving): steps run
+    in pairs — a FULL UNet pass whose deepest-levels output is cached,
+    then a SHALLOW pass (level-0 blocks only) reusing it. Deep
+    activations vary slowly across adjacent steps, so quality stays
+    near the full trajectory at ~60% of the compute (models/unet.py
+    documents the split). Deterministic (eta=0); even step count
+    required."""
+    n = schedule.timesteps.shape[0]
+    assert n % 2 == 0, f"deepcache pairing needs an even step count, got {n}"
+
+    def pack(a):
+        return a.reshape(n // 2, 2)
+
+    def pair_step(x, per):
+        t, a_t, a_prev = per
+        eps, deep = denoise_full(x, t[0])
+        x = ddim_update(x, eps, a_t[0], a_prev[0])
+        eps = denoise_shallow(x, t[1], deep)
+        x = ddim_update(x, eps, a_t[1], a_prev[1])
+        return x, None
+
+    final, _ = jax.lax.scan(
+        pair_step, latents,
+        (pack(schedule.timesteps), pack(schedule.alpha_bars),
+         pack(schedule.alpha_bars_prev)),
+    )
+    return final
+
+
 def ddim_sample(
     denoise: Callable[[jax.Array, jax.Array], jax.Array],
     latents: jax.Array,
@@ -121,6 +163,32 @@ def ddim_sample(
     return final
 
 
+def _cfg_context(context, uncond_context, addition_embeds,
+                 uncond_addition_embeds):
+    """Stack the unconditional and conditional conditioning into the 2B
+    CFG batch (shared by every CFG denoiser variant)."""
+    full_context = jnp.concatenate([uncond_context, context], axis=0)
+    full_addition = None
+    if addition_embeds is not None:
+        uncond_add = (uncond_addition_embeds
+                      if uncond_addition_embeds is not None
+                      else jnp.zeros_like(addition_embeds))
+        full_addition = jnp.concatenate([uncond_add, addition_embeds], axis=0)
+    return full_context, full_addition
+
+
+def _cfg_double(x, t):
+    """(x, t) -> the duplicated (x2, t2) the 2B CFG batch consumes."""
+    x2 = jnp.concatenate([x, x], axis=0)
+    t2 = jnp.full((2 * x.shape[0],), t, dtype=jnp.int32)
+    return x2, t2
+
+
+def _cfg_guide(eps, guidance_scale):
+    eps_uncond, eps_cond = jnp.split(eps, 2, axis=0)
+    return eps_uncond + guidance_scale * (eps_cond - eps_uncond)
+
+
 def make_cfg_denoiser(
     unet_apply: Callable,
     params,
@@ -135,26 +203,49 @@ def make_cfg_denoiser(
     For SDXL, ``addition_embeds`` carries the pooled-text + time-ids
     micro-conditioning vector; it rides the same 2B batch as the context.
     """
-    full_context = jnp.concatenate([uncond_context, context], axis=0)
-    full_addition = None
-    if addition_embeds is not None:
-        uncond_add = (uncond_addition_embeds
-                      if uncond_addition_embeds is not None
-                      else jnp.zeros_like(addition_embeds))
-        full_addition = jnp.concatenate([uncond_add, addition_embeds], axis=0)
+    full_context, full_addition = _cfg_context(
+        context, uncond_context, addition_embeds, uncond_addition_embeds)
 
     def denoise(x, t):
-        b = x.shape[0]
-        x2 = jnp.concatenate([x, x], axis=0)
-        t2 = jnp.full((2 * b,), t, dtype=jnp.int32)
+        x2, t2 = _cfg_double(x, t)
         if full_addition is None:
             eps = unet_apply(params, x2, t2, full_context)
         else:
             eps = unet_apply(params, x2, t2, full_context, full_addition)
-        eps_uncond, eps_cond = jnp.split(eps, 2, axis=0)
-        return eps_uncond + guidance_scale * (eps_cond - eps_uncond)
+        return _cfg_guide(eps, guidance_scale)
 
     return denoise
+
+
+def make_cfg_denoiser_pair(
+    unet_apply: Callable,
+    params,
+    context: jax.Array,
+    uncond_context: jax.Array,
+    guidance_scale: float,
+    addition_embeds: Optional[jax.Array] = None,
+    uncond_addition_embeds: Optional[jax.Array] = None,
+) -> Tuple[Callable, Callable]:
+    """CFG denoiser pair for deep-feature reuse: ``full(x, t)`` returns
+    (guided eps, deep features of the 2B CFG batch); ``shallow(x, t,
+    deep)`` reuses them. The cache rides the same cond+uncond batch, so
+    both guidance halves reuse their own deep features. SDXL
+    micro-conditioning rides along exactly as in make_cfg_denoiser."""
+    full_context, full_addition = _cfg_context(
+        context, uncond_context, addition_embeds, uncond_addition_embeds)
+
+    def denoise_full(x, t):
+        x2, t2 = _cfg_double(x, t)
+        eps, deep = unet_apply(params, x2, t2, full_context,
+                               full_addition, None, True)
+        return _cfg_guide(eps, guidance_scale), deep
+
+    def denoise_shallow(x, t, deep):
+        x2, t2 = _cfg_double(x, t)
+        eps = unet_apply(params, x2, t2, full_context, full_addition, deep)
+        return _cfg_guide(eps, guidance_scale)
+
+    return denoise_full, denoise_shallow
 
 
 def initial_latents(

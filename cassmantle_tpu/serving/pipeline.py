@@ -152,6 +152,15 @@ class Text2ImagePipeline:
                 cache_path=param_cache_path(
                     f"vae{cfg.sampler.image_size}", m.vae))
         )
+        if cfg.sampler.deepcache:
+            from cassmantle_tpu.ops.ddim import DDIMSchedule
+
+            assert cfg.sampler.kind == "ddim" and \
+                cfg.sampler.num_steps % 2 == 0 and \
+                cfg.sampler.eta == 0.0, \
+                "deepcache needs ddim, an even step count, and eta=0 " \
+                "(the paired loop is deterministic)"
+            self._dc_schedule = DDIMSchedule.create(cfg.sampler.num_steps)
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -167,14 +176,27 @@ class Text2ImagePipeline:
         with annotate("clip_encode"):
             ctx = self.clip.apply(params["clip"], ids)["hidden"]
             uncond = self.clip.apply(params["clip"], uncond_ids)["hidden"]
-        denoise = make_cfg_denoiser(
-            self.unet.apply, params["unet"], ctx, uncond,
-            self.cfg.sampler.guidance_scale,
-        )
         lat = initial_latents(rng, ids.shape[0], self.cfg.sampler.image_size,
                               self.vae_scale)
         with annotate("denoise_scan"):
-            final = self.sample_latents(denoise, lat)
+            if self.cfg.sampler.deepcache:
+                from cassmantle_tpu.ops.ddim import (
+                    ddim_sample_deepcache,
+                    make_cfg_denoiser_pair,
+                )
+
+                dn_full, dn_shallow = make_cfg_denoiser_pair(
+                    self.unet.apply, params["unet"], ctx, uncond,
+                    self.cfg.sampler.guidance_scale,
+                )
+                final = ddim_sample_deepcache(
+                    dn_full, dn_shallow, lat, self._dc_schedule)
+            else:
+                denoise = make_cfg_denoiser(
+                    self.unet.apply, params["unet"], ctx, uncond,
+                    self.cfg.sampler.guidance_scale,
+                )
+                final = self.sample_latents(denoise, lat)
         with annotate("vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)
@@ -258,6 +280,12 @@ class Text2ImagePipeline:
         in (0, 1]: fraction of the schedule re-run; higher = less of the
         input survives. Single-chip path (no dp sharding)."""
         assert 0.0 < strength <= 1.0
+        if self.cfg.sampler.deepcache:
+            raise NotImplementedError(
+                "img2img does not support deepcache (schedule tails have "
+                "arbitrary parity); use a non-deepcache config for "
+                "image-conditioned generation"
+            )
         self._ensure_encoder()
         steps = self.cfg.sampler.num_steps
         k = max(1, min(steps, int(round(strength * steps))))

@@ -142,6 +142,14 @@ class SDXLPipeline:
                 cache_path=param_cache_path(
                     f"vae_xl{cfg.sampler.image_size}", m.vae))
         )
+        if cfg.sampler.deepcache:
+            from cassmantle_tpu.ops.ddim import DDIMSchedule
+
+            assert cfg.sampler.kind == "ddim" and \
+                cfg.sampler.num_steps % 2 == 0 and \
+                cfg.sampler.eta == 0.0, \
+                "deepcache needs ddim, an even step count, and eta=0"
+            self._dc_schedule = DDIMSchedule.create(cfg.sampler.num_steps)
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -186,15 +194,30 @@ class SDXLPipeline:
         time_ids = self._time_ids(b)
         add = jnp.concatenate([pooled, time_ids], axis=-1)
         uncond_add = jnp.concatenate([uncond_pooled, time_ids], axis=-1)
-        denoise = make_cfg_denoiser(
-            self.unet.apply, params["unet"], ctx, uncond_ctx,
-            self.cfg.sampler.guidance_scale,
-            addition_embeds=add, uncond_addition_embeds=uncond_add,
-        )
         lat = initial_latents(rng, b, self.cfg.sampler.image_size,
                               self.vae_scale)
         with annotate("sdxl_denoise_scan"):
-            final = self.sample_latents(denoise, lat)
+            if self.cfg.sampler.deepcache:
+                from cassmantle_tpu.ops.ddim import (
+                    ddim_sample_deepcache,
+                    make_cfg_denoiser_pair,
+                )
+
+                dn_full, dn_shallow = make_cfg_denoiser_pair(
+                    self.unet.apply, params["unet"], ctx, uncond_ctx,
+                    self.cfg.sampler.guidance_scale,
+                    addition_embeds=add,
+                    uncond_addition_embeds=uncond_add,
+                )
+                final = ddim_sample_deepcache(
+                    dn_full, dn_shallow, lat, self._dc_schedule)
+            else:
+                denoise = make_cfg_denoiser(
+                    self.unet.apply, params["unet"], ctx, uncond_ctx,
+                    self.cfg.sampler.guidance_scale,
+                    addition_embeds=add, uncond_addition_embeds=uncond_add,
+                )
+                final = self.sample_latents(denoise, lat)
         with annotate("sdxl_vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)
