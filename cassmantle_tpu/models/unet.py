@@ -132,7 +132,7 @@ class UNet(nn.Module):
         """Denoise forward. Two extra modes implement deep-feature reuse
         (DeepCache-style serving: deep activations vary slowly across
         adjacent diffusion steps, so a shallow step can reuse them —
-        see ops/samplers.py deepcache pairing and PARITY.md):
+        see ops/ddim.py::ddim_sample_deepcache and PARITY.md):
 
         - ``return_deep=True``: also return the activation entering the
           SHALLOWEST up level (captured after level 1's upsample conv).

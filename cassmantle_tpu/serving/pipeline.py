@@ -73,6 +73,45 @@ def dp_sharded_sampler(sample_impl, mesh):
     return fn, int(mesh.shape["dp"])
 
 
+def deepcache_schedule(sampler_cfg):
+    """Validate a deepcache sampler config and build its DDIM schedule
+    (shared by the SD1.5 and SDXL pipelines, like dp_sharded_sampler)."""
+    from cassmantle_tpu.ops.ddim import DDIMSchedule
+
+    assert sampler_cfg.kind == "ddim" and \
+        sampler_cfg.num_steps % 2 == 0 and \
+        sampler_cfg.eta == 0.0, \
+        "deepcache needs ddim, an even step count, and eta=0 " \
+        "(the paired loop is deterministic)"
+    return DDIMSchedule.create(sampler_cfg.num_steps)
+
+
+def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
+                    params, ctx, uncond_ctx, lat,
+                    addition_embeds=None, uncond_addition_embeds=None):
+    """The denoise stage both image pipelines share: plain CFG sampling,
+    or the deepcache full/shallow pairing when configured."""
+    if sampler_cfg.deepcache:
+        from cassmantle_tpu.ops.ddim import (
+            ddim_sample_deepcache,
+            make_cfg_denoiser_pair,
+        )
+
+        dn_full, dn_shallow = make_cfg_denoiser_pair(
+            unet_apply, params, ctx, uncond_ctx,
+            sampler_cfg.guidance_scale,
+            addition_embeds=addition_embeds,
+            uncond_addition_embeds=uncond_addition_embeds,
+        )
+        return ddim_sample_deepcache(dn_full, dn_shallow, lat, dc_schedule)
+    denoise = make_cfg_denoiser(
+        unet_apply, params, ctx, uncond_ctx, sampler_cfg.guidance_scale,
+        addition_embeds=addition_embeds,
+        uncond_addition_embeds=uncond_addition_embeds,
+    )
+    return sample_latents(denoise, lat)
+
+
 def pad_prompts_to_dp(prompts: Sequence[str], dp: int):
     """Pad a prompt list to a multiple of the dp width (equal per-device
     shards); callers drop the pad rows from the output."""
@@ -152,15 +191,8 @@ class Text2ImagePipeline:
                 cache_path=param_cache_path(
                     f"vae{cfg.sampler.image_size}", m.vae))
         )
-        if cfg.sampler.deepcache:
-            from cassmantle_tpu.ops.ddim import DDIMSchedule
-
-            assert cfg.sampler.kind == "ddim" and \
-                cfg.sampler.num_steps % 2 == 0 and \
-                cfg.sampler.eta == 0.0, \
-                "deepcache needs ddim, an even step count, and eta=0 " \
-                "(the paired loop is deterministic)"
-            self._dc_schedule = DDIMSchedule.create(cfg.sampler.num_steps)
+        self._dc_schedule = (deepcache_schedule(cfg.sampler)
+                             if cfg.sampler.deepcache else None)
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -179,24 +211,10 @@ class Text2ImagePipeline:
         lat = initial_latents(rng, ids.shape[0], self.cfg.sampler.image_size,
                               self.vae_scale)
         with annotate("denoise_scan"):
-            if self.cfg.sampler.deepcache:
-                from cassmantle_tpu.ops.ddim import (
-                    ddim_sample_deepcache,
-                    make_cfg_denoiser_pair,
-                )
-
-                dn_full, dn_shallow = make_cfg_denoiser_pair(
-                    self.unet.apply, params["unet"], ctx, uncond,
-                    self.cfg.sampler.guidance_scale,
-                )
-                final = ddim_sample_deepcache(
-                    dn_full, dn_shallow, lat, self._dc_schedule)
-            else:
-                denoise = make_cfg_denoiser(
-                    self.unet.apply, params["unet"], ctx, uncond,
-                    self.cfg.sampler.guidance_scale,
-                )
-                final = self.sample_latents(denoise, lat)
+            final = run_cfg_denoise(
+                self.cfg.sampler, self.sample_latents, self._dc_schedule,
+                self.unet.apply, params["unet"], ctx, uncond, lat,
+            )
         with annotate("vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)

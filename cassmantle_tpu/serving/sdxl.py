@@ -142,14 +142,10 @@ class SDXLPipeline:
                 cache_path=param_cache_path(
                     f"vae_xl{cfg.sampler.image_size}", m.vae))
         )
-        if cfg.sampler.deepcache:
-            from cassmantle_tpu.ops.ddim import DDIMSchedule
+        from cassmantle_tpu.serving.pipeline import deepcache_schedule
 
-            assert cfg.sampler.kind == "ddim" and \
-                cfg.sampler.num_steps % 2 == 0 and \
-                cfg.sampler.eta == 0.0, \
-                "deepcache needs ddim, an even step count, and eta=0"
-            self._dc_schedule = DDIMSchedule.create(cfg.sampler.num_steps)
+        self._dc_schedule = (deepcache_schedule(cfg.sampler)
+                             if cfg.sampler.deepcache else None)
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -197,27 +193,13 @@ class SDXLPipeline:
         lat = initial_latents(rng, b, self.cfg.sampler.image_size,
                               self.vae_scale)
         with annotate("sdxl_denoise_scan"):
-            if self.cfg.sampler.deepcache:
-                from cassmantle_tpu.ops.ddim import (
-                    ddim_sample_deepcache,
-                    make_cfg_denoiser_pair,
-                )
+            from cassmantle_tpu.serving.pipeline import run_cfg_denoise
 
-                dn_full, dn_shallow = make_cfg_denoiser_pair(
-                    self.unet.apply, params["unet"], ctx, uncond_ctx,
-                    self.cfg.sampler.guidance_scale,
-                    addition_embeds=add,
-                    uncond_addition_embeds=uncond_add,
-                )
-                final = ddim_sample_deepcache(
-                    dn_full, dn_shallow, lat, self._dc_schedule)
-            else:
-                denoise = make_cfg_denoiser(
-                    self.unet.apply, params["unet"], ctx, uncond_ctx,
-                    self.cfg.sampler.guidance_scale,
-                    addition_embeds=add, uncond_addition_embeds=uncond_add,
-                )
-                final = self.sample_latents(denoise, lat)
+            final = run_cfg_denoise(
+                self.cfg.sampler, self.sample_latents, self._dc_schedule,
+                self.unet.apply, params["unet"], ctx, uncond_ctx, lat,
+                addition_embeds=add, uncond_addition_embeds=uncond_add,
+            )
         with annotate("sdxl_vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
         return postprocess_images(decoded)

@@ -106,6 +106,14 @@ def test_deepcache_rejects_odd_steps_or_wrong_sampler():
         cfg.sampler, kind="ddim", deepcache=True, num_steps=5))
     with pytest.raises(AssertionError, match="even"):
         Text2ImagePipeline(bad)
+    bad = cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, kind="euler", deepcache=True, num_steps=4))
+    with pytest.raises(AssertionError, match="ddim"):
+        Text2ImagePipeline(bad)
+    bad = cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, kind="ddim", deepcache=True, num_steps=4, eta=0.5))
+    with pytest.raises(AssertionError, match="eta"):
+        Text2ImagePipeline(bad)
 
 
 def test_sdxl_pipeline_with_deepcache_config():
