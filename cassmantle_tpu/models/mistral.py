@@ -95,7 +95,7 @@ class MistralAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, mask=None, kv_cache=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, causal: bool = False):
         """GQA attention with RoPE applied to q/k before caching.
 
         Same cache contract as models/layers.py::MultiHeadAttention, but
@@ -133,7 +133,8 @@ class MistralAttention(nn.Module):
 
         n_rep = cfg.num_heads // cfg.num_kv_heads
         out = multi_head_attention(
-            q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), mask=mask
+            q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), mask=mask,
+            causal=causal,
         )
         out = out.reshape(b, s, cfg.num_heads * d)
         out = nn.Dense(cfg.hidden_size, use_bias=False, dtype=self.dtype,
@@ -166,10 +167,11 @@ class MistralBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, mask=None, kv_cache=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False, causal: bool = False):
         h = RMSNorm(self.cfg.rms_eps, name="ln1")(x)
         attn_out = MistralAttention(self.cfg, self.dtype, name="attn")(
-            h, cos, sin, mask=mask, kv_cache=kv_cache, return_kv=return_kv
+            h, cos, sin, mask=mask, kv_cache=kv_cache,
+            return_kv=return_kv, causal=causal,
         )
         if kv_cache is not None or return_kv:
             a, kv = attn_out
@@ -209,18 +211,36 @@ class MistralLM(nn.Module):
         return self.lm_head(hidden.astype(jnp.float32))
 
     def __call__(self, input_ids: jax.Array,
-                 valid: Optional[jax.Array] = None) -> jax.Array:
-        """Plain forward: (B, S) [+ (B, S) validity] -> (B, S, V)."""
+                 valid: Optional[jax.Array] = None,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+        """Plain forward: (B, S) [+ (B, S) validity] -> (B, S, V).
+
+        Explicit (B, S) ``positions`` select the context-parallel form
+        (zigzag-permuted data, parallel/lm_train.py): RoPE follows the
+        per-token true positions, the mask is owned by the attention op
+        (plain causal, dispatchable to the sharded zigzag ring), and the
+        sequence must fit the sliding window — the band mask degenerates
+        to causal there, which is what the zigzag kernel implements."""
         cfg = self.cfg
         _, s = input_ids.shape
-        positions = jnp.arange(s)
+        if positions is not None:
+            assert valid is None, \
+                "positions mode owns masking; pre-mask inputs instead"
+            assert s <= cfg.sliding_window, (
+                f"context-parallel Mistral needs seq {s} <= "
+                f"sliding_window {cfg.sliding_window} (banded zigzag "
+                f"attention not implemented)")
+            mask = None
+        else:
+            positions = jnp.arange(s)
+            mask = band_mask(
+                positions, positions, cfg.sliding_window)[None, None]
+            if valid is not None:
+                mask = mask & valid[:, None, None, :]
         cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
         x = self.embed(input_ids)
-        mask = band_mask(positions, positions, cfg.sliding_window)[None, None]
-        if valid is not None:
-            mask = mask & valid[:, None, None, :]
         for block in self.blocks:
-            x, _ = block(x, cos, sin, mask=mask)
+            x, _ = block(x, cos, sin, mask=mask, causal=mask is None)
         return self._logits(self.ln_f(x))
 
     def prefill(

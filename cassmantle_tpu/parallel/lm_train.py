@@ -101,8 +101,10 @@ class LMTrainer:
     — GPT2LM and MistralLM both qualify (models/gpt2.py, models/mistral.py).
     ``context_parallel=True`` (long-context: sequence sharded over the
     ``sp`` axis, zigzag ring attention) additionally requires explicit
-    ``positions`` support and plain causal attention — GPT2LM only; the
-    constructor rejects models that don't qualify.
+    ``positions`` support and plain causal attention — GPT2LM, and
+    MistralLM for sequences within its sliding window (the band mask
+    degenerates to causal there); the constructor rejects models that
+    don't qualify.
     """
 
     def __init__(self, model, mesh: Mesh, lr: float = 3e-4,
@@ -126,8 +128,8 @@ class LMTrainer:
                     f"context_parallel needs a model whose __call__ "
                     f"takes explicit `positions` (zigzag-permuted "
                     f"data); {type(model).__name__} does not — GPT2LM "
-                    f"qualifies, MistralLM (RoPE + sliding window) "
-                    f"does not yet"
+                    f"and MistralLM (sequences within the sliding "
+                    f"window) qualify"
                 )
         impl = (self._cp_step_impl if context_parallel
                 else self._train_step_impl)

@@ -276,11 +276,65 @@ def test_context_parallel_rejects_interior_zero_mask():
     prepare_long_context_batch(ids, mask, n_sp=2)
 
 
-def test_context_parallel_rejects_positionless_model():
+def test_context_parallel_mistral_forward_matches_plain():
+    """Mistral cp mode (RoPE from explicit positions, zigzag causal
+    attention) == plain forward, for sequences within the window (where
+    the band mask degenerates to causal)."""
+    from cassmantle_tpu.ops.attention import context_parallel
+    from cassmantle_tpu.parallel.ring import (
+        zigzag_permute,
+        zigzag_unpermute,
+    )
+
     mesh = make_mesh(MeshConfig(dp=2, tp=1, sp=4))
     mcfg = MistralConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
-        num_layers=1, num_heads=4, num_kv_heads=2, max_positions=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_positions=64,
+        sliding_window=64, dtype="float32",
     )
+    model = MistralLM(mcfg)
+    b, s = 2, 32
+    ids = jax.random.randint(jax.random.PRNGKey(0), (b, s), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    ref = model.apply(params, ids)
+
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    ids_z = zigzag_permute(ids, 4, axis=1)
+    pos_z = zigzag_permute(positions, 4, axis=1)
+    with context_parallel(mesh, "sp", batch_axis="dp"):
+        out_z = jax.jit(
+            lambda p, i, pos: model.apply(p, i, None, pos)
+        )(params, ids_z, pos_z)
+    out = zigzag_unpermute(out_z, 4, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_context_parallel_mistral_rejects_overlong_sequence():
+    mcfg = MistralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=1, num_heads=4, num_kv_heads=2, max_positions=64,
+        sliding_window=16, dtype="float32",
+    )
+    model = MistralLM(mcfg)
+    ids = jnp.zeros((1, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    pos = jnp.broadcast_to(jnp.arange(32)[None, :], (1, 32))
+    with pytest.raises(AssertionError, match="sliding_window"):
+        model.apply(params, ids, None, pos)
+
+
+def test_context_parallel_rejects_positionless_model():
+    """The constructor guard: a model whose __call__ lacks `positions`
+    fails fast with a clear TypeError, not at trace time."""
+
+    class NoPositionsLM(GPT2LM):
+        def __call__(self, input_ids, valid=None):  # noqa: D401
+            return super().__call__(input_ids, valid)
+
+    mesh = make_mesh(MeshConfig(dp=2, tp=1, sp=4))
+    cfg = test_config()
     with pytest.raises(TypeError, match="positions"):
-        LMTrainer(MistralLM(mcfg), mesh, context_parallel=True)
+        LMTrainer(NoPositionsLM(cfg.models.gpt2), mesh,
+                  context_parallel=True)
