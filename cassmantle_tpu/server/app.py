@@ -374,6 +374,15 @@ def main() -> None:
                         help="'cpu' pins jax to host devices — e.g. "
                              "--fake serving on a box whose accelerator "
                              "tunnel is absent or down")
+    parser.add_argument("--lm", default="gpt2",
+                        choices=("gpt2", "mistral"),
+                        help="prompt-LM family: gpt2 (default) or a "
+                             "Mistral-7B-class model (the reference's "
+                             "actual LLM, reference backend.py:25)")
+    parser.add_argument("--lm-int8", action="store_true",
+                        help="weights-only int8 for the prompt LM "
+                             "(ops/quant.py) — what fits Mistral-7B-"
+                             "class weights + decode on one 16 GB chip")
     args = parser.parse_args()
 
     if args.platform == "cpu":
@@ -395,13 +404,22 @@ def main() -> None:
         cfg = deepcache_serving_config()
     else:
         cfg = FrameworkConfig()
-    if args.round_seconds:
-        import dataclasses
+    import dataclasses
 
+    if args.round_seconds:
         cfg = cfg.replace(
             game=dataclasses.replace(cfg.game,
                                      time_per_prompt=args.round_seconds)
         )
+    if args.lm == "mistral" or args.lm_int8:
+        from cassmantle_tpu.config import MistralConfig
+
+        models = cfg.models
+        if args.lm == "mistral":
+            models = dataclasses.replace(models, mistral=MistralConfig())
+        if args.lm_int8:
+            models = dataclasses.replace(models, lm_int8=True)
+        cfg = cfg.replace(models=models)
     game = build_game(cfg, fake=args.fake, weights_dir=args.weights,
                       store_addr=args.store)
     web.run_app(create_app(game, cfg, device_health=not args.fake),
