@@ -322,6 +322,12 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
 
         port = int(store_addr.split(":")[1]) if ":" in store_addr else 7070
         store = MantleStore(port=port)
+    elif store_addr:
+        # fail loudly: a typo'd store string silently falling back to a
+        # per-process MemoryStore would split-brain a multi-worker fleet
+        raise ValueError(
+            f"unknown store address {store_addr!r} (expected "
+            f"'native[:port]')")
     else:
         store = MemoryStore()
     if fake:
@@ -383,6 +389,14 @@ def main() -> None:
                         help="weights-only int8 for the prompt LM "
                              "(ops/quant.py) — what fits Mistral-7B-"
                              "class weights + decode on one 16 GB chip")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="worker processes sharing the port "
+                             "(SO_REUSEPORT) and one --store "
+                             "(required >1) — the multi-worker layout "
+                             "the reference ran as multi-worker "
+                             "uvicorn (main.py:37-40): every worker "
+                             "runs the lock-guarded global timer, "
+                             "exactly one generates per round")
     args = parser.parse_args()
 
     if args.platform == "cpu":
@@ -420,10 +434,61 @@ def main() -> None:
         if args.lm_int8:
             models = dataclasses.replace(models, lm_int8=True)
         cfg = cfg.replace(models=models)
+    if args.workers > 1:
+        import multiprocessing
+        import signal
+        import threading
+
+        if not (args.store and args.store.startswith("native")):
+            parser.error("--workers > 1 requires --store native[:port] "
+                         "(a shared native store is the coordination "
+                         "plane; per-process MemoryStores would each "
+                         "run their own game)")
+        if not (args.fake or args.platform == "cpu"):
+            parser.error("--workers > 1 needs --fake or --platform cpu: "
+                         "one accelerator chip has one owning process — "
+                         "TPU-backed serving runs single-worker (the "
+                         "inference queue already coalesces requests)")
+        procs = []
+        for _ in range(args.workers - 1):
+            p = multiprocessing.Process(
+                target=_run_worker, args=(args, cfg), daemon=True)
+            p.start()
+            procs.append(p)
+
+        def _watch() -> None:
+            # a silently-dead worker degrades capacity invisibly
+            for p in procs:
+                p.join()
+                if p.exitcode not in (0, None, -signal.SIGINT,
+                                      -signal.SIGTERM):
+                    log.error("worker pid=%s died with exit code %s",
+                              p.pid, p.exitcode)
+
+        threading.Thread(target=_watch, daemon=True).start()
+        try:
+            _run_worker(args, cfg)
+        finally:
+            # graceful first (aiohttp on_cleanup -> game.shutdown drops
+            # store locks); only then force-kill stragglers
+            for p in procs:
+                if p.is_alive():
+                    os.kill(p.pid, signal.SIGINT)
+            for p in procs:
+                p.join(timeout=5.0)
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+        return
+    _run_worker(args, cfg)
+
+
+def _run_worker(args, cfg: FrameworkConfig) -> None:
     game = build_game(cfg, fake=args.fake, weights_dir=args.weights,
                       store_addr=args.store)
     web.run_app(create_app(game, cfg, device_health=not args.fake),
-                host=args.host, port=args.port)
+                host=args.host, port=args.port,
+                reuse_port=(args.workers > 1))
 
 
 if __name__ == "__main__":

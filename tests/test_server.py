@@ -244,3 +244,10 @@ async def test_debug_trace_endpoint(tmp_path):
         assert res.status == 400
     finally:
         await client.close()
+
+
+def test_build_game_rejects_unknown_store_address():
+    from cassmantle_tpu.server.app import build_game
+
+    with pytest.raises(ValueError, match="store address"):
+        build_game(make_cfg(), fake=True, store_addr="redis:6379")
