@@ -34,14 +34,11 @@ from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("app")
 
-STATIC_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "static"
-)
-DATA_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "data"
-)
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+STATIC_DIR = os.path.join(_ROOT, "static")
+DATA_DIR = os.path.join(_ROOT, "data")
+MEDIA_DIR = os.path.join(_ROOT, "media")
 
 _GAME = web.AppKey("game", Game)
 _HEALTH = web.AppKey("health", object)
@@ -292,6 +289,10 @@ def create_app(game: Game, cfg: FrameworkConfig,
         app.router.add_static("/static", STATIC_DIR)
     if os.path.isdir(DATA_DIR):
         app.router.add_static("/data", DATA_DIR)
+    if os.path.isdir(MEDIA_DIR):
+        # brand/UI assets, the reference's third static mount
+        # (main.py:25-27); all files here are original SVGs
+        app.router.add_static("/media", MEDIA_DIR)
 
     async def on_startup(app_: web.Application) -> None:
         await game.startup()
@@ -317,17 +318,20 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
     """
     from cassmantle_tpu.engine.store import MemoryStore
 
-    if store_addr and store_addr.startswith("native"):
+    if store_addr:
+        import re
+
+        m = re.fullmatch(r"native(?::(\d+))?", store_addr)
+        if not m:
+            # fail loudly: a typo'd store string silently falling back
+            # to a per-process MemoryStore would split-brain a
+            # multi-worker fleet
+            raise ValueError(
+                f"unknown store address {store_addr!r} (expected "
+                f"'native[:port]')")
         from cassmantle_tpu.native.client import MantleStore
 
-        port = int(store_addr.split(":")[1]) if ":" in store_addr else 7070
-        store = MantleStore(port=port)
-    elif store_addr:
-        # fail loudly: a typo'd store string silently falling back to a
-        # per-process MemoryStore would split-brain a multi-worker fleet
-        raise ValueError(
-            f"unknown store address {store_addr!r} (expected "
-            f"'native[:port]')")
+        store = MantleStore(port=int(m.group(1) or 7070))
     else:
         store = MemoryStore()
     if fake:
@@ -457,13 +461,20 @@ def main() -> None:
             procs.append(p)
 
         def _watch() -> None:
-            # a silently-dead worker degrades capacity invisibly
-            for p in procs:
-                p.join()
-                if p.exitcode not in (0, None, -signal.SIGINT,
-                                      -signal.SIGTERM):
-                    log.error("worker pid=%s died with exit code %s",
-                              p.pid, p.exitcode)
+            # a silently-dead worker degrades capacity invisibly; wait
+            # on ALL sentinels at once (a sequential join would sit on
+            # the first worker while a later one dies unreported)
+            from multiprocessing.connection import wait as mp_wait
+
+            pending = {p.sentinel: p for p in procs}
+            while pending:
+                for sentinel in mp_wait(list(pending)):
+                    p = pending.pop(sentinel)
+                    p.join()
+                    if p.exitcode not in (0, None, -signal.SIGINT,
+                                          -signal.SIGTERM):
+                        log.error("worker pid=%s died with exit code %s",
+                                  p.pid, p.exitcode)
 
         threading.Thread(target=_watch, daemon=True).start()
         try:

@@ -57,7 +57,7 @@ function connectClock() {
     clock.textContent = data.time;
     const [mm, ss] = data.time.split(":").map(Number);
     clock.classList.toggle("blink", mm * 60 + ss <= 60);
-    $("players").textContent = `${data.conns} online`;
+    $("player-count").textContent = `${data.conns}`;
     if (data.reset) {
       state.won = false;
       $("win-banner").classList.add("hidden");
