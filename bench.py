@@ -34,6 +34,28 @@ PROMPTS = [
 ]
 
 
+def probe_device(timeout_s: float = 180.0) -> None:
+    """Fail FAST if the accelerator is unreachable. A dead device
+    tunnel makes the first jax backend init block indefinitely (not
+    error), which would hang the whole bench run; probing in a
+    subprocess turns that into a clean, attributable failure."""
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((64, 64)); (x @ x).block_until_ready(); "
+            "print(jax.devices())")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        sys.exit(f"device probe timed out after {timeout_s:.0f}s — "
+                 f"accelerator tunnel down or wedged; not starting bench")
+    if proc.returncode != 0:
+        sys.exit("device probe failed:\n" + proc.stderr[-2000:])
+
+
 def _setup_jax():
     import jax
 
@@ -248,6 +270,7 @@ def main() -> None:
     args = [a for a in args if not a.startswith("--")]
     weights_dir = args[0] if args else "weights"
 
+    probe_device()
     if not suite:
         print(json.dumps(bench_sd15(weights_dir)))
         return
