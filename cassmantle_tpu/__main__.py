@@ -82,6 +82,10 @@ def cmd_fetch_weights(argv) -> int:
     return _run_script(os.path.join("tools", "fetch_weights.py"), argv)
 
 
+def cmd_quantize_weights(argv) -> int:
+    return _run_script(os.path.join("tools", "quantize_weights.py"), argv)
+
+
 def _train_parser(desc: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=desc)
     p.add_argument("--steps", type=int, default=20)
@@ -271,6 +275,7 @@ COMMANDS = {
     "serve": cmd_serve,
     "bench": cmd_bench,
     "fetch-weights": cmd_fetch_weights,
+    "quantize-weights": cmd_quantize_weights,
     "train-diffusion": cmd_train_diffusion,
     "train-lm": cmd_train_lm,
 }

@@ -473,7 +473,14 @@ def maybe_load(
     path = os.path.join(weights_dir, filename)
     if os.path.exists(path):
         log.info("%s: loading %s", model_name, path)
-        tensors = load_safetensors(path)
+        try:
+            tensors = load_safetensors(path)
+        except Exception:
+            # truncated/corrupt download: degrade to the documented
+            # random-init fallback instead of crashing the server boot
+            log.exception("%s: checkpoint at %s is unreadable; "
+                          "falling back to random init", model_name, path)
+            return None
     else:
         # sharded checkpoints: <stem>-*.safetensors merge into one dict
         import glob

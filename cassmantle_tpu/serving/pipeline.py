@@ -11,6 +11,7 @@ implements for tests (engine/content.py).
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 from functools import partial
 from typing import List, Optional, Sequence
@@ -358,24 +359,31 @@ class PromptGenerator:
                       lambda t: convert_gpt2(t, m.num_layers, m.hidden_size),
                       "gpt2")
         self.mcfg = m
+        self._int8_path = (
+            os.path.join(weights_dir, f"{loader[2]}.int8.safetensors")
+            if weights_dir else None)
         ids = jnp.zeros((1, 8), dtype=jnp.int32)
-        transform = None
-        if cfg.models.lm_int8:
-            # Quantize on HOST, before device placement: peak HBM stays
-            # at the int8 footprint (quantizing after would briefly hold
-            # the fp and int8 trees resident together — fatal for a
-            # 7B-class model on a 16 GB chip).
-            from cassmantle_tpu.ops.quant import quantize_tree_host
+        self.params = (self._load_int8_checkpoint(loader[2], weights_dir)
+                       if cfg.models.lm_int8 else None)
+        if self.params is None:
+            transform = None
+            if cfg.models.lm_int8:
+                # Quantize on HOST, before device placement: peak HBM
+                # stays at the int8 footprint (quantizing after would
+                # briefly hold the fp and int8 trees resident together —
+                # fatal for a 7B-class model on a 16 GB chip).
+                from cassmantle_tpu.ops.quant import quantize_tree_host
 
-            transform = quantize_tree_host
-        self.params = (
-            maybe_load(weights_dir, loader[0], loader[1], loader[2],
-                       cast_to=cfg.models.param_dtype, transform=transform)
-            or init_params_cached(
-                self.model, 5, ids,
-                cache_path=param_cache_path(loader[2], m),
-                cast_to=cfg.models.param_dtype, transform=transform)
-        )
+                transform = quantize_tree_host
+            self.params = (
+                maybe_load(weights_dir, loader[0], loader[1], loader[2],
+                           cast_to=cfg.models.param_dtype,
+                           transform=transform)
+                or init_params_cached(
+                    self.model, 5, ids,
+                    cache_path=param_cache_path(loader[2], m),
+                    cast_to=cfg.models.param_dtype, transform=transform)
+            )
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
         cls = type(self.model)
@@ -396,6 +404,48 @@ class PromptGenerator:
             self._step = quantized_apply(self._step, dq_dtype)
             log.info("lm_int8: serving %.2f GB quantized param tree",
                      tree_nbytes(self.params) / 1e9)
+
+    def _load_int8_checkpoint(self, name: str, weights_dir):
+        """Pre-quantized checkpoint (tools/quantize_weights.py): int8
+        straight from disk — no fp pass, half the read bytes. Returns
+        None (-> normal fp path) when the file is absent, STALE (the fp
+        checkpoint is newer — an operator re-fetched weights without
+        re-quantizing), or structurally unloadable (e.g. the model
+        config changed since quantization)."""
+        if not (self._int8_path and os.path.exists(self._int8_path)):
+            return None
+        fp_path = os.path.join(weights_dir, f"{name}.safetensors")
+        if os.path.exists(fp_path) and \
+                os.path.getmtime(fp_path) > os.path.getmtime(self._int8_path):
+            log.warning(
+                "%s is older than %s; re-quantizing from the fp "
+                "checkpoint (run quantize-weights to refresh)",
+                self._int8_path, fp_path)
+            return None
+        from cassmantle_tpu.ops.quant import load_quantized
+
+        log.info("%s: loading quantized %s", name, self._int8_path)
+        try:
+            return jax.tree_util.tree_map(
+                jnp.asarray, load_quantized(self._int8_path))
+        except Exception:
+            log.exception(
+                "quantized checkpoint %s failed to load (model config "
+                "changed since quantization?); falling back to the fp "
+                "path", self._int8_path)
+            return None
+
+    def save_quantized(self, path: Optional[str] = None) -> str:
+        """Persist the (quantized) param tree so later boots load int8
+        straight from disk. Requires lm_int8; default path is the
+        weights-dir convention the constructor checks."""
+        assert self.cfg.models.lm_int8, "construct with lm_int8=True first"
+        from cassmantle_tpu.ops.quant import save_quantized
+
+        path = path or self._int8_path
+        assert path, "no weights_dir: pass an explicit path"
+        save_quantized(self.params, path)
+        return path
 
     def decode_ids(self, seed_text: str,
                    max_new_tokens: Optional[int] = None):

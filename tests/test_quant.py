@@ -145,3 +145,53 @@ def test_quantized_lm_decode_end_to_end(cfg, monkeypatch):
     leaves = jax.tree_util.tree_leaves(
         gen_q.params, is_leaf=lambda x: isinstance(x, QT))
     assert any(isinstance(leaf, QT) for leaf in leaves)
+
+
+def test_save_load_quantized_roundtrip(tmp_path):
+    from cassmantle_tpu.ops.quant import load_quantized, save_quantized
+
+    w = jax.random.normal(jax.random.PRNGKey(7), (300, 300))
+    tree = quantize_tree({"a": {"kernel": w, "bias": jnp.ones((300,))}})
+    path = str(tmp_path / "q.safetensors")
+    save_quantized(tree, path)
+    back = load_quantized(path)
+    q0, q1 = tree["a"]["kernel"], back["a"]["kernel"]
+    assert isinstance(q1, QTensor)
+    np.testing.assert_array_equal(np.asarray(q0.data), np.asarray(q1.data))
+    np.testing.assert_allclose(np.asarray(q0.scale), np.asarray(q1.scale))
+    np.testing.assert_array_equal(np.asarray(back["a"]["bias"]),
+                                  np.ones((300,)))
+
+
+def test_prompt_generator_int8_checkpoint_boot(cfg, tmp_path, monkeypatch):
+    """Quantize once, save, boot again from the int8 file: identical
+    quantized params, no fp load."""
+    import dataclasses
+
+    import cassmantle_tpu.ops.quant as quant
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    monkeypatch.setattr(
+        quant, "default_predicate",
+        lambda path, leaf: "kernel" in str(path[-1] if path else "")
+        and getattr(leaf, "ndim", 0) >= 2)
+    qcfg = cfg.replace(models=dataclasses.replace(cfg.models, lm_int8=True))
+
+    gen1 = PromptGenerator(qcfg, weights_dir=str(tmp_path))
+    path = gen1.save_quantized()
+    assert path.endswith("gpt2.int8.safetensors")
+
+    gen2 = PromptGenerator(qcfg, weights_dir=str(tmp_path))
+    l1 = jax.tree_util.tree_leaves(
+        gen1.params, is_leaf=lambda x: isinstance(x, QTensor))
+    l2 = jax.tree_util.tree_leaves(
+        gen2.params, is_leaf=lambda x: isinstance(x, QTensor))
+    assert len(l1) == len(l2)
+    for a, b in zip(l1, l2):
+        if isinstance(a, QTensor):
+            assert isinstance(b, QTensor)
+            np.testing.assert_array_equal(np.asarray(a.data),
+                                          np.asarray(b.data))
+    # and the loaded generator still decodes
+    toks, n = gen2.decode_ids("the storm", max_new_tokens=4)
+    assert toks.shape[1] == 4

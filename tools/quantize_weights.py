@@ -1,0 +1,55 @@
+"""Offline weight quantization: produce an int8 LM checkpoint.
+
+One-shot (like the reference's download_model.py bootstrap): build the
+prompt-LM with ``lm_int8`` (loading/converting whatever fp checkpoint is
+in --weights, or deterministic random init without one), then write
+``<family>.int8.safetensors`` next to it. Every later boot with
+``lm_int8`` loads int8 straight from disk — no fp pass, half the read
+bytes, and the quantization cost is paid once instead of per process.
+
+Usage: python tools/quantize_weights.py --weights weights [--lm mistral]
+       (or: python -m cassmantle_tpu quantize-weights ...)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--weights", required=True,
+                        help="checkpoint directory (output lands here)")
+    parser.add_argument("--lm", default="gpt2",
+                        choices=("gpt2", "mistral"))
+    parser.add_argument("--platform", default="cpu",
+                        choices=("auto", "cpu"),
+                        help="default 'cpu': quantization is host-only, "
+                             "so don't initialize the accelerator or "
+                             "round-trip multi-GB trees through it")
+    args = parser.parse_args()
+
+    if args.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
+
+    from cassmantle_tpu.config import FrameworkConfig, MistralConfig
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    cfg = FrameworkConfig()
+    models = dataclasses.replace(cfg.models, lm_int8=True)
+    if args.lm == "mistral":
+        models = dataclasses.replace(models, mistral=MistralConfig())
+    cfg = cfg.replace(models=models)
+
+    gen = PromptGenerator(cfg, weights_dir=args.weights)
+    path = gen.save_quantized()
+    print(f"quantized checkpoint written: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
