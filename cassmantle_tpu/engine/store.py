@@ -33,7 +33,7 @@ import contextlib
 import pickle
 import time
 import uuid
-from typing import AsyncIterator, Callable, Dict, Iterable, Optional, Set, Union
+from typing import AsyncIterator, Callable, Dict, Optional, Set, Union
 
 Value = Union[str, bytes, int, float]
 

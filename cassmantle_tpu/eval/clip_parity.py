@@ -13,7 +13,7 @@ random init it still validates the plumbing end to end.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +29,6 @@ from cassmantle_tpu.models.clip_vision import (
 from cassmantle_tpu.models.weights import (
     convert_clip_text,
     init_params,
-    load_safetensors,
     maybe_load,
 )
 from cassmantle_tpu.utils.tokenizers import load_tokenizer

@@ -12,7 +12,6 @@ module at ClipTextConfig.sdxl_big() dims.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import flax.linen as nn
 import jax

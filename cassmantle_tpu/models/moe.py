@@ -22,7 +22,6 @@ the ``P("ep", ...)`` param specs for mesh placement.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import flax.linen as nn
 import jax

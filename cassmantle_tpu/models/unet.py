@@ -22,7 +22,7 @@ skip concatenation and nearest-neighbor upsample → GroupNorm/SiLU/conv_out.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import flax.linen as nn
 import jax

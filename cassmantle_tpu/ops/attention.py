@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-from functools import partial
 from typing import Optional
 
 import jax

@@ -13,7 +13,6 @@ truncated Gaussian (radius = 3.5 sigma, SciPy/PIL-like truncation).
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -15,13 +15,11 @@ by default, eta>0 adds the stochastic DDPM-style term.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from cassmantle_tpu.config import SamplerConfig
 
 
 def alpha_bars_full(

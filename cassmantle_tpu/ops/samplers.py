@@ -20,7 +20,7 @@ sampler kinds integrate the same discretization of the same ODE).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

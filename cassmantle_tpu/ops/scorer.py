@@ -10,7 +10,6 @@ through the serving queue.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Sequence, Tuple
 
 import jax

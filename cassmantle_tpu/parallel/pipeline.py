@@ -23,12 +23,11 @@ and ``tp`` (shard the stage weights) on the same mesh.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def stack_stage_params(per_stage_params: Sequence):

@@ -22,8 +22,7 @@ step. Design:
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +32,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.models.unet import UNet
 from cassmantle_tpu.models.weights import init_params
-from cassmantle_tpu.ops.ddim import DDIMSchedule
 from cassmantle_tpu.parallel.sharding import shard_params
 
 

@@ -41,10 +41,7 @@ from cassmantle_tpu.models.weights import (
     init_params_cached,
     maybe_load,
 )
-from cassmantle_tpu.ops.ddim import (
-    initial_latents,
-    make_cfg_denoiser,
-)
+from cassmantle_tpu.ops.ddim import initial_latents
 from cassmantle_tpu.ops.samplers import make_sampler
 from cassmantle_tpu.utils.compile_cache import (
     enable_compile_cache,

@@ -8,7 +8,6 @@ optimization work. Usage: python tools/profile_unet.py [batch]
 from __future__ import annotations
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
