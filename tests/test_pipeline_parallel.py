@@ -103,3 +103,40 @@ def test_moe_capacity_overflow_drops_tokens():
     # overflowing tokens produce zero MoE output (residual fall-through)
     zero_rows = np.sum(np.all(np.asarray(out) == 0.0, axis=-1))
     assert zero_rows >= 6  # 8 tokens, <=2 kept
+
+
+def test_moe_expert_parallel_train_step():
+    """Gradients flow through the expert-parallel dispatch/combine (the
+    GSPMD all-to-alls) and reduce a regression loss — expert-parallel
+    TRAINING, not just inference."""
+    import optax
+
+    mesh = make_mesh(MeshConfig(dp=1, ep=8))
+    model = MoEMLP(num_experts=8, intermediate=32, capacity_factor=2.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16))
+    y = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 16)) * 0.1
+    params = shard_moe_params(
+        model.init(jax.random.PRNGKey(2), x), mesh)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, aux = model.apply(p, x, mutable=["aux_loss"])
+            lb = aux["aux_loss"]["load_balance"][0]  # sow returns a tuple
+            return jnp.mean((out - y) ** 2) + 0.01 * lb
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    losses = []
+    for _ in range(6):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # expert weights stayed ep-sharded through the update
+    spec = params["params"]["w1"].sharding.spec
+    assert "ep" in tuple(spec), spec
