@@ -119,8 +119,15 @@ def multi_head_attention(
                 batch_axis=batch_axis,
             )
     if causal and mask is None:
+        # bottom-right-aligned band: when s_q != s_k (cached decode, where
+        # the queries are the LAST s_q positions of the sequence), query i
+        # attends keys [0, s_k - s_q + i]; reduces to plain tril at
+        # s_q == s_k
         s_q, s_k = q.shape[-3], k.shape[-3]
-        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        assert s_q <= s_k, (
+            f"causal decode needs s_q <= s_k, got {s_q} > {s_k}"
+        )
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
     if _FORCE_XLA.get():
         use_flash = False
     if use_flash is None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import re
 import tempfile
 import uuid
 from typing import Optional
@@ -42,7 +43,7 @@ MEDIA_DIR = os.path.join(_ROOT, "media")
 
 _GAME = web.AppKey("game", Game)
 _HEALTH = web.AppKey("health", object)
-_TRACE_ACTIVE = web.AppKey("trace_active", bool)
+_TRACE_STATE = web.AppKey("trace_state", dict)
 
 
 def _client_ip(request: web.Request) -> str:
@@ -213,10 +214,16 @@ async def handle_healthz(request: web.Request) -> web.Response:
 
 async def handle_debug_trace(request: web.Request) -> web.Response:
     """On-demand jax.profiler capture (SURVEY.md §5.1 — the reference has
-    no tracing at all): ``POST /debug/trace?seconds=N[&dir=path]``
+    no tracing at all): ``POST /debug/trace?seconds=N[&name=subdir]``
     records N seconds of device+host activity to a TensorBoard trace
     directory while live traffic runs, and returns its path. One capture
-    at a time; loopback only (an operator surface, not a player one)."""
+    at a time; loopback only (an operator surface, not a player one).
+
+    The write path is never request-chosen: captures land under a fixed
+    root (``CASSMANTLE_TRACE_ROOT`` env or the system tempdir), and the
+    optional ``name`` selects only a single sanitized subdirectory —
+    a same-host reverse proxy forwarding this route cannot turn it into
+    an arbitrary-filesystem-write primitive."""
     # fail closed: an unresolvable peer (None — e.g. unix-socket behind a
     # proxy) is NOT treated as local
     if request.remote not in ("127.0.0.1", "::1"):
@@ -225,13 +232,18 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
         seconds = min(60.0, float(request.query.get("seconds", "5")))
     except ValueError:
         raise web.HTTPBadRequest(text="seconds must be a number")
-    log_dir = request.query.get(
-        "dir", os.path.join(tempfile.gettempdir(), "cassmantle_trace")
+    name = request.query.get("name", "capture")
+    if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name) or ".." in name:
+        raise web.HTTPBadRequest(text="name must be [A-Za-z0-9._-]{1,64}")
+    root = os.environ.get(
+        "CASSMANTLE_TRACE_ROOT",
+        os.path.join(tempfile.gettempdir(), "cassmantle_trace"),
     )
-    app = request.app
-    if app.get(_TRACE_ACTIVE):
+    log_dir = os.path.join(root, name)
+    trace_state = request.app[_TRACE_STATE]
+    if trace_state["active"]:
         raise web.HTTPConflict(text="a trace capture is already running")
-    app[_TRACE_ACTIVE] = True
+    trace_state["active"] = True
     try:
         import jax
 
@@ -245,7 +257,7 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
         finally:
             await loop.run_in_executor(None, jax.profiler.stop_trace)
     finally:
-        app[_TRACE_ACTIVE] = False
+        trace_state["active"] = False
     metrics.inc("server.trace_captures")
     return web.json_response({"trace_dir": log_dir, "seconds": seconds})
 
@@ -271,6 +283,10 @@ def create_app(game: Game, cfg: FrameworkConfig,
         cors_middleware, make_ratelimit_middleware(cfg)
     ])
     app[_GAME] = game
+    # mutable holder created before the app starts: flipping a field at
+    # request time is legal where reassigning an app key is not (aiohttp
+    # deprecates, and 4.x forbids, mutating a started app's keys)
+    app[_TRACE_STATE] = {"active": False}
     if device_health:
         from cassmantle_tpu.utils.health import DeviceHealth
 

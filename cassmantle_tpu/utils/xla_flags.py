@@ -17,7 +17,8 @@ COLLECTIVE_TIMEOUT_FLAGS = (
     "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
 )
 
-VIRTUAL_8_DEVICE_FLAG = "--xla_force_host_platform_device_count=8"
+def virtual_device_flag(count: int) -> str:
+    return f"--xla_force_host_platform_device_count={count}"
 
 
 def append_xla_flags(*flags: str) -> None:
@@ -30,7 +31,9 @@ def append_xla_flags(*flags: str) -> None:
     os.environ["XLA_FLAGS"] = current
 
 
-def pin_cpu_platform(virtual_devices: bool = True) -> None:
+def pin_cpu_platform(
+    virtual_devices: bool = True, device_count: int = 8
+) -> None:
     """Force jax onto host CPU devices, robustly against plugin backends.
 
     The one place the subtle ordering rules live (used by
@@ -46,7 +49,9 @@ def pin_cpu_platform(virtual_devices: bool = True) -> None:
       forever when the tunnel behind a plugin is down.
     """
     if virtual_devices:
-        append_xla_flags(VIRTUAL_8_DEVICE_FLAG, *COLLECTIVE_TIMEOUT_FLAGS)
+        append_xla_flags(
+            virtual_device_flag(device_count), *COLLECTIVE_TIMEOUT_FLAGS
+        )
     else:
         append_xla_flags(*COLLECTIVE_TIMEOUT_FLAGS)
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cassmantle_tpu.config import test_config
+from cassmantle_tpu.config import test_config as _tiny_config
 from cassmantle_tpu.models.unet import UNet
 from cassmantle_tpu.models.weights import init_params
 from cassmantle_tpu.ops.ddim import (
@@ -27,7 +27,7 @@ from cassmantle_tpu.ops.ddim import (
 
 
 def _tiny_unet():
-    cfg = test_config().models.unet
+    cfg = _tiny_config().models.unet
     model = UNet(cfg)
     lat = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 4))
     t = jnp.array([5, 9], jnp.int32)
@@ -88,7 +88,7 @@ def test_paired_loop_matches_plain_ddim_when_cache_ignored():
 def test_pipeline_with_deepcache_config():
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
-    cfg = test_config()
+    cfg = _tiny_config()
     cfg = cfg.replace(sampler=dataclasses.replace(
         cfg.sampler, kind="ddim", deepcache=True, num_steps=4))
     pipe = Text2ImagePipeline(cfg)
@@ -101,7 +101,7 @@ def test_deepcache_rejects_odd_steps_or_wrong_sampler():
 
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
-    cfg = test_config()
+    cfg = _tiny_config()
     bad = cfg.replace(sampler=dataclasses.replace(
         cfg.sampler, kind="ddim", deepcache=True, num_steps=5))
     with pytest.raises(AssertionError, match="even"):
@@ -133,7 +133,7 @@ def test_img2img_rejects_deepcache():
 
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
-    cfg = test_config()
+    cfg = _tiny_config()
     cfg = cfg.replace(sampler=dataclasses.replace(
         cfg.sampler, kind="ddim", deepcache=True, num_steps=4))
     pipe = Text2ImagePipeline(cfg)

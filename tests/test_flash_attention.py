@@ -82,3 +82,22 @@ def test_flash_extreme_logits_stable():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4
     )
+
+
+def test_causal_decode_alignment():
+    """causal=True with s_q != s_k (cached decode: queries are the LAST
+    s_q positions) must use a bottom-right-aligned band — the single last
+    query sees every key, and the general case matches a full-sequence
+    causal run restricted to its last rows."""
+    from cassmantle_tpu.ops.attention import multi_head_attention as attention
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 2, 6, 2, 8)
+    full = attention(q, k, v, causal=True, use_flash=False)
+    # decode step: last query only, full KV — equals last row of full run
+    one = attention(q[:, -1:], k, v, causal=True, use_flash=False)
+    np.testing.assert_allclose(
+        np.asarray(one), np.asarray(full[:, -1:]), atol=1e-6, rtol=1e-6)
+    # chunked decode: last 3 queries vs full KV
+    tail = attention(q[:, -3:], k, v, causal=True, use_flash=False)
+    np.testing.assert_allclose(
+        np.asarray(tail), np.asarray(full[:, -3:]), atol=1e-6, rtol=1e-6)

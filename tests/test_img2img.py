@@ -5,13 +5,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from cassmantle_tpu.config import test_config
+from cassmantle_tpu.config import test_config as _tiny_config
 from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
 
 @pytest.fixture(scope="module")
 def pipe():
-    return Text2ImagePipeline(test_config())
+    return Text2ImagePipeline(_tiny_config())
 
 
 def _img(seed, size):
@@ -63,7 +63,7 @@ def test_img2img_respects_sampler_kind(kind):
     low strength still tracks the input for every kind."""
     import dataclasses
 
-    base = test_config()
+    base = _tiny_config()
     cfg = base.replace(sampler=dataclasses.replace(base.sampler, kind=kind))
     p = Text2ImagePipeline(cfg)
     size = cfg.sampler.image_size

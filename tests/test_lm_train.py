@@ -6,7 +6,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from cassmantle_tpu.config import MeshConfig, MistralConfig, test_config
+from cassmantle_tpu.config import (
+    MeshConfig,
+    MistralConfig,
+    test_config as _tiny_config,
+)
 from cassmantle_tpu.models.gpt2 import GPT2LM
 from cassmantle_tpu.models.mistral import MistralLM
 from cassmantle_tpu.parallel.lm_train import LMTrainer, next_token_loss
@@ -110,7 +114,7 @@ def test_next_token_loss_masks_padding():
 
 @pytest.mark.parametrize("family", ["gpt2", "mistral"])
 def test_lm_trainer_step_runs_and_learns(family):
-    cfg = test_config()
+    cfg = _tiny_config()
     if family == "gpt2":
         model = GPT2LM(cfg.models.gpt2)
         vocab = cfg.models.gpt2.vocab_size
@@ -138,7 +142,7 @@ def test_lm_trainer_step_runs_and_learns(family):
 
 
 def test_lm_trainer_remat_matches():
-    cfg = test_config()
+    cfg = _tiny_config()
     model = GPT2LM(cfg.models.gpt2)
     mesh = make_mesh(MeshConfig(dp=-1))
     rng = np.random.default_rng(1)
@@ -158,7 +162,7 @@ def test_lm_trainer_remat_matches():
 
 def test_end_to_end_data_to_train():
     """Corpus -> pack -> batches -> prefetch(place=shard) -> train steps."""
-    cfg = test_config()
+    cfg = _tiny_config()
     model = GPT2LM(cfg.models.gpt2)
     mesh = make_mesh(MeshConfig(dp=-1))
     trainer = LMTrainer(model, mesh, lr=1e-3)
@@ -334,7 +338,7 @@ def test_context_parallel_rejects_positionless_model():
             return super().__call__(input_ids, valid)
 
     mesh = make_mesh(MeshConfig(dp=2, tp=1, sp=4))
-    cfg = test_config()
+    cfg = _tiny_config()
     with pytest.raises(TypeError, match="positions"):
         LMTrainer(NoPositionsLM(cfg.models.gpt2), mesh,
                   context_parallel=True)

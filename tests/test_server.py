@@ -227,20 +227,24 @@ async def test_index_served():
 
 
 @pytest.mark.asyncio
-async def test_debug_trace_endpoint(tmp_path):
+async def test_debug_trace_endpoint(tmp_path, monkeypatch):
     """POST /debug/trace captures a jax.profiler trace while traffic
-    runs and is single-flight + loopback-guarded."""
+    runs and is single-flight + loopback-guarded. The write path is the
+    operator-set root + a sanitized name — never a request-chosen path."""
+    monkeypatch.setenv("CASSMANTLE_TRACE_ROOT", str(tmp_path))
     client, _ = await make_client(make_cfg())
     try:
-        res = await client.post(
-            f"/debug/trace?seconds=0.2&dir={tmp_path / 'tr'}")
+        res = await client.post("/debug/trace?seconds=0.2&name=tr")
         assert res.status == 200
         data = await res.json()
-        assert data["trace_dir"].endswith("tr")
+        assert data["trace_dir"] == str(tmp_path / "tr")
         import os as _os
 
         assert _os.path.isdir(data["trace_dir"])      # trace written
         res = await client.post("/debug/trace?seconds=abc")
+        assert res.status == 400
+        # path traversal in name is rejected, not written
+        res = await client.post("/debug/trace?seconds=0.1&name=../evil")
         assert res.status == 400
     finally:
         await client.close()
