@@ -28,6 +28,8 @@ from cassmantle_tpu.models.clip_vision import (
 )
 from cassmantle_tpu.models.weights import (
     convert_clip_text,
+    convert_clip_text_projection,
+    convert_clip_vision,
     init_params,
     maybe_load,
 )
@@ -51,29 +53,50 @@ class ClipSimilarityHarness:
 
         self.text = ClipTextEncoder(self.text_cfg)
         ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
+        loaded_text = maybe_load(
+            weights_dir, "clip_text.safetensors",
+            lambda t: convert_clip_text(t, self.text_cfg.num_layers),
+            "clip_text")
         self.text_params = (
-            maybe_load(weights_dir, "clip_text.safetensors",
-                       lambda t: convert_clip_text(
-                           t, self.text_cfg.num_layers),
-                       "clip_text")
-            or init_params(self.text, 11, ids)
+            loaded_text if loaded_text is not None
+            else init_params(self.text, 11, ids)
         )
 
+        # the vision tower and both projections live in the SAME full
+        # CLIPModel checkpoint as the text tower (clip_text.safetensors =
+        # openai/clip-vit-large-patch14 model.safetensors) — no separate
+        # vision file to fetch
         self.vision = ClipVisionEncoder(self.vision_cfg)
         img = jnp.zeros(
             (1, self.vision_cfg.image_size, self.vision_cfg.image_size, 3)
         )
-        self.vision_params = init_params(self.vision, 12, img)
+        loaded_vision = maybe_load(
+            weights_dir, "clip_text.safetensors",
+            lambda t: convert_clip_vision(t, self.vision_cfg.num_layers),
+            "clip_vision")
+        self.vision_params = (
+            loaded_vision if loaded_vision is not None
+            else init_params(self.vision, 12, img)
+        )
 
         # text projection into the shared space
-        rng = jax.random.PRNGKey(13)
-        self.text_projection = (
-            jax.random.normal(
-                rng,
-                (self.text_cfg.hidden_size, self.vision_cfg.projection_dim),
-            )
-            * 0.02
+        proj = maybe_load(weights_dir, "clip_text.safetensors",
+                          convert_clip_text_projection,
+                          "clip_text_projection")
+        # a real parity number needs EVERY stage loaded, not just some —
+        # a partial load (e.g. vision conversion KeyError falling back to
+        # random init) must not masquerade as a quality measurement
+        self.loaded_real_weights = (
+            loaded_text is not None
+            and loaded_vision is not None
+            and proj is not None
         )
+        if proj is None:
+            proj = jax.random.normal(
+                jax.random.PRNGKey(13),
+                (self.text_cfg.hidden_size, self.vision_cfg.projection_dim),
+            ) * 0.02
+        self.text_projection = proj
         # params as jit args (device buffers), not captured constants
         self._params = {"text": self.text_params,
                         "vision": self.vision_params,
@@ -114,6 +137,8 @@ class ClipSimilarityHarness:
             "clip_sim_mean": float(np.mean(sims)),
             "clip_sim_std": float(np.std(sims)),
             "n": int(len(sims)),
+            # False => plumbing-only run (random init): NOT a quality claim
+            "real_weights": self.loaded_real_weights,
         }
         if baseline_mean is not None:
             report["baseline_mean"] = float(baseline_mean)

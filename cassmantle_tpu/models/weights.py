@@ -147,6 +147,48 @@ def convert_clip_text(tensors: Tensors, num_layers: int) -> dict:
     return c.tree()
 
 
+def convert_clip_vision(tensors: Tensors, num_layers: int) -> dict:
+    """CLIP vision tower (transformers CLIPModel naming, prefix
+    "vision_model.") -> ClipVisionEncoder tree. The SAME full-model
+    checkpoint that feeds convert_clip_text carries these tensors plus
+    ``visual_projection`` — the parity harness (eval/clip_parity.py)
+    loads both towers from one file. Mirrors the reference's image-side
+    quality check role (/root/reference/src/backend.py:270-295 trusts a
+    hosted SDXL endpoint; we score images against prompts locally)."""
+    c = Converter(tensors, "clip_vision")
+    p = "vision_model."
+    c.put("class_embedding", c.take(f"{p}embeddings.class_embedding"))
+    c.put("position_embedding",
+          c.take(f"{p}embeddings.position_embedding.weight"))
+    c.put("patch_embed/kernel",
+          _conv(c.take(f"{p}embeddings.patch_embedding.weight")))
+    # transformers ships this layer under a historically typo'd name
+    # ("pre_layrnorm"); accept the corrected spelling too
+    pre = (f"{p}pre_layrnorm" if c.has(f"{p}pre_layrnorm.weight")
+           else f"{p}pre_layernorm")
+    c.norm(pre, "pre_ln")
+    for i in range(num_layers):
+        src = f"{p}encoder.layers.{i}"
+        dst = f"block_{i}"
+        c.norm(f"{src}.layer_norm1", f"{dst}/ln1")
+        c.dense(f"{src}.self_attn.q_proj", f"{dst}/attn/q")
+        c.dense(f"{src}.self_attn.k_proj", f"{dst}/attn/k")
+        c.dense(f"{src}.self_attn.v_proj", f"{dst}/attn/v")
+        c.dense(f"{src}.self_attn.out_proj", f"{dst}/attn/out")
+        c.norm(f"{src}.layer_norm2", f"{dst}/ln2")
+        c.dense(f"{src}.mlp.fc1", f"{dst}/mlp/fc1")
+        c.dense(f"{src}.mlp.fc2", f"{dst}/mlp/fc2")
+    c.norm(f"{p}post_layernorm", "post_ln")
+    c.put("projection", _t(c.take("visual_projection.weight")))
+    return c.tree()
+
+
+def convert_clip_text_projection(tensors: Tensors) -> np.ndarray:
+    """(hidden, projection_dim) text->shared-space matrix from the full
+    CLIPModel checkpoint (torch stores it (out, in))."""
+    return _t(tensors["text_projection.weight"])
+
+
 # ---------------------------------------------------------------------------
 # GPT-2 (transformers naming; Conv1D stores (in, out) -> no transpose)
 # ---------------------------------------------------------------------------

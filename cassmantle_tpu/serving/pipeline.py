@@ -144,7 +144,14 @@ class Text2ImagePipeline:
 
     def __init__(self, cfg: FrameworkConfig,
                  weights_dir: Optional[str] = None,
-                 mesh=None) -> None:
+                 mesh=None,
+                 share_params_with: "Optional[Text2ImagePipeline]" = None,
+                 ) -> None:
+        """``share_params_with``: reuse another pipeline's already-loaded
+        param trees (device buffers are shared, nothing is copied) when
+        the model configs match — presets that differ only in sampler
+        (ddim50 vs dpmpp25 vs deepcache) then skip re-reading and
+        re-converting the multi-GB checkpoints per variant."""
         enable_compile_cache()
         m = cfg.models
         self.cfg = cfg
@@ -152,6 +159,10 @@ class Text2ImagePipeline:
         self.clip = ClipTextEncoder(m.clip_text)
         self.unet = UNet(m.unet)
         self.vae = VAEDecoder(m.vae)
+        if share_params_with is not None:
+            assert share_params_with.cfg.models == m, (
+                "share_params_with needs identical model configs"
+            )
         self.tokenizer = load_tokenizer(
             weights_dir, "clip", m.clip_text.vocab_size
         )
@@ -160,38 +171,58 @@ class Text2ImagePipeline:
         # pixels per latent: one 2x upsample per VAE level transition
         self.vae_scale = 2 ** (len(m.vae.channel_mults) - 1)
 
-        ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
-        self.clip_params = (
-            maybe_load(weights_dir, "clip_text.safetensors",
-                       lambda t: convert_clip_text(t, m.clip_text.num_layers),
-                       "clip_text", cast_to=m.param_dtype)
-            or init_params_cached(
-                self.clip, 1, ids,
-                cache_path=param_cache_path("clip_text", m.clip_text),
+        if share_params_with is not None:
+            self.clip_params = share_params_with.clip_params
+            self.unet_params = share_params_with.unet_params
+            self.vae_params = share_params_with.vae_params
+            self.loaded_real_weights = share_params_with.loaded_real_weights
+        else:
+            ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
+            loaded_clip = maybe_load(
+                weights_dir, "clip_text.safetensors",
+                lambda t: convert_clip_text(t, m.clip_text.num_layers),
+                "clip_text", cast_to=m.param_dtype)
+            self.clip_params = (
+                loaded_clip if loaded_clip is not None
+                else init_params_cached(
+                    self.clip, 1, ids,
+                    cache_path=param_cache_path("clip_text", m.clip_text),
+                    cast_to=m.param_dtype)
+            )
+            lat_hw = cfg.sampler.image_size // self.vae_scale
+            lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
+            t0 = jnp.zeros((1,), dtype=jnp.int32)
+            ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
+                            dtype=jnp.float32)
+            loaded_unet = maybe_load(
+                weights_dir, "unet.safetensors",
+                lambda t: convert_unet(t, m.unet), "unet",
                 cast_to=m.param_dtype)
-        )
-        lat_hw = cfg.sampler.image_size // self.vae_scale
-        lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
-        t0 = jnp.zeros((1,), dtype=jnp.int32)
-        ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
-                        dtype=jnp.float32)
-        self.unet_params = (
-            maybe_load(weights_dir, "unet.safetensors",
-                       lambda t: convert_unet(t, m.unet), "unet",
-                       cast_to=m.param_dtype)
-            or init_params_cached(
-                self.unet, 2, lat, t0, ctx,
-                cache_path=param_cache_path("unet", m.unet),
-                cast_to=m.param_dtype)
-        )
-        self.vae_params = (
-            maybe_load(weights_dir, "vae.safetensors",
-                       lambda t: convert_vae_decoder(t, m.vae), "vae")
-            or init_params_cached(
-                self.vae, 3, lat,
-                cache_path=param_cache_path(
-                    f"vae{cfg.sampler.image_size}", m.vae))
-        )
+            self.unet_params = (
+                loaded_unet if loaded_unet is not None
+                else init_params_cached(
+                    self.unet, 2, lat, t0, ctx,
+                    cache_path=param_cache_path("unet", m.unet),
+                    cast_to=m.param_dtype)
+            )
+            loaded_vae = maybe_load(
+                weights_dir, "vae.safetensors",
+                lambda t: convert_vae_decoder(t, m.vae), "vae")
+            self.vae_params = (
+                loaded_vae if loaded_vae is not None
+                else init_params_cached(
+                    self.vae, 3, lat,
+                    cache_path=param_cache_path(
+                        f"vae{cfg.sampler.image_size}", m.vae))
+            )
+            # True only when EVERY stage came from a checkpoint: quality
+            # evals (tools/clip_report.py) refuse to call a partially
+            # random-init pipeline a measurement
+            self.loaded_real_weights = (
+                loaded_clip is not None
+                and loaded_unet is not None
+                and loaded_vae is not None
+            )
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
         self.sample_latents = make_sampler(

@@ -44,3 +44,49 @@ def test_parity_report():
     assert {"clip_sim_mean", "clip_sim_std", "n", "parity_ratio"} <= set(
         report
     )
+
+
+def test_harness_loads_full_checkpoint(tmp_path):
+    """With a full CLIPModel-style checkpoint (text + vision towers +
+    projections in ONE file) in weights_dir, the harness loads every
+    stage — the parity gate is only falsifiable when real_weights=True
+    in its reports."""
+    import jax.numpy as jnp
+    from safetensors.numpy import save_file
+
+    from cassmantle_tpu.eval.clip_parity import ClipSimilarityHarness
+    from cassmantle_tpu.models.clip_text import ClipTextEncoder
+    from cassmantle_tpu.models.clip_vision import ClipVisionEncoder
+    from cassmantle_tpu.models.weights import init_params
+    from tests.test_weights import (
+        fabricate_clip,
+        fabricate_clip_vision,
+        _torch_dense,
+    )
+
+    text_cfg = ClipTextConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, max_positions=16,
+    )
+    vcfg = ClipVisionConfig.tiny()
+    text_ref = init_params(
+        ClipTextEncoder(text_cfg), 0,
+        jnp.zeros((1, 8), dtype=jnp.int32))
+    vis_ref = init_params(
+        ClipVisionEncoder(vcfg), 1,
+        jnp.zeros((1, vcfg.image_size, vcfg.image_size, 3)))
+    proj = np.random.default_rng(0).standard_normal(
+        (text_cfg.hidden_size, vcfg.projection_dim)).astype(np.float32)
+    ckpt = {**fabricate_clip(text_ref, text_cfg.num_layers),
+            **fabricate_clip_vision(vis_ref, vcfg.num_layers),
+            "text_projection.weight": _torch_dense(proj)}
+    save_file(ckpt, str(tmp_path / "clip_text.safetensors"))
+
+    h = ClipSimilarityHarness(
+        text_cfg=text_cfg, vision_cfg=vcfg,
+        weights_dir=str(tmp_path), pad_len=16)
+    assert h.loaded_real_weights
+    np.testing.assert_allclose(np.asarray(h.text_projection), proj)
+    report = h.parity_report(
+        np.zeros((1, 32, 32, 3), dtype=np.uint8), ["x"])
+    assert report["real_weights"] is True

@@ -410,3 +410,93 @@ def test_convert_vae_decoder(cfg):
     out_a = model.apply(reference, lat)
     out_b = model.apply(jax.tree_util.tree_map(jnp.asarray, converted), lat)
     np.testing.assert_allclose(out_a, out_b, atol=1e-5)
+
+
+def fabricate_clip_vision(params, num_layers):
+    p = params["params"]
+    out = {
+        "vision_model.embeddings.class_embedding":
+            np.asarray(p["class_embedding"]),
+        "vision_model.embeddings.position_embedding.weight":
+            np.asarray(p["position_embedding"]),
+        "vision_model.embeddings.patch_embedding.weight":
+            _torch_conv(p["patch_embed"]["kernel"]),
+        "vision_model.pre_layrnorm.weight":  # transformers' typo'd name
+            np.asarray(p["pre_ln"]["scale"]),
+        "vision_model.pre_layrnorm.bias": np.asarray(p["pre_ln"]["bias"]),
+        "vision_model.post_layernorm.weight":
+            np.asarray(p["post_ln"]["scale"]),
+        "vision_model.post_layernorm.bias":
+            np.asarray(p["post_ln"]["bias"]),
+        "visual_projection.weight": _torch_dense(p["projection"]),
+    }
+    for i in range(num_layers):
+        b = p[f"block_{i}"]
+        src = f"vision_model.encoder.layers.{i}"
+        out[f"{src}.layer_norm1.weight"] = np.asarray(b["ln1"]["scale"])
+        out[f"{src}.layer_norm1.bias"] = np.asarray(b["ln1"]["bias"])
+        out[f"{src}.layer_norm2.weight"] = np.asarray(b["ln2"]["scale"])
+        out[f"{src}.layer_norm2.bias"] = np.asarray(b["ln2"]["bias"])
+        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                             ("v", "v_proj"), ("out", "out_proj")):
+            out[f"{src}.self_attn.{theirs}.weight"] = _torch_dense(
+                b["attn"][ours]["kernel"])
+            out[f"{src}.self_attn.{theirs}.bias"] = np.asarray(
+                b["attn"][ours]["bias"])
+        for fc in ("fc1", "fc2"):
+            out[f"{src}.mlp.{fc}.weight"] = _torch_dense(
+                b["mlp"][fc]["kernel"])
+            out[f"{src}.mlp.{fc}.bias"] = np.asarray(b["mlp"][fc]["bias"])
+    return out
+
+
+def test_convert_clip_vision():
+    from cassmantle_tpu.models.clip_vision import (
+        ClipVisionConfig,
+        ClipVisionEncoder,
+    )
+    from cassmantle_tpu.models.weights import (
+        convert_clip_text_projection,
+        convert_clip_vision,
+    )
+
+    vcfg = ClipVisionConfig.tiny()
+    model = ClipVisionEncoder(vcfg)
+    img = jnp.zeros((1, vcfg.image_size, vcfg.image_size, 3))
+    reference = init_params(model, 0, img)
+    ckpt = fabricate_clip_vision(reference, vcfg.num_layers)
+    # conversion also tolerates the corrected layer name
+    ckpt["text_projection.weight"] = _fill((vcfg.projection_dim, 48), 3)
+    converted = convert_clip_vision(
+        {k: v for k, v in ckpt.items() if k != "text_projection.weight"},
+        vcfg.num_layers,
+    )
+    assert_same_structure(converted, reference)
+    x = jax.random.normal(jax.random.PRNGKey(1), img.shape)
+    out_a = model.apply(reference, x)
+    out_b = model.apply(
+        jax.tree_util.tree_map(jnp.asarray, converted), x)
+    np.testing.assert_allclose(out_a, out_b, atol=1e-6)
+    # text projection: torch (out, in) -> ours (in, out)
+    proj = convert_clip_text_projection(ckpt)
+    assert proj.shape == (48, vcfg.projection_dim)
+
+
+def test_convert_clip_vision_accepts_corrected_pre_ln_name():
+    from cassmantle_tpu.models.clip_vision import (
+        ClipVisionConfig,
+        ClipVisionEncoder,
+    )
+    from cassmantle_tpu.models.weights import convert_clip_vision
+
+    vcfg = ClipVisionConfig.tiny()
+    model = ClipVisionEncoder(vcfg)
+    img = jnp.zeros((1, vcfg.image_size, vcfg.image_size, 3))
+    reference = init_params(model, 0, img)
+    ckpt = fabricate_clip_vision(reference, vcfg.num_layers)
+    ckpt["vision_model.pre_layernorm.weight"] = ckpt.pop(
+        "vision_model.pre_layrnorm.weight")
+    ckpt["vision_model.pre_layernorm.bias"] = ckpt.pop(
+        "vision_model.pre_layrnorm.bias")
+    converted = convert_clip_vision(ckpt, vcfg.num_layers)
+    assert_same_structure(converted, reference)

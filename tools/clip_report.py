@@ -1,0 +1,168 @@
+"""CLIP-similarity report across serving presets (the quality gate).
+
+BASELINE.md's gate is "CLIP-similarity parity": the fast presets
+(DPM-Solver++(2M) @ 25 steps, deepcache) only count as wins if their
+images score on par with the fixed DDIM-50 config under CLIP. This tool
+generates the same prompts with each preset, scores every image against
+its prompt with the local CLIP harness (eval/clip_parity.py — both
+towers + projections load from clip_text.safetensors), and writes one
+JSON report with per-preset means and ratios vs the ddim50 anchor.
+
+The reference never measures image quality — it trusts a hosted SDXL
+endpoint's output (/root/reference/src/backend.py:270-295); this harness
+is that trust made falsifiable. ``real_weights`` is false when any CLIP
+stage fell back to random init: such a run validates plumbing only and
+must not be quoted as a quality number.
+
+Usage:
+    python tools/clip_report.py [--weights weights] [--out CLIP_REPORT.json]
+        [--platform cpu] [--presets ddim50,dpmpp25,deepcache] [--tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROMPTS = [
+    "A watercolor style piece depicting: a lighthouse over a stormy sea",
+    "An art deco style piece depicting: a caravan crossing silver dunes",
+    "A stained glass style piece depicting: an orchard under two moons",
+    "A vaporwave style piece depicting: a night train between cities",
+    "An ukiyo-e style piece depicting: cranes over a frozen river",
+    "A chalk pastel style piece depicting: a market street in the rain",
+    "A linocut style piece depicting: a fox asleep in a bell tower",
+    "A gouache style piece depicting: terraced fields at first light",
+]
+
+
+def preset_factories(tiny: bool):
+    if tiny:
+        import dataclasses
+
+        from cassmantle_tpu.config import test_config
+
+        def tiny_kind(kind, **kw):
+            def make():
+                cfg = test_config()
+                return cfg.replace(sampler=dataclasses.replace(
+                    cfg.sampler, kind=kind, **kw))
+            return make
+
+        return {
+            "ddim50": tiny_kind("ddim", num_steps=4),
+            "dpmpp25": tiny_kind("dpmpp_2m", num_steps=2),
+            "deepcache": tiny_kind("ddim", num_steps=4, deepcache=True),
+        }
+    from cassmantle_tpu.config import (
+        FrameworkConfig,
+        deepcache_serving_config,
+        fast_serving_config,
+    )
+
+    return {
+        "ddim50": FrameworkConfig,
+        "dpmpp25": fast_serving_config,
+        "deepcache": deepcache_serving_config,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--weights", default="weights")
+    ap.add_argument("--out", default="CLIP_REPORT.json")
+    ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
+    ap.add_argument("--presets", default="ddim50,dpmpp25,deepcache")
+    ap.add_argument("--seeds", type=int, default=2,
+                    help="image batches per preset (n = seeds * 8 prompts)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny configs (plumbing smoke, not a measurement)")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
+
+    from cassmantle_tpu.eval.clip_parity import ClipSimilarityHarness
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    # --tiny is a plumbing smoke: tiny-config models must never try to
+    # ingest a real full-size checkpoint (layer-prefix conversion would
+    # "succeed" then fail at apply with shape errors)
+    weights_dir = (None if args.tiny
+                   else args.weights if os.path.isdir(args.weights)
+                   else None)
+    if args.tiny:
+        from cassmantle_tpu.config import ClipTextConfig
+        from cassmantle_tpu.models.clip_vision import ClipVisionConfig
+
+        harness = ClipSimilarityHarness(
+            text_cfg=ClipTextConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, max_positions=16),
+            vision_cfg=ClipVisionConfig.tiny(),
+            weights_dir=None, pad_len=16)
+    else:
+        harness = ClipSimilarityHarness(weights_dir=weights_dir)
+
+    factories = preset_factories(args.tiny)
+    wanted = [p.strip() for p in args.presets.split(",") if p.strip()]
+    unknown = sorted(set(wanted) - set(factories))
+    if unknown:
+        sys.exit(f"unknown presets: {unknown}; have {sorted(factories)}")
+
+    import numpy as np
+
+    report: dict = {
+        "real_weights": harness.loaded_real_weights,
+        "prompts": len(PROMPTS), "seeds": args.seeds,
+        "presets": {},
+    }
+    first_pipe = None
+    for name in wanted:
+        # presets share one set of loaded param trees (they differ only
+        # in sampler config) — checkpoints are read and converted once
+        pipe = Text2ImagePipeline(factories[name](),
+                                  weights_dir=weights_dir,
+                                  share_params_with=first_pipe)
+        first_pipe = first_pipe or pipe
+        sims = []
+        for seed in range(args.seeds):
+            images = pipe.generate(PROMPTS, seed=seed)
+            sims.extend(harness.similarity(images, PROMPTS).tolist())
+        entry = {
+            "clip_sim_mean": float(np.mean(sims)),
+            "clip_sim_std": float(np.std(sims)),
+            "n": len(sims),
+            "pipeline_real_weights": pipe.loaded_real_weights,
+        }
+        # the headline flag means "this whole report is a measurement":
+        # scorer AND every generator loaded from checkpoints
+        report["real_weights"] = (
+            report["real_weights"] and pipe.loaded_real_weights
+        )
+        report["presets"][name] = entry
+        print(f"[clip_report] {name}: mean={entry['clip_sim_mean']:.4f} "
+              f"std={entry['clip_sim_std']:.4f} n={entry['n']}")
+
+    anchor = report["presets"].get("ddim50")
+    if anchor:
+        for name, entry in report["presets"].items():
+            if name != "ddim50" and anchor["clip_sim_mean"]:
+                entry["parity_vs_ddim50"] = float(
+                    entry["clip_sim_mean"] / anchor["clip_sim_mean"])
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"[clip_report] wrote {args.out} "
+          f"(real_weights={report['real_weights']})")
+
+
+if __name__ == "__main__":
+    main()

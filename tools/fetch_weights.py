@@ -5,7 +5,10 @@ artifact (download_model.py:4-10). This framework's artifacts are model
 checkpoints + tokenizer vocabularies, laid out as::
 
     weights/
-      clip_text.safetensors   # CLIP ViT-L/14 text tower (SD1.5's)
+      clip_text.safetensors   # CLIP ViT-L/14 FULL model: text tower
+                              # (SD1.5's encoder) + vision tower + both
+                              # projections (eval/clip_parity.py loads
+                              # the image side from this same file)
       unet.safetensors        # SD1.5 UNet
       vae.safetensors         # SD VAE (decoder+post_quant used)
       gpt2.safetensors        # GPT-2-small
