@@ -126,6 +126,37 @@ def bench_sd15_deepcache(weights_dir: str) -> dict:
         weights_dir)
 
 
+def bench_sd15_turbo(weights_dir: str) -> dict:
+    """Composed preset: DPM-Solver++(2M) @ 24 steps WITH deep-feature
+    reuse (~3.3x fewer UNet-FLOPs/image than DDIM-50) — the workload-
+    level route to the 4 img/s/chip target (turbo_serving_config)."""
+    from cassmantle_tpu.config import turbo_serving_config
+
+    return _bench_txt2img(
+        turbo_serving_config,
+        "sd15_512px_dpmpp24_deepcache_images_per_sec_per_chip",
+        weights_dir)
+
+
+def bench_sd15_int8(weights_dir: str) -> dict:
+    """A/B arm for weights-only int8 UNet on the fixed DDIM-50 config:
+    same trajectory as `sd15`, int8 weight streaming (halved per-step
+    HBM weight reads, dequant fused in-jit — ops/quant.py). Compare
+    directly against the `sd15` entry; quality re-gated via
+    tools/clip_report.py when enabled in serving."""
+    import dataclasses as _dc
+
+    from cassmantle_tpu.config import FrameworkConfig
+
+    def cfg():
+        base = FrameworkConfig()
+        return base.replace(models=_dc.replace(base.models, unet_int8=True))
+
+    return _bench_txt2img(
+        cfg, "sd15_512px_ddim50_int8unet_images_per_sec_per_chip",
+        weights_dir)
+
+
 def bench_scorer(weights_dir: str) -> dict:
     """BASELINE ladder #1: MiniLM guess scorer, 1k pairs coalesced."""
     _setup_jax()
@@ -255,6 +286,8 @@ SUITE = {
     "sd15": bench_sd15,
     "sd15_fast": bench_sd15_fast,
     "sd15_deepcache": bench_sd15_deepcache,
+    "sd15_turbo": bench_sd15_turbo,
+    "sd15_int8": bench_sd15_int8,
     "sdxl": bench_sdxl,
     "e2e": bench_e2e_round,
 }

@@ -167,6 +167,13 @@ class ModelZooConfig:
     # Mistral-7B-class prompt model (the reference's LLM family) fit and
     # decode fast on a single 16 GB chip. Embeddings/norms stay bf16.
     lm_int8: bool = False
+    # Weights-only int8 for the diffusion UNet's large matmul/conv
+    # kernels: halves denoise-loop weight streaming (the per-step HBM
+    # read of ~1.7 GB bf16 UNet params). Dequantization happens inside
+    # the jit (per-output-channel scales, ops/quant.py) so the MXU still
+    # sees bf16 tiles. Quality must be re-gated via tools/clip_report.py
+    # when enabled.
+    unet_int8: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,6 +291,20 @@ def fast_serving_config() -> FrameworkConfig:
 
     return FrameworkConfig(
         sampler=SamplerConfig(kind="dpmpp_2m", num_steps=25)
+    )
+
+
+def turbo_serving_config() -> FrameworkConfig:
+    """The two workload-level speedups COMPOSED: DPM-Solver++(2M) at 24
+    steps (half of DDIM-50) with deep-feature reuse on alternate steps
+    (~60% UNet compute). Relative to the DDIM-50 north star this is
+    ~3.3x fewer UNet-FLOPs per image — the route past BASELINE.md's
+    ~2.5 img/s/chip bf16 ceiling toward the 4 img/s target. Quality is
+    gated by tools/clip_report.py's parity_vs_ddim50, like every other
+    preset. Even step count keeps the (full, shallow) pairing uniform."""
+
+    return FrameworkConfig(
+        sampler=SamplerConfig(kind="dpmpp_2m", num_steps=24, deepcache=True)
     )
 
 

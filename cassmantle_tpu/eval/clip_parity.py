@@ -30,8 +30,9 @@ from cassmantle_tpu.models.weights import (
     convert_clip_text,
     convert_clip_text_projection,
     convert_clip_vision,
+    convert_tensors,
     init_params,
-    maybe_load,
+    load_checkpoint_tensors,
 )
 from cassmantle_tpu.utils.tokenizers import load_tokenizer
 
@@ -51,10 +52,15 @@ class ClipSimilarityHarness:
             weights_dir, "clip", self.text_cfg.vocab_size
         )
 
+        # ONE read of the full CLIPModel checkpoint feeds all three
+        # stages (text tower, vision tower, text projection)
+        tensors = load_checkpoint_tensors(
+            weights_dir, "clip_text.safetensors", "clip_full")
+
         self.text = ClipTextEncoder(self.text_cfg)
         ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
-        loaded_text = maybe_load(
-            weights_dir, "clip_text.safetensors",
+        loaded_text = convert_tensors(
+            tensors,
             lambda t: convert_clip_text(t, self.text_cfg.num_layers),
             "clip_text")
         self.text_params = (
@@ -70,8 +76,8 @@ class ClipSimilarityHarness:
         img = jnp.zeros(
             (1, self.vision_cfg.image_size, self.vision_cfg.image_size, 3)
         )
-        loaded_vision = maybe_load(
-            weights_dir, "clip_text.safetensors",
+        loaded_vision = convert_tensors(
+            tensors,
             lambda t: convert_clip_vision(t, self.vision_cfg.num_layers),
             "clip_vision")
         self.vision_params = (
@@ -80,9 +86,8 @@ class ClipSimilarityHarness:
         )
 
         # text projection into the shared space
-        proj = maybe_load(weights_dir, "clip_text.safetensors",
-                          convert_clip_text_projection,
-                          "clip_text_projection")
+        proj = convert_tensors(tensors, convert_clip_text_projection,
+                               "clip_text_projection")
         # a real parity number needs EVERY stage loaded, not just some —
         # a partial load (e.g. vision conversion KeyError falling back to
         # random init) must not masquerade as a quality measurement

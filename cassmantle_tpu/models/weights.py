@@ -62,11 +62,19 @@ def set_in_tree(tree: dict, path: str, value: np.ndarray) -> None:
 
 
 class Converter:
-    """Accumulates {flax_path: array} then materializes a param tree."""
+    """Accumulates {flax_path: array} then materializes a param tree.
 
-    def __init__(self, tensors: Tensors, model_name: str) -> None:
+    ``ignore_prefixes``: source keys under these prefixes are expected
+    to go unused (e.g. the OTHER tower of a full CLIPModel checkpoint)
+    and are excluded from the unused-tensors warning, which otherwise
+    would fire spuriously on every real-weights boot and drown genuine
+    missing-tensor signals."""
+
+    def __init__(self, tensors: Tensors, model_name: str,
+                 ignore_prefixes: tuple = ()) -> None:
         self.src = tensors
         self.model_name = model_name
+        self.ignore_prefixes = ignore_prefixes
         self.out: Dict[str, np.ndarray] = {}
         self.used = set()
 
@@ -111,7 +119,10 @@ class Converter:
         self.put(f"{dst}/embedding", self.take(f"{src}.weight"))
 
     def tree(self) -> dict:
-        unused = set(self.src) - self.used
+        unused = {
+            k for k in set(self.src) - self.used
+            if not any(k.startswith(p) for p in self.ignore_prefixes)
+        }
         if unused:
             log.warning("%s: %d source tensors unused (e.g. %s)",
                         self.model_name, len(unused),
@@ -126,8 +137,15 @@ class Converter:
 # CLIP text encoder (transformers naming, prefix "text_model.")
 # ---------------------------------------------------------------------------
 
+# A full CLIPModel checkpoint carries both towers + projections; each
+# single-tower converter expects the other side's tensors to go unused.
+_CLIP_FULL_EXTRAS = ("logit_scale",)
+
+
 def convert_clip_text(tensors: Tensors, num_layers: int) -> dict:
-    c = Converter(tensors, "clip_text")
+    c = Converter(tensors, "clip_text", ignore_prefixes=(
+        "vision_model.", "visual_projection.", "text_projection.",
+    ) + _CLIP_FULL_EXTRAS)
     p = "text_model."
     c.embed(f"{p}embeddings.token_embedding", "token_embedding")
     c.put("position_embedding",
@@ -155,7 +173,9 @@ def convert_clip_vision(tensors: Tensors, num_layers: int) -> dict:
     loads both towers from one file. Mirrors the reference's image-side
     quality check role (/root/reference/src/backend.py:270-295 trusts a
     hosted SDXL endpoint; we score images against prompts locally)."""
-    c = Converter(tensors, "clip_vision")
+    c = Converter(tensors, "clip_vision", ignore_prefixes=(
+        "text_model.", "text_projection.",
+    ) + _CLIP_FULL_EXTRAS)
     p = "vision_model."
     c.put("class_embedding", c.take(f"{p}embeddings.class_embedding"))
     c.put("position_embedding",
@@ -499,6 +519,72 @@ def init_params_cached(model, rng_seed: int, *sample_args,
     return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
+def load_checkpoint_tensors(
+    weights_dir: Optional[str], filename: str, model_name: str = "weights",
+) -> Optional[Tensors]:
+    """Read a checkpoint's flat tensor dict, or None (-> random init).
+
+    Handles missing files, sharded checkpoints (``<stem>-*.safetensors``
+    merged into one dict), and unreadable/truncated files (logged, not
+    raised). Callers converting SEVERAL models from one file (the full
+    CLIP checkpoint feeds the text tower, vision tower, and projection)
+    read once here and run each converter via :func:`convert_tensors`."""
+    if not weights_dir:
+        return None
+    path = os.path.join(weights_dir, filename)
+    if os.path.exists(path):
+        log.info("%s: loading %s", model_name, path)
+        try:
+            return load_safetensors(path)
+        except Exception:
+            # truncated/corrupt download: degrade to the documented
+            # random-init fallback instead of crashing the server boot
+            log.exception("%s: checkpoint at %s is unreadable; "
+                          "falling back to random init", model_name, path)
+            return None
+    # sharded checkpoints: <stem>-*.safetensors merge into one dict
+    import glob
+
+    stem = filename.rsplit(".", 1)[0]
+    shards = sorted(
+        glob.glob(os.path.join(weights_dir, f"{stem}-*.safetensors"))
+    )
+    if not shards:
+        log.info("%s: no checkpoint at %s; using random init",
+                 model_name, path)
+        return None
+    log.info("%s: loading %d shards for %s", model_name, len(shards), stem)
+    tensors: Tensors = {}
+    for shard in shards:
+        tensors.update(load_safetensors(shard))
+    return tensors
+
+
+def convert_tensors(
+    tensors: Optional[Tensors], converter, model_name: str,
+    cast_to: Optional[str] = None,
+    transform=None,
+) -> Optional[dict]:
+    """Run a converter over an already-read tensor dict; None on an
+    incomplete checkpoint (-> random init), mirroring maybe_load."""
+    if tensors is None:
+        return None
+    try:
+        params = converter(tensors)
+    except KeyError as exc:
+        # incomplete checkpoint (e.g. interrupted shard download): degrade
+        # to the documented random-init fallback instead of crashing the
+        # server deep inside conversion
+        log.error("%s: checkpoint is missing tensors (%s); "
+                  "falling back to random init", model_name, exc)
+        return None
+    if cast_to:
+        params = cast_params(params, cast_to)
+    if transform is not None:
+        params = transform(params)
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
 def maybe_load(
     weights_dir: Optional[str], filename: str, converter, model_name: str,
     cast_to: Optional[str] = None,
@@ -510,50 +596,9 @@ def maybe_load(
     init_params_cached). ``transform``: host-side tree transform (e.g.
     ops.quant.quantize_tree_host) applied BEFORE device placement, so
     only the transformed tree ever occupies HBM."""
-    if not weights_dir:
-        return None
-    path = os.path.join(weights_dir, filename)
-    if os.path.exists(path):
-        log.info("%s: loading %s", model_name, path)
-        try:
-            tensors = load_safetensors(path)
-        except Exception:
-            # truncated/corrupt download: degrade to the documented
-            # random-init fallback instead of crashing the server boot
-            log.exception("%s: checkpoint at %s is unreadable; "
-                          "falling back to random init", model_name, path)
-            return None
-    else:
-        # sharded checkpoints: <stem>-*.safetensors merge into one dict
-        import glob
-
-        stem = filename.rsplit(".", 1)[0]
-        shards = sorted(
-            glob.glob(os.path.join(weights_dir, f"{stem}-*.safetensors"))
-        )
-        if not shards:
-            log.info("%s: no checkpoint at %s; using random init",
-                     model_name, path)
-            return None
-        log.info("%s: loading %d shards for %s", model_name, len(shards),
-                 stem)
-        tensors = {}
-        for shard in shards:
-            tensors.update(load_safetensors(shard))
-    try:
-        params = converter(tensors)
-    except KeyError as exc:
-        # incomplete checkpoint (e.g. interrupted shard download): degrade
-        # to the documented random-init fallback instead of crashing the
-        # server deep inside conversion
-        log.error("%s: checkpoint at %s is missing tensors (%s); "
-                  "falling back to random init", model_name, path, exc)
-        return None
-    if cast_to:
-        params = cast_params(params, cast_to)
-    if transform is not None:
-        params = transform(params)
-    return jax.tree_util.tree_map(jnp.asarray, params)
+    tensors = load_checkpoint_tensors(weights_dir, filename, model_name)
+    return convert_tensors(tensors, converter, model_name,
+                           cast_to=cast_to, transform=transform)
 
 
 def cast_params(params, dtype) -> dict:

@@ -75,16 +75,25 @@ def dp_sharded_sampler(sample_impl, mesh):
 
 
 def deepcache_schedule(sampler_cfg):
-    """Validate a deepcache sampler config and build its DDIM schedule
-    (shared by the SD1.5 and SDXL pipelines, like dp_sharded_sampler)."""
-    from cassmantle_tpu.ops.ddim import DDIMSchedule
+    """Validate a deepcache sampler config and build the matching
+    schedule (shared by the SD1.5 and SDXL pipelines, like
+    dp_sharded_sampler). Composes with ddim (even steps only) and
+    dpmpp_2m (any step count; an odd final step runs unpaired-full)."""
+    assert sampler_cfg.eta == 0.0, \
+        "deepcache needs eta=0 (the paired loop is deterministic)"
+    if sampler_cfg.kind == "ddim":
+        from cassmantle_tpu.ops.ddim import DDIMSchedule
 
-    assert sampler_cfg.kind == "ddim" and \
-        sampler_cfg.num_steps % 2 == 0 and \
-        sampler_cfg.eta == 0.0, \
-        "deepcache needs ddim, an even step count, and eta=0 " \
-        "(the paired loop is deterministic)"
-    return DDIMSchedule.create(sampler_cfg.num_steps)
+        assert sampler_cfg.num_steps % 2 == 0, \
+            "ddim deepcache pairing needs an even step count"
+        return DDIMSchedule.create(sampler_cfg.num_steps)
+    if sampler_cfg.kind == "dpmpp_2m":
+        from cassmantle_tpu.ops.samplers import DPMppSchedule
+
+        return DPMppSchedule.create(sampler_cfg.num_steps)
+    raise AssertionError(
+        f"deepcache composes with ddim or dpmpp_2m, not "
+        f"{sampler_cfg.kind!r}")
 
 
 def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
@@ -104,6 +113,13 @@ def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
             addition_embeds=addition_embeds,
             uncond_addition_embeds=uncond_addition_embeds,
         )
+        if sampler_cfg.kind == "dpmpp_2m":
+            from cassmantle_tpu.ops.samplers import (
+                dpmpp_2m_sample_deepcache,
+            )
+
+            return dpmpp_2m_sample_deepcache(
+                dn_full, dn_shallow, lat, dc_schedule)
         return ddim_sample_deepcache(dn_full, dn_shallow, lat, dc_schedule)
     denoise = make_cfg_denoiser(
         unet_apply, params, ctx, uncond_ctx, sampler_cfg.guidance_scale,
@@ -194,16 +210,23 @@ class Text2ImagePipeline:
             t0 = jnp.zeros((1,), dtype=jnp.int32)
             ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
                             dtype=jnp.float32)
+            unet_transform = None
+            if m.unet_int8:
+                from cassmantle_tpu.ops.quant import quantize_tree_host
+
+                # quantize on host BEFORE device placement: HBM only ever
+                # holds the int8 tree (same rule as the LM int8 path)
+                unet_transform = quantize_tree_host
             loaded_unet = maybe_load(
                 weights_dir, "unet.safetensors",
                 lambda t: convert_unet(t, m.unet), "unet",
-                cast_to=m.param_dtype)
+                cast_to=m.param_dtype, transform=unet_transform)
             self.unet_params = (
                 loaded_unet if loaded_unet is not None
                 else init_params_cached(
                     self.unet, 2, lat, t0, ctx,
                     cache_path=param_cache_path("unet", m.unet),
-                    cast_to=m.param_dtype)
+                    cast_to=m.param_dtype, transform=unet_transform)
             )
             loaded_vae = maybe_load(
                 weights_dir, "vae.safetensors",
@@ -223,6 +246,13 @@ class Text2ImagePipeline:
                 and loaded_unet is not None
                 and loaded_vae is not None
             )
+        if m.unet_int8:
+            from cassmantle_tpu.ops.quant import quantized_apply
+
+            self.unet_apply = quantized_apply(
+                self.unet.apply, jnp.dtype(m.param_dtype))
+        else:
+            self.unet_apply = self.unet.apply
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
         self.sample_latents = make_sampler(
@@ -245,7 +275,7 @@ class Text2ImagePipeline:
         with annotate("denoise_scan"):
             final = run_cfg_denoise(
                 self.cfg.sampler, self.sample_latents, self._dc_schedule,
-                self.unet.apply, params["unet"], ctx, uncond, lat,
+                self.unet_apply, params["unet"], ctx, uncond, lat,
             )
         with annotate("vae_decode"):
             decoded = self.vae.apply(params["vae"], final)
@@ -303,7 +333,7 @@ class Text2ImagePipeline:
         ctx = self.clip.apply(params["clip"], ids)["hidden"]
         uncond = self.clip.apply(params["clip"], uncond_ids)["hidden"]
         denoise = make_cfg_denoiser(
-            self.unet.apply, params["unet"], ctx, uncond,
+            self.unet_apply, params["unet"], ctx, uncond,
             self.cfg.sampler.guidance_scale,
         )
         rng_enc, rng_noise = jax.random.split(rng)

@@ -122,14 +122,19 @@ class SDXLPipeline:
         ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
                         dtype=jnp.float32)
         add = jnp.zeros((1, m.unet.addition_embed_dim), dtype=jnp.float32)
+        unet_transform = None
+        if m.unet_int8:
+            from cassmantle_tpu.ops.quant import quantize_tree_host
+
+            unet_transform = quantize_tree_host
         self.unet_params = (
             maybe_load(weights_dir, "unet_xl.safetensors",
                        lambda t: convert_unet(t, m.unet), "unet_xl",
-                       cast_to=m.param_dtype)
+                       cast_to=m.param_dtype, transform=unet_transform)
             or init_params_cached(
                 self.unet, 2, lat, t0, ctx, add,
                 cache_path=param_cache_path("unet_xl", m.unet),
-                cast_to=m.param_dtype)
+                cast_to=m.param_dtype, transform=unet_transform)
         )
         self.vae_params = (
             maybe_load(weights_dir, "vae_xl.safetensors",
@@ -143,6 +148,13 @@ class SDXLPipeline:
 
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
+        if m.unet_int8:
+            from cassmantle_tpu.ops.quant import quantized_apply
+
+            self.unet_apply = quantized_apply(
+                self.unet.apply, jnp.dtype(m.param_dtype))
+        else:
+            self.unet_apply = self.unet.apply
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -194,7 +206,7 @@ class SDXLPipeline:
 
             final = run_cfg_denoise(
                 self.cfg.sampler, self.sample_latents, self._dc_schedule,
-                self.unet.apply, params["unet"], ctx, uncond_ctx, lat,
+                self.unet_apply, params["unet"], ctx, uncond_ctx, lat,
                 addition_embeds=add, uncond_addition_embeds=uncond_add,
             )
         with annotate("sdxl_vae_decode"):

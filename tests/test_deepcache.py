@@ -143,3 +143,44 @@ def test_img2img_rejects_deepcache():
                      np.uint8),
             ["x"],
         )
+
+
+def test_dpmpp_paired_loop_matches_plain_when_cache_ignored():
+    """dpmpp_2m + deepcache pairing is EXACTLY dpmpp_2m when the shallow
+    denoiser ignores its cache — for even AND odd step counts (odd runs
+    its final step as an unpaired full pass)."""
+    from cassmantle_tpu.ops.samplers import (
+        DPMppSchedule,
+        dpmpp_2m_sample,
+        dpmpp_2m_sample_deepcache,
+    )
+
+    lat = jax.random.normal(jax.random.PRNGKey(3), (1, 8, 8, 4))
+
+    def denoise(x, t):
+        return 0.1 * x + 0.01 * t.astype(jnp.float32)
+
+    for steps in (8, 5):
+        schedule = DPMppSchedule.create(steps)
+        ref = dpmpp_2m_sample(denoise, lat, schedule)
+        out = dpmpp_2m_sample_deepcache(
+            lambda x, t: (denoise(x, t), None),
+            lambda x, t, deep: denoise(x, t),
+            lat, schedule,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6, rtol=1e-6, err_msg=f"{steps=}")
+
+
+def test_pipeline_with_dpmpp_deepcache_config():
+    """The composed turbo path (dpmpp_2m + deepcache) runs end to end,
+    including an odd step count."""
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    for steps in (4, 5):
+        cfg = _tiny_config()
+        cfg = cfg.replace(sampler=dataclasses.replace(
+            cfg.sampler, kind="dpmpp_2m", deepcache=True, num_steps=steps))
+        pipe = Text2ImagePipeline(cfg)
+        imgs = pipe.generate(["a copper kite over cliffs"], seed=3)
+        assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8

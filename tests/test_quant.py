@@ -195,3 +195,38 @@ def test_prompt_generator_int8_checkpoint_boot(cfg, tmp_path, monkeypatch):
     # and the loaded generator still decodes
     toks, n = gen2.decode_ids("the storm", max_new_tokens=4)
     assert toks.shape[1] == 4
+
+
+def test_unet_int8_pipeline_generates():
+    """unet_int8 config: the pipeline quantizes UNet kernels to int8
+    QTensors (footprint shrinks), dequantizes inside the jit, and still
+    generates images — including through the deepcache turbo path and
+    img2img."""
+    import dataclasses
+
+    import numpy as np
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.ops.quant import QTensor, tree_nbytes
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    base = test_config()
+    cfg = base.replace(models=dataclasses.replace(
+        base.models, unet_int8=True))
+    pipe = Text2ImagePipeline(cfg)
+    q_leaves = [leaf for leaf in jax.tree_util.tree_leaves(
+        pipe.unet_params,
+        is_leaf=lambda x: isinstance(x, QTensor))
+        if isinstance(leaf, QTensor)]
+    assert q_leaves, "expected quantized kernels in the int8 UNet tree"
+    fp = Text2ImagePipeline(base)
+    assert tree_nbytes(pipe.unet_params) < tree_nbytes(fp.unet_params)
+    imgs = pipe.generate(["a tin lantern in fog"], seed=5)
+    assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
+
+    turbo = base.replace(
+        models=dataclasses.replace(base.models, unet_int8=True),
+        sampler=dataclasses.replace(
+            base.sampler, kind="dpmpp_2m", num_steps=4, deepcache=True))
+    imgs = Text2ImagePipeline(turbo).generate(["a paper boat"], seed=6)
+    assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8

@@ -16,7 +16,7 @@ must not be quoted as a quality number.
 
 Usage:
     python tools/clip_report.py [--weights weights] [--out CLIP_REPORT.json]
-        [--platform cpu] [--presets ddim50,dpmpp25,deepcache] [--tiny]
+        [--platform cpu] [--presets ddim50,dpmpp25,deepcache,turbo] [--tiny]
 """
 
 from __future__ import annotations
@@ -57,17 +57,20 @@ def preset_factories(tiny: bool):
             "ddim50": tiny_kind("ddim", num_steps=4),
             "dpmpp25": tiny_kind("dpmpp_2m", num_steps=2),
             "deepcache": tiny_kind("ddim", num_steps=4, deepcache=True),
+            "turbo": tiny_kind("dpmpp_2m", num_steps=4, deepcache=True),
         }
     from cassmantle_tpu.config import (
         FrameworkConfig,
         deepcache_serving_config,
         fast_serving_config,
+        turbo_serving_config,
     )
 
     return {
         "ddim50": FrameworkConfig,
         "dpmpp25": fast_serving_config,
         "deepcache": deepcache_serving_config,
+        "turbo": turbo_serving_config,
     }
 
 
@@ -76,7 +79,7 @@ def main() -> None:
     ap.add_argument("--weights", default="weights")
     ap.add_argument("--out", default="CLIP_REPORT.json")
     ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
-    ap.add_argument("--presets", default="ddim50,dpmpp25,deepcache")
+    ap.add_argument("--presets", default="ddim50,dpmpp25,deepcache,turbo")
     ap.add_argument("--seeds", type=int, default=2,
                     help="image batches per preset (n = seeds * 8 prompts)")
     ap.add_argument("--tiny", action="store_true",
