@@ -21,6 +21,7 @@ Rate limits mirror the reference: 3/s default, 2/s API routes, per IP.
 from __future__ import annotations
 
 import asyncio
+import functools
 import os
 import re
 import tempfile
@@ -262,18 +263,34 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
     return web.json_response({"trace_dir": log_dir, "seconds": seconds})
 
 
+@functools.lru_cache(maxsize=1)
+def _wordlist_payload() -> bytes:
+    """The ~38k-word response serialized ONCE: the lexicon is immutable
+    at runtime and /wordlist is hit per page load — re-serializing
+    ~0.4 MB of JSON on the event loop per request would stall the 1 Hz
+    WS clock pushes."""
+    import json
+
+    from cassmantle_tpu.engine.masking import STOPWORDS
+    from cassmantle_tpu.server.assets import load_wordlist
+
+    return json.dumps({
+        "words": list(load_wordlist()),
+        "stopwords": sorted(STOPWORDS),
+        "min_len": 2,
+    }).encode()
+
+
 async def handle_wordlist(request: web.Request) -> web.Response:
     """Dictionary + stopwords for client-side spellcheck (replaces the
     reference's vendored hunspell dictionary + typo.js, §2 F3; the client
     runs static/spell.js check/suggest over these words)."""
-    from cassmantle_tpu.engine.masking import STOPWORDS
-    from cassmantle_tpu.server.assets import load_wordlist
-
-    return web.json_response({
-        "words": load_wordlist(),
-        "stopwords": sorted(STOPWORDS),
-        "min_len": 2,
-    })
+    return web.Response(
+        body=_wordlist_payload(),
+        content_type="application/json",
+        # immutable per process; let the browser keep it for a day
+        headers={"Cache-Control": "public, max-age=86400"},
+    )
 
 
 def create_app(game: Game, cfg: FrameworkConfig,

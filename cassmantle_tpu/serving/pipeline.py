@@ -74,6 +74,20 @@ def dp_sharded_sampler(sample_impl, mesh):
     return fn, int(mesh.shape["dp"])
 
 
+def int8_unet_tools(models_cfg):
+    """(loader transform, apply wrapper) for the weights-only int8 UNet
+    option — the one place the int8 serving contract lives (shared by
+    the SD1.5 and SDXL pipelines, like deepcache_schedule): quantize
+    host-side before device placement, dequantize inside the jit."""
+    if not models_cfg.unet_int8:
+        return None, lambda apply: apply
+    from cassmantle_tpu.ops.quant import quantize_tree_host, quantized_apply
+
+    return (quantize_tree_host,
+            lambda apply: quantized_apply(
+                apply, jnp.dtype(models_cfg.param_dtype)))
+
+
 def deepcache_schedule(sampler_cfg):
     """Validate a deepcache sampler config and build the matching
     schedule (shared by the SD1.5 and SDXL pipelines, like
@@ -186,6 +200,7 @@ class Text2ImagePipeline:
                            m.clip_text.max_positions)
         # pixels per latent: one 2x upsample per VAE level transition
         self.vae_scale = 2 ** (len(m.vae.channel_mults) - 1)
+        unet_transform, wrap_unet_apply = int8_unet_tools(m)
 
         if share_params_with is not None:
             self.clip_params = share_params_with.clip_params
@@ -210,13 +225,6 @@ class Text2ImagePipeline:
             t0 = jnp.zeros((1,), dtype=jnp.int32)
             ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
                             dtype=jnp.float32)
-            unet_transform = None
-            if m.unet_int8:
-                from cassmantle_tpu.ops.quant import quantize_tree_host
-
-                # quantize on host BEFORE device placement: HBM only ever
-                # holds the int8 tree (same rule as the LM int8 path)
-                unet_transform = quantize_tree_host
             loaded_unet = maybe_load(
                 weights_dir, "unet.safetensors",
                 lambda t: convert_unet(t, m.unet), "unet",
@@ -246,13 +254,7 @@ class Text2ImagePipeline:
                 and loaded_unet is not None
                 and loaded_vae is not None
             )
-        if m.unet_int8:
-            from cassmantle_tpu.ops.quant import quantized_apply
-
-            self.unet_apply = quantized_apply(
-                self.unet.apply, jnp.dtype(m.param_dtype))
-        else:
-            self.unet_apply = self.unet.apply
+        self.unet_apply = wrap_unet_apply(self.unet.apply)
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
         self.sample_latents = make_sampler(

@@ -122,11 +122,9 @@ class SDXLPipeline:
         ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
                         dtype=jnp.float32)
         add = jnp.zeros((1, m.unet.addition_embed_dim), dtype=jnp.float32)
-        unet_transform = None
-        if m.unet_int8:
-            from cassmantle_tpu.ops.quant import quantize_tree_host
+        from cassmantle_tpu.serving.pipeline import int8_unet_tools
 
-            unet_transform = quantize_tree_host
+        unet_transform, wrap_unet_apply = int8_unet_tools(m)
         self.unet_params = (
             maybe_load(weights_dir, "unet_xl.safetensors",
                        lambda t: convert_unet(t, m.unet), "unet_xl",
@@ -148,13 +146,7 @@ class SDXLPipeline:
 
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
-        if m.unet_int8:
-            from cassmantle_tpu.ops.quant import quantized_apply
-
-            self.unet_apply = quantized_apply(
-                self.unet.apply, jnp.dtype(m.param_dtype))
-        else:
-            self.unet_apply = self.unet.apply
+        self.unet_apply = wrap_unet_apply(self.unet.apply)
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )

@@ -1,0 +1,92 @@
+"""Server-side spellchecker — the reference implementation of
+``static/spell.js`` (same API surface as the reference's vendored
+typo.js: check / suggest, reference static/typo.js:622,755).
+
+KEEP IN LOCKSTEP WITH static/spell.js: same suffix rules, same
+edit-distance-1 candidate generation order (deletion, transposition,
+insertion, substitution at each position, left to right). The browser
+runs the JS against GET /wordlist; tests (tests/test_spell.py) drive
+THIS implementation against the same served wordlist, so suggest()
+quality is pinned in CI without a JS runtime. The stem rules are
+rule-based affix reduction (plural, past, progressive, agentive,
+superlative, adverb), standing in for hunspell .aff expansion at a
+fraction of the complexity.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+_WORD_RE = re.compile(r"^[a-zA-Z][a-zA-Z'-]*$")
+_DOUBLED = re.compile(r"^(.+?)([bdgklmnprt])\2(ed|ing)$")
+_ALPHABET = "abcdefghijklmnopqrstuvwxyz"
+
+
+class Spell:
+    def __init__(self, words: Iterable[str]) -> None:
+        self.words = {str(w).lower() for w in words or ()}
+
+    def _stems(self, word: str) -> List[str]:
+        w = word.lower()
+        out = [w]
+
+        def add(s: str) -> None:
+            if len(s) >= 2:
+                out.append(s)
+
+        if w.endswith("ies"):
+            add(w[:-3] + "y")
+        if w.endswith("es"):
+            add(w[:-2])
+        if w.endswith("s"):
+            add(w[:-1])
+        if w.endswith("ed"):
+            add(w[:-2])
+            add(w[:-1])
+        if w.endswith("ing"):
+            add(w[:-3])
+            add(w[:-3] + "e")
+        if w.endswith("ly"):
+            add(w[:-2])
+        if w.endswith("er"):
+            add(w[:-2])
+            add(w[:-1])
+        if w.endswith("est"):
+            add(w[:-3])
+            add(w[:-2])
+        m = _DOUBLED.match(w)
+        if m:  # doubled final consonant before -ed/-ing (stopped -> stop)
+            add(m.group(1) + m.group(2))
+        return out
+
+    def check(self, word: str) -> bool:
+        # fullmatch: Python's '$' would accept a trailing newline that
+        # the JS mirror's /^...$/ (no multiline) rejects
+        if not word or not _WORD_RE.fullmatch(word):
+            return False
+        return any(s in self.words for s in self._stems(word))
+
+    def suggest(self, word: str, limit: int = 5) -> List[str]:
+        w = str(word).lower()
+        seen = set()
+        out: List[str] = []
+
+        def consider(cand: str) -> None:
+            if cand not in seen and cand != w and self.check(cand):
+                seen.add(cand)
+                out.append(cand)
+
+        for i in range(len(w) + 1):
+            head, tail = w[:i], w[i:]
+            if tail:
+                consider(head + tail[1:])                      # deletion
+            if len(tail) > 1:                                  # transposition
+                consider(head + tail[1] + tail[0] + tail[2:])
+            for c in _ALPHABET:
+                consider(head + c + tail)                      # insertion
+                if tail:
+                    consider(head + c + tail[1:])              # substitution
+            if len(out) >= limit:
+                break
+        return out[:limit]

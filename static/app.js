@@ -21,6 +21,7 @@ const state = {
   stopwords: new Set(),
   spell: null,
   submitting: false,
+  confirmed: new Set(),  // words already shown the spellcheck hold
 };
 
 /* ---------------- session bootstrap ---------------- */
@@ -60,6 +61,7 @@ function connectClock() {
     $("player-count").textContent = `${data.conns}`;
     if (data.reset) {
       state.won = false;
+      state.confirmed.clear();  // new round, fresh spellcheck holds
       $("win-banner").classList.add("hidden");
       $("feedback").textContent = "";
       fetchContents();
@@ -140,34 +142,45 @@ function validGuess(word) {
   return null;
 }
 
-/* Advisory only: answers come from unrestricted LM output, so an absent
- * word must never block submission (the served list is far smaller than
- * the reference's full hunspell dictionary) — it just earns a hint. */
+/* Blocking with a confirm escape hatch. The reference hard-rejects
+ * misspelled guesses (its script.js:435-440), and at ~38k served words
+ * this lexicon is big enough to do the same — but answers come from
+ * unrestricted LM output, so a hard block could make a round
+ * unwinnable. First submission of a flagged word is held back with
+ * suggestions; submitting the SAME word again sends it anyway. */
 function spellHint(word) {
   if (!state.spell || state.spell.check(word)) return null;
   const hints = state.spell.suggest(word, 3);
   return hints.length
-    ? `unusual word — did you mean ${hints.join(", ")}?`
-    : null;
+    ? `unusual word — did you mean ${hints.join(", ")}? (submit again to send anyway)`
+    : `unusual word — submit again to send anyway`;
 }
 
 async function submitGuesses() {
   if (state.submitting || state.won) return;
   const inputs = {};
   let error = null;
-  let hint = null;
+  const flagged = [];  // [{word, hint}] for unrecognized guesses
   document.querySelectorAll("#prompt input").forEach((input) => {
     const word = input.value.trim();
     if (!word) return;
     const problem = validGuess(word);
     if (problem) { error = `"${word}": ${problem}`; return; }
-    hint = hint || spellHint(word);
+    const h = spellHint(word);
+    if (h) flagged.push({ word: word.toLowerCase(), hint: h });
     inputs[input.dataset.mask] = word;
   });
   if (error) { $("feedback").textContent = error; return; }
-  if (hint) $("feedback").textContent = hint;
   if (Object.keys(inputs).length === 0) {
     $("feedback").textContent = "type a guess first";
+    return;
+  }
+  // per-word hold: block only words not yet shown the hold this round;
+  // a word the player already saw held goes through on any later submit
+  const fresh = flagged.filter((f) => !state.confirmed.has(f.word));
+  if (fresh.length) {
+    fresh.forEach((f) => state.confirmed.add(f.word));
+    $("feedback").textContent = fresh[0].hint;
     return;
   }
 
@@ -205,11 +218,29 @@ async function submitGuesses() {
 /* ---------------- consent ---------------- */
 
 function setupConsent() {
-  if (localStorage.getItem("cassmantle-consent")) return;
-  $("consent").classList.remove("hidden");
-  $("consent-ok").addEventListener("click", () => {
-    localStorage.setItem("cassmantle-consent", "1");
-    $("consent").classList.add("hidden");
+  if (!localStorage.getItem("cassmantle-consent")) {
+    $("consent").classList.remove("hidden");
+    $("consent-ok").addEventListener("click", () => {
+      localStorage.setItem("cassmantle-consent", "1");
+      $("consent").classList.add("hidden");
+    });
+  }
+  setupPrivacyModal();
+}
+
+/* Privacy-policy modal: opened from the consent notice link; closes on
+ * the button, a backdrop click, or Escape (reference surface parity —
+ * its page ships a policy modal wired to a link). */
+function setupPrivacyModal() {
+  const modal = $("privacy-modal");
+  const open = (e) => { e.preventDefault(); modal.classList.remove("hidden"); };
+  const close = () => modal.classList.add("hidden");
+  document.querySelectorAll(".privacy-link").forEach(
+    (a) => a.addEventListener("click", open));
+  $("privacy-close").addEventListener("click", close);
+  modal.addEventListener("click", (e) => { if (e.target === modal) close(); });
+  document.addEventListener("keydown", (e) => {
+    if (e.key === "Escape" && !modal.classList.contains("hidden")) close();
   });
 }
 

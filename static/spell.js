@@ -6,6 +6,11 @@
  * lookup. Suggestions are edit-distance-1 candidates that pass check(),
  * in generation order (deletion, transposition, insertion, substitution
  * at each position, left to right).
+ *
+ * KEEP IN LOCKSTEP WITH cassmantle_tpu/utils/spell.py — the Python
+ * mirror that tests/test_spell.py drives against the served wordlist
+ * (no JS runtime in CI); the suffix-rule sets are compared across the
+ * two files by test_spell_rule_parity.
  */
 
 "use strict";

@@ -230,3 +230,11 @@ def test_unet_int8_pipeline_generates():
             base.sampler, kind="dpmpp_2m", num_steps=4, deepcache=True))
     imgs = Text2ImagePipeline(turbo).generate(["a paper boat"], seed=6)
     assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
+
+    # img2img consumes the same quantized unet_apply via its own
+    # denoiser construction — exercise that path too
+    size = cfg.sampler.image_size
+    src = np.zeros((1, size, size, 3), dtype=np.uint8)
+    out = pipe.generate_img2img(src, ["a tin lantern"], strength=0.5,
+                                seed=7)
+    assert out.shape[-1] == 3 and out.dtype == np.uint8

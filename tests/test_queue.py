@@ -81,3 +81,22 @@ async def test_latency_bounded_by_delay_window():
     elapsed = loop.time() - t0
     assert elapsed < 1.0  # window + dispatch, far under a second
     await q.stop()
+
+
+def test_soak_run_smoke():
+    """The sustained-serving soak harness (bench.py:soak_run) drives N
+    rounds of content generation under continuous guess pressure and
+    returns latency samples — smoke-tested here at tiny config on CPU;
+    the suite's `soak` entry reports p50/p99 from the same code path."""
+    import asyncio
+
+    from bench import soak_run
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.serving.service import InferenceService
+
+    svc = InferenceService(test_config())
+    elapsed, lats, errors = asyncio.new_event_loop().run_until_complete(
+        soak_run(svc, rounds=2, workers=4))
+    assert elapsed > 0
+    assert len(lats) >= 4   # pressure loops actually scored guesses
+    assert errors == 0

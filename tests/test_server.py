@@ -255,3 +255,18 @@ def test_build_game_rejects_unknown_store_address():
 
     with pytest.raises(ValueError, match="store address"):
         build_game(make_cfg(), fake=True, store_addr="redis:6379")
+
+
+@pytest.mark.asyncio
+async def test_index_ships_privacy_modal():
+    """Reference surface parity: the page carries a privacy-policy modal
+    wired to link(s) (reference index.html ships the same surface)."""
+    client, _ = await make_client(make_cfg())
+    try:
+        res = await client.get("/")
+        text = await res.text()
+        assert 'id="privacy-modal"' in text
+        assert text.count('class="privacy-link"') >= 2   # consent + footer
+        assert 'id="privacy-close"' in text
+    finally:
+        await client.close()

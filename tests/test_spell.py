@@ -1,0 +1,129 @@
+"""Spellcheck lexicon scale + suggest() quality (VERDICT round-1 item:
+the reference ships a 49,569-entry hunspell dictionary and hard-blocks
+misspelled guesses, reference static/script.js:435-440; this build
+serves a mined wordlist and must recognize legitimate guesses at
+comparable rates). Driven through the Python mirror of spell.js."""
+
+import os
+import re
+
+import pytest
+
+from cassmantle_tpu.server.assets import load_wordlist
+from cassmantle_tpu.utils.spell import Spell
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def spell():
+    return Spell(load_wordlist())
+
+
+def test_wordlist_scale():
+    """>=20k entries (reference ships ~50k; round-1's 1.5k flagged most
+    legitimate guesses as unusual)."""
+    words = load_wordlist()
+    assert len(words) >= 20_000, len(words)
+    # guard the FILE (load_wordlist dedups, so check the raw lines)
+    lines = [ln.strip() for ln in
+             open(os.path.join(REPO, "data", "wordlist.txt"))
+             if ln.strip()]
+    assert len(lines) == len(set(lines))
+
+
+COMMON = [
+    # the kind of "descriptive word" guesses the game actually sees
+    "stormy", "silver", "ancient", "quiet", "glass", "velvet", "bright",
+    "dark", "golden", "frozen", "misty", "crimson", "gentle", "hollow",
+    "amber", "silent", "distant", "burning", "shattered", "wandering",
+    "river", "mountain", "forest", "ocean", "shadow", "light", "stone",
+    "garden", "winter", "summer", "morning", "evening", "thunder",
+    # inflected forms the stemmer must reduce
+    "stories", "cities", "boxes", "stopped", "running", "quickly",
+    "darker", "darkest", "flowers", "dancing", "painted", "dreams",
+]
+
+
+def test_check_accepts_common_words(spell):
+    missing = [w for w in COMMON if not spell.check(w)]
+    # a healthy lexicon + stemmer should cover essentially all of these
+    assert not missing, f"lexicon misses: {missing}"
+
+
+def test_check_rejects_junk(spell):
+    for junk in ("qzxvk", "xkcdq", "zzzzz", "aaaaaa", "qwrtpsd", ""):
+        assert not spell.check(junk), junk
+    assert not spell.check("storm3")   # non-alpha
+    assert not spell.check("123")
+
+
+def test_suggest_anchors(spell):
+    """Classic one-edit typos surface the intended word in the top 5."""
+    for typo, want in (
+        ("stromy", "stormy"), ("silvr", "silver"), ("quietr", "quieter"),
+        ("anceint", "ancient"), ("forrest", "forest"),
+    ):
+        got = spell.suggest(typo, 5)
+        assert want in got, f"{typo}: {got}"
+
+
+def test_suggest_recovers_single_edits(spell):
+    """For a deterministic sample of real words, corrupt with one edit
+    (delete / transpose / substitute mid-word) and require the original
+    back in the top-5 suggestions for >=80% of cases."""
+    words = [w for w in load_wordlist()
+             if len(w) >= 6 and w.isalpha() and spell.check(w)]
+    sample = words[:: max(1, len(words) // 120)][:120]
+    assert len(sample) >= 80
+
+    hits = total = 0
+    for i, w in enumerate(sample):
+        mid = len(w) // 2
+        if i % 3 == 0:      # deletion
+            typo = w[:mid] + w[mid + 1:]
+        elif i % 3 == 1:    # transposition
+            typo = w[:mid] + w[mid + 1] + w[mid] + w[mid + 2:]
+        else:               # substitution
+            sub = "q" if w[mid] != "q" else "z"
+            typo = w[:mid] + sub + w[mid + 1:]
+        if typo == w or spell.check(typo):
+            continue        # edit landed on another real word: skip
+        total += 1
+        if w in spell.suggest(typo, 5):
+            hits += 1
+    assert total >= 40, total
+    assert hits / total >= 0.8, f"{hits}/{total}"
+
+
+def test_spell_rule_parity():
+    """The JS and Python spellcheckers declare the same suffix rules —
+    a cheap structural guard against the two drifting apart."""
+    js = open(os.path.join(REPO, "static", "spell.js")).read()
+    py = open(os.path.join(
+        REPO, "cassmantle_tpu", "utils", "spell.py")).read()
+    js_rules = set(re.findall(r'endsWith\("([a-z]+)"\)', js))
+    py_rules = set(re.findall(r'endswith\("([a-z]+)"\)', py))
+    assert js_rules == py_rules and js_rules
+    # the doubled-consonant rule exists on both sides
+    assert "bdgklmnprt" in js and "bdgklmnprt" in py
+
+
+def test_wordlist_endpoint_scale():
+    """GET /wordlist serves the full lexicon (the client builds its
+    checker from this response)."""
+    import asyncio
+
+    from tests.test_server import make_cfg, make_client
+
+    async def run():
+        client, _ = await make_client(make_cfg())
+        try:
+            res = await client.get("/wordlist")
+            assert res.status == 200
+            data = await res.json()
+            assert len(data["words"]) >= 20_000
+        finally:
+            await client.close()
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())

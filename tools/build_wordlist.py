@@ -1,0 +1,166 @@
+"""Build data/wordlist.txt by mining English prose already on the host.
+
+The reference vendors a 49,569-entry hunspell dictionary for client-side
+spellcheck (reference data/en_US.dic, loaded at static/script.js:4-10).
+This build generates its OWN lexicon — nothing is copied from the
+reference tree — by mining the English text that ships with the system:
+package documentation, README/LICENSE prose, and source docstrings
+(/usr/share/doc + site-packages). That corpus is gigabytes of edited
+English; document-frequency filtering keeps words that appear across
+many independent files and drops one-off identifiers.
+
+Filters (deterministic):
+- lowercase alphabetic tokens, 2-15 chars, containing a vowel, no 5+
+  consonant run, no letter tripled (kills ascii-art junk);
+- document frequency >= --min-df (default 3); 2-letter tokens only from
+  an explicit allowlist (prose initialisms dominate otherwise);
+- a curated literary seed list covers story-prose vocabulary that
+  technical corpora under-represent;
+- words seen mostly Capitalized (> 3x more often than lowercase) are
+  treated as proper nouns and dropped;
+- the existing curated game list (data/wordlist.txt) is merged in, so
+  regeneration never loses hand-picked vocabulary.
+
+Usage:  python tools/build_wordlist.py [--out data/wordlist.txt]
+            [--min-df 3] [--no-merge-existing]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import re
+import sys
+
+WORD_RE = re.compile(r"[A-Za-z]{2,15}")
+VOWELS = set("aeiouy")
+CONS_RUN = re.compile(r"[bcdfghjklmnpqrstvwxz]{5,}")
+REPEAT_RUN = re.compile(r"(.)\1\1")  # no English word triples a letter
+
+# two-letter tokens in prose are mostly initialisms; only real words pass
+TWO_LETTER = {
+    "ah", "am", "an", "as", "at", "ax", "be", "by", "do", "eh", "ex",
+    "go", "he", "hi", "id", "if", "in", "is", "it", "lo", "ma", "me",
+    "my", "no", "of", "oh", "on", "or", "ow", "ox", "pa", "pi", "re",
+    "so", "to", "up", "us", "we", "ye", "yo",
+}
+
+# Common literary/descriptive vocabulary that technical corpora
+# under-represent but story prose (the game's actual content) uses
+# constantly. Seeds the lexicon regardless of mining thresholds.
+CURATED_LITERARY = """
+amber ancient ash aurora autumn beacon blaze bloom blossom breeze brittle
+bronze burnished canyon caravan cavern charcoal cinder cliff cobalt comet
+coral crimson crystal dawn dew drift dusk ember emerald feather fern
+flicker fog frost gale gleam glimmer glisten glow golden gossamer granite
+grove halo harbor haze hearth heather hollow horizon hush indigo ivory
+jade lagoon lantern lavender lighthouse lilac lullaby marble meadow mist
+misty moonlit moss mossy murmur nebula nectar obsidian olive onyx opal
+orchard pale pearl pebble petal pine plume prairie quartz quiver raven
+reef ripple russet rust rustic saffron sapphire scarlet shatter shattered
+shimmer shiver silken silver slate smolder snowy solace sorrow spark
+sparkle spire starlit storm stormy stream summit sunset thistle thorn
+thunder tide timber topaz tranquil twilight velvet verdant violet
+wander wandering whisper wildflower willow wisp wistful zephyr
+""".split()
+
+TEXT_EXTS = (".py", ".md", ".rst", ".txt")
+SKIP_DIRS = {"__pycache__", "nvidia", "node_modules", ".git"}
+# per-file read cap: license/notice blobs repeat after this anyway, and
+# it bounds the pass over multi-MB generated files
+READ_CAP = 120_000
+
+DEFAULT_ROOTS = (
+    "/usr/share/doc",
+    "/opt/venv/lib/python3.12/site-packages",
+)
+
+
+def iter_text_files(roots):
+    for root in roots:
+        if not os.path.isdir(root):
+            continue
+        for dirpath, dirnames, names in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in SKIP_DIRS)
+            for f in sorted(names):
+                if f.endswith(TEXT_EXTS) or "." not in f:
+                    yield os.path.join(dirpath, f)
+
+
+def mine(roots, progress_every: int = 10_000):
+    """-> (lowercase document frequency, capitalized document frequency)."""
+    df: collections.Counter = collections.Counter()
+    caps: collections.Counter = collections.Counter()
+    n = 0
+    for path in iter_text_files(roots):
+        try:
+            text = open(path, "rb").read(READ_CAP).decode("utf-8", "ignore")
+        except OSError:
+            continue
+        n += 1
+        if progress_every and n % progress_every == 0:
+            print(f"[build_wordlist] ... {n} files", file=sys.stderr)
+        lower, upper = set(), set()
+        for m in WORD_RE.finditer(text):
+            w = m.group(0)
+            if w.islower():
+                lower.add(w)
+            elif w[0].isupper() and w[1:].islower():
+                upper.add(w.lower())
+        for w in lower:
+            df[w] += 1
+        for w in upper:
+            caps[w] += 1
+    print(f"[build_wordlist] scanned {n} files", file=sys.stderr)
+    return df, caps
+
+
+def select(df, caps, min_df: int):
+    out = []
+    for w, c in df.items():
+        if c < min_df:
+            continue
+        if len(w) == 2 and w not in TWO_LETTER:
+            continue
+        if not (set(w) & VOWELS):
+            continue
+        if CONS_RUN.search(w) or REPEAT_RUN.search(w):
+            continue
+        # proper nouns: predominantly Capitalized in the corpus
+        if caps.get(w, 0) > 3 * c:
+            continue
+        out.append(w)
+    out.extend(CURATED_LITERARY)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="data/wordlist.txt")
+    ap.add_argument("--min-df", type=int, default=3)
+    ap.add_argument("--roots", nargs="*", default=list(DEFAULT_ROOTS))
+    ap.add_argument("--no-merge-existing", action="store_true",
+                    help="drop the current curated list instead of merging")
+    args = ap.parse_args()
+
+    df, caps = mine(args.roots)
+    words = set(select(df, caps, args.min_df))
+    mined = len(words)
+
+    if not args.no_merge_existing and os.path.exists(args.out):
+        for line in open(args.out, encoding="utf-8"):
+            w = line.strip().lower()
+            if w and WORD_RE.fullmatch(w):
+                words.add(w)
+
+    final = sorted(words)
+    with open(args.out, "w", encoding="utf-8") as f:
+        f.write("\n".join(final) + "\n")
+    print(f"[build_wordlist] {mined} mined + curated merge -> "
+          f"{len(final)} words at {args.out}")
+
+
+if __name__ == "__main__":
+    main()
