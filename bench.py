@@ -282,8 +282,9 @@ def bench_e2e_round(weights_dir: str) -> dict:
 
 async def soak_run(svc, rounds: int, workers: int = 32):
     """N rounds of content generation while `workers` guess loops keep
-    constant pressure on the score queue; -> (elapsed_s, latencies_s).
-    Shared by bench_soak and its CPU smoke test (tests/test_queue.py)."""
+    constant pressure on the score queue; -> (elapsed_s, latencies_s,
+    error_count). Shared by bench_soak and its CPU smoke test
+    (tests/test_queue.py)."""
     import asyncio
 
     svc.score_queue.start()

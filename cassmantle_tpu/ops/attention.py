@@ -133,10 +133,21 @@ def multi_head_attention(
     if use_flash is None:
         use_flash = _on_tpu() and mask is None
     if use_flash and mask is None:
-        from cassmantle_tpu.ops.flash_attention import flash_attention_ok
+        from cassmantle_tpu.ops.flash_attention import (
+            flash_attention_ok,
+            flash_cross_ok,
+        )
 
         if flash_attention_ok(q, k):
             from cassmantle_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, scale=scale)
+        if flash_cross_ok(q, k):
+            # ragged-S_k cross-attention (UNet text context, S_k=77):
+            # K/V pad into the kernel, pad columns masked by kv_len
+            from cassmantle_tpu.ops.flash_attention import (
+                flash_cross_attention,
+            )
+
+            return flash_cross_attention(q, k, v, scale=scale)
     return xla_attention(q, k, v, mask=mask, scale=scale)

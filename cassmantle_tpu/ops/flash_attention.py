@@ -13,9 +13,12 @@ into the leading grid dimension. Scores accumulate in fp32 on the MXU
 for the P·V matmul so both matmuls hit the MXU in bf16 on TPU.
 
 Dispatch rules (``flash_attention_ok``): self-attention (no mask), sequence
-divisible into blocks, head_dim bounded — everything else (cross-attention
-with S_k=77, tiny text sequences) stays on the XLA path where fusion is
-already optimal.
+divisible into blocks, head_dim bounded. Cross-attention with ragged
+S_k (the UNet's text context, S_k=77) takes :func:`flash_cross_attention`:
+K/V pad to one 128-wide block and the kernel masks the pad columns via a
+static ``kv_len`` — the score matrix (4096×77 per head at 512² level 0,
+materialized to HBM on the XLA path) never leaves VMEM. Tiny text-model
+sequences stay on the XLA path where fusion is already optimal.
 """
 
 from __future__ import annotations
@@ -58,7 +61,8 @@ def flash_attention_ok(q: jax.Array, k: jax.Array) -> bool:
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale: float, num_k_blocks: int):
+                  scale: float, num_k_blocks: int, block_k: int,
+                  kv_len: int = 0):
     k_idx = pl.program_id(2)
 
     @pl.when(k_idx == 0)
@@ -75,6 +79,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                          # (BQ, BK) fp32
+
+    if kv_len:  # static: ragged K/V padded into the last block
+        col = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+               + k_idx * block_k)
+        s = jnp.where(col < kv_len, s, _NEG_INF)
 
     m_prev = m_ref[:, :1]             # (BQ, 1)
     l_prev = l_ref[:, :1]
@@ -97,16 +106,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=(
+    "scale", "interpret", "block_q", "block_k", "kv_len"))
 def _flash_bhsd(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
-                interpret: bool) -> jax.Array:
-    """(BH, S, D) flash attention."""
+                interpret: bool, block_q: int = BLOCK_Q,
+                block_k: int = BLOCK_K, kv_len: int = 0) -> jax.Array:
+    """(BH, S, D) flash attention. ``kv_len`` > 0 marks K/V as padded to
+    the block grid with only the first kv_len columns valid."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    nq, nk = sq // BLOCK_Q, sk // BLOCK_K
+    nq, nk = sq // block_q, sk // block_k
 
     grid = (bh, nq, nk)
-    kernel = functools.partial(_flash_kernel, scale=scale, num_k_blocks=nk)
+    kernel = functools.partial(_flash_kernel, scale=scale, num_k_blocks=nk,
+                               block_k=block_k, kv_len=kv_len)
     # Only the k-block axis carries state (online-softmax scratch); the
     # batch*heads and q-block axes are embarrassingly parallel.
     compiler_params = pltpu.CompilerParams(
@@ -117,16 +130,16 @@ def _flash_bhsd(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),   # running max
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),   # running denom
-            pltpu.VMEM((BLOCK_Q, d), jnp.float32),     # output accumulator
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
         ],
         compiler_params=compiler_params,
         cost_estimate=pl.CostEstimate(
@@ -136,6 +149,11 @@ def _flash_bhsd(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
         ),
         interpret=interpret,
     )(q, k, v)
+
+
+def _fold_heads(t, s, d):
+    t = jnp.moveaxis(t, -2, -3)                   # (..., H, S, D)
+    return t.reshape((-1, s, d))
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -149,11 +167,59 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     *batch, sq, h, d = q.shape
     sk = k.shape[-3]
 
-    def fold(t, s):
-        t = jnp.moveaxis(t, -2, -3)               # (..., H, S, D)
-        return t.reshape((-1, s, d))
-
-    qf, kf, vf = fold(q, sq), fold(k, sk), fold(v, sk)
+    qf = _fold_heads(q, sq, d)
+    kf, vf = _fold_heads(k, sk, d), _fold_heads(v, sk, d)
     out = _flash_bhsd(qf, kf, vf, float(scale), bool(interpret))
     out = out.reshape(tuple(batch) + (h, sq, d))
     return jnp.moveaxis(out, -3, -2)              # (..., S, H, D)
+
+
+# Cross-attention K/V blocks: the text context is short (77 for CLIP), so
+# one lane-width block holds it after padding; queries keep large blocks.
+CROSS_BLOCK_K = 128
+MAX_CROSS_KV = 1024
+
+
+def flash_cross_ok(q: jax.Array, k: jax.Array) -> bool:
+    """Ragged-K/V shapes worth padding into the kernel: long aligned
+    query axis (image tokens), short unaligned context. The XLA path
+    for these materializes a (S_q, S_k) score matrix per head in HBM;
+    here it stays in VMEM."""
+    sq, sk, d = q.shape[-3], k.shape[-3], q.shape[-1]
+    return (
+        sq % BLOCK_Q == 0
+        and sq >= BLOCK_Q
+        and 0 < sk <= MAX_CROSS_KV
+        and d <= MAX_HEAD_DIM
+        and q.ndim >= 4
+        # anything the plain kernel takes (sk in full BLOCK_K blocks)
+        # stays there; this path covers every remaining short-context
+        # shape, aligned-to-128 included (pad=0, kv_len exact)
+        and not flash_attention_ok(q, k)
+    )
+
+
+def flash_cross_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          scale=None, interpret=None) -> jax.Array:
+    """(..., S_q, H, D) x (..., S_k, H, D) cross-attention with ragged
+    S_k: K/V zero-pad to the block width and the kernel masks pad
+    columns via the static ``kv_len`` (exact — pad keys get -inf scores
+    before the online softmax, so they contribute nothing)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    *batch, sq, h, d = q.shape
+    sk = k.shape[-3]
+    pad = (-sk) % CROSS_BLOCK_K
+    widths = [(0, 0)] * (k.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
+    kp = jnp.pad(k, widths)
+    vp = jnp.pad(v, widths)
+
+    qf = _fold_heads(q, sq, d)
+    kf, vf = _fold_heads(kp, sk + pad, d), _fold_heads(vp, sk + pad, d)
+    out = _flash_bhsd(qf, kf, vf, float(scale), bool(interpret),
+                      block_k=CROSS_BLOCK_K, kv_len=sk)
+    out = out.reshape(tuple(batch) + (h, sq, d))
+    return jnp.moveaxis(out, -3, -2)

@@ -101,3 +101,65 @@ def test_causal_decode_alignment():
     tail = attention(q[:, -3:], k, v, causal=True, use_flash=False)
     np.testing.assert_allclose(
         np.asarray(tail), np.asarray(full[:, -3:]), atol=1e-6, rtol=1e-6)
+
+
+def test_flash_cross_ragged_kv_matches_xla():
+    """Ragged-S_k cross-attention (the UNet's text context, S_k=77):
+    K/V pad into one block and the kernel's kv_len mask makes the
+    result EXACT vs the XLA reference — pad columns contribute
+    nothing to the softmax."""
+    from cassmantle_tpu.ops.flash_attention import (
+        flash_cross_attention,
+        flash_cross_ok,
+    )
+
+    for sk in (77, 7, 130):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(5), 2, BLOCK_Q, 2, 40,
+                            seq_k=sk)
+        assert flash_cross_ok(q, k), sk
+        out = flash_cross_attention(q, k, v, interpret=True)
+        ref = xla_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+            err_msg=f"{sk=}")
+
+
+def test_flash_cross_ok_predicate():
+    from cassmantle_tpu.ops.flash_attention import (
+        CROSS_BLOCK_K,
+        flash_cross_ok,
+    )
+
+    q, k, _ = _rand_qkv(jax.random.PRNGKey(6), 1, BLOCK_Q, 2, 64,
+                        seq_k=77)
+    assert flash_cross_ok(q, k)
+    # short ALIGNED S_k (128..896) also belongs here: too small for the
+    # plain kernel's 1024-blocks, still worth keeping out of HBM
+    q2, k2, _ = _rand_qkv(jax.random.PRNGKey(6), 1, BLOCK_Q, 2, 64,
+                          seq_k=CROSS_BLOCK_K)
+    assert flash_cross_ok(q2, k2)
+    from cassmantle_tpu.ops.flash_attention import flash_cross_attention
+
+    out = flash_cross_attention(q2, k2, k2, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(xla_attention(q2, k2, k2)),
+        atol=2e-5, rtol=2e-5)
+    # full-block K/V stays with the plain kernel
+    q4, k4, _ = _rand_qkv(jax.random.PRNGKey(6), 1, BLOCK_Q, 2, 64)
+    assert not flash_cross_ok(q4, k4)
+    # short query axis -> XLA path
+    q3, k3, _ = _rand_qkv(jax.random.PRNGKey(6), 1, 64, 2, 64, seq_k=77)
+    assert not flash_cross_ok(q3, k3)
+
+
+def test_dispatcher_routes_ragged_cross_attention():
+    """multi_head_attention with use_flash=True and ragged K/V must hit
+    the cross kernel (numerics equal XLA) rather than falling back."""
+    from cassmantle_tpu.ops.attention import multi_head_attention
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, BLOCK_Q, 2, 40,
+                        seq_k=77)
+    out = multi_head_attention(q, k, v, use_flash=True)
+    ref = xla_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

@@ -95,8 +95,7 @@ def test_soak_run_smoke():
     from cassmantle_tpu.serving.service import InferenceService
 
     svc = InferenceService(test_config())
-    elapsed, lats, errors = asyncio.new_event_loop().run_until_complete(
-        soak_run(svc, rounds=2, workers=4))
+    elapsed, lats, errors = asyncio.run(soak_run(svc, rounds=2, workers=4))
     assert elapsed > 0
     assert len(lats) >= 4   # pressure loops actually scored guesses
     assert errors == 0

@@ -126,4 +126,4 @@ def test_wordlist_endpoint_scale():
         finally:
             await client.close()
 
-    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(run())
+    asyncio.run(run())

@@ -150,9 +150,13 @@ def main() -> None:
     mined = len(words)
 
     if not args.no_merge_existing and os.path.exists(args.out):
+        # looser shape than the miner's: hand-curated entries may carry
+        # apostrophes/hyphens or run long (spell.js accepts them), and
+        # regeneration must never lose hand-picked vocabulary
+        curated_re = re.compile(r"[a-z]+(?:[-'][a-z]+)*")
         for line in open(args.out, encoding="utf-8"):
             w = line.strip().lower()
-            if w and WORD_RE.fullmatch(w):
+            if w and curated_re.fullmatch(w):
                 words.add(w)
 
     final = sorted(words)
