@@ -5,11 +5,14 @@ main:app`` (reference requirements.txt:2, main.py:18) and its one tool is
 a bare script (download_model.py). A standalone framework needs a front
 door; this one wraps every runnable surface:
 
-- ``serve``           game server (presets: sd15 / sdxl / fast; --fake)
-- ``bench``           the BASELINE.md workload ladder (repo-root bench.py)
-- ``fetch-weights``   checkpoint/tokenizer bootstrap (tools/fetch_weights.py)
-- ``train-diffusion`` dp×tp×sp UNet fine-tuning loop (synthetic or .npy data)
-- ``train-lm``        LM fine-tuning loop (GPT-2 by default)
+- ``serve``            game server (presets: sd15 / sdxl / fast; --fake)
+- ``bench``            the BASELINE.md workload ladder (repo-root bench.py)
+- ``fetch-weights``    checkpoint/tokenizer bootstrap (tools/fetch_weights.py)
+- ``quantize-weights`` offline int8 LM checkpoints (tools/quantize_weights.py)
+- ``clip-report``      CLIP-sim quality gate across presets (tools/clip_report.py)
+- ``build-wordlist``   regenerate the spellcheck lexicon (tools/build_wordlist.py)
+- ``train-diffusion``  dp×tp×sp UNet fine-tuning loop (synthetic or .npy data)
+- ``train-lm``         LM fine-tuning loop (GPT-2 by default)
 - ``version``
 
 Training commands are thin loops over parallel/train.py and
@@ -55,22 +58,30 @@ def cmd_serve(argv) -> int:
 
 
 def _run_script(relpath: str, argv) -> int:
-    """Exec a repo-root script (bench.py, tools/*) in-process."""
+    """Exec a repo-root script (bench.py, tools/*) in-process.
+
+    Runs with cwd = repo root: the scripts' relative defaults (e.g.
+    build_wordlist's ``data/wordlist.txt``, bench's BENCH_SUITE.json)
+    must land where the package reads them, regardless of where the
+    module CLI was invoked from."""
     import runpy
 
-    path = os.path.join(_repo_root(), relpath)
+    root = _repo_root()
+    path = os.path.join(root, relpath)
     if not os.path.exists(path):
         print(f"{relpath} not found (not a source checkout?)",
               file=sys.stderr)
         return 2
-    saved = sys.argv
+    saved_argv, saved_cwd = sys.argv, os.getcwd()
     sys.argv = [path] + list(argv)
+    os.chdir(root)
     try:
         runpy.run_path(path, run_name="__main__")
     except SystemExit as e:
         return _exit_code(e)
     finally:
-        sys.argv = saved
+        sys.argv = saved_argv
+        os.chdir(saved_cwd)
     return 0
 
 
@@ -84,6 +95,14 @@ def cmd_fetch_weights(argv) -> int:
 
 def cmd_quantize_weights(argv) -> int:
     return _run_script(os.path.join("tools", "quantize_weights.py"), argv)
+
+
+def cmd_clip_report(argv) -> int:
+    return _run_script(os.path.join("tools", "clip_report.py"), argv)
+
+
+def cmd_build_wordlist(argv) -> int:
+    return _run_script(os.path.join("tools", "build_wordlist.py"), argv)
 
 
 def _train_parser(desc: str) -> argparse.ArgumentParser:
@@ -276,6 +295,8 @@ COMMANDS = {
     "bench": cmd_bench,
     "fetch-weights": cmd_fetch_weights,
     "quantize-weights": cmd_quantize_weights,
+    "clip-report": cmd_clip_report,
+    "build-wordlist": cmd_build_wordlist,
     "train-diffusion": cmd_train_diffusion,
     "train-lm": cmd_train_lm,
 }
