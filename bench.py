@@ -382,7 +382,10 @@ def main() -> None:
     if unknown:
         sys.exit(f"unknown flag(s): {' '.join(unknown)} (only --suite)")
     args = [a for a in args if not a.startswith("--")]
-    weights_dir = args[0] if args else "weights"
+    # defaults resolve against the repo, not the cwd (module-CLI runs
+    # from anywhere); an explicit positional path keeps shell meaning
+    repo = os.path.dirname(os.path.abspath(__file__))
+    weights_dir = args[0] if args else os.path.join(repo, "weights")
 
     probe_device()
     if not suite:
@@ -402,7 +405,7 @@ def main() -> None:
         if name == "sd15":
             north_star = res
         print(json.dumps(res), file=sys.stderr)
-    with open("BENCH_SUITE.json", "w") as f:
+    with open(os.path.join(repo, "BENCH_SUITE.json"), "w") as f:
         json.dump(results, f, indent=2)
     if north_star is None or "error" in north_star:
         # never emit a malformed north-star line with a zero exit

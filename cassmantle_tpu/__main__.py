@@ -60,28 +60,26 @@ def cmd_serve(argv) -> int:
 def _run_script(relpath: str, argv) -> int:
     """Exec a repo-root script (bench.py, tools/*) in-process.
 
-    Runs with cwd = repo root: the scripts' relative defaults (e.g.
-    build_wordlist's ``data/wordlist.txt``, bench's BENCH_SUITE.json)
-    must land where the package reads them, regardless of where the
-    module CLI was invoked from."""
+    cwd is left alone — user-supplied relative paths keep meaning what
+    they mean in the shell. The scripts themselves resolve their
+    *defaults* (data/wordlist.txt, BENCH_SUITE.json, weights/) against
+    the repo root so a module-CLI invocation from anywhere still reads
+    and writes where the package expects."""
     import runpy
 
-    root = _repo_root()
-    path = os.path.join(root, relpath)
+    path = os.path.join(_repo_root(), relpath)
     if not os.path.exists(path):
         print(f"{relpath} not found (not a source checkout?)",
               file=sys.stderr)
         return 2
-    saved_argv, saved_cwd = sys.argv, os.getcwd()
+    saved = sys.argv
     sys.argv = [path] + list(argv)
-    os.chdir(root)
     try:
         runpy.run_path(path, run_name="__main__")
     except SystemExit as e:
         return _exit_code(e)
     finally:
-        sys.argv = saved_argv
-        os.chdir(saved_cwd)
+        sys.argv = saved
     return 0
 
 

@@ -76,6 +76,11 @@ DEFAULT_ROOTS = (
     "/opt/venv/lib/python3.12/site-packages",
 )
 
+# default output resolves against the repo, not the cwd: the server
+# reads the lexicon from the package-relative data/ directory
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_OUT = os.path.join(REPO_ROOT, "data", "wordlist.txt")
+
 
 def iter_text_files(roots):
     for root in roots:
@@ -138,7 +143,7 @@ def select(df, caps, min_df: int):
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--out", default="data/wordlist.txt")
+    ap.add_argument("--out", default=DEFAULT_OUT)
     ap.add_argument("--min-df", type=int, default=3)
     ap.add_argument("--roots", nargs="*", default=list(DEFAULT_ROOTS))
     ap.add_argument("--no-merge-existing", action="store_true",

@@ -16,7 +16,8 @@ must not be quoted as a quality number.
 
 Usage:
     python tools/clip_report.py [--weights weights] [--out CLIP_REPORT.json]
-        [--platform cpu] [--presets ddim50,dpmpp25,deepcache,turbo] [--tiny]
+        [--platform cpu] [--presets ddim50,dpmpp25,deepcache,turbo,int8]
+        [--tiny]
 """
 
 from __future__ import annotations
@@ -26,7 +27,8 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
 PROMPTS = [
     "A watercolor style piece depicting: a lighthouse over a stormy sea",
@@ -38,6 +40,13 @@ PROMPTS = [
     "A linocut style piece depicting: a fox asleep in a bell tower",
     "A gouache style piece depicting: terraced fields at first light",
 ]
+
+
+def _with_unet_int8(cfg):
+    import dataclasses
+
+    return cfg.replace(
+        models=dataclasses.replace(cfg.models, unet_int8=True))
 
 
 def preset_factories(tiny: bool):
@@ -58,6 +67,7 @@ def preset_factories(tiny: bool):
             "dpmpp25": tiny_kind("dpmpp_2m", num_steps=2),
             "deepcache": tiny_kind("ddim", num_steps=4, deepcache=True),
             "turbo": tiny_kind("dpmpp_2m", num_steps=4, deepcache=True),
+            "int8": lambda: _with_unet_int8(test_config()),
         }
     from cassmantle_tpu.config import (
         FrameworkConfig,
@@ -71,15 +81,22 @@ def preset_factories(tiny: bool):
         "dpmpp25": fast_serving_config,
         "deepcache": deepcache_serving_config,
         "turbo": turbo_serving_config,
+        # quality arm of the sd15_int8 bench A/B: same DDIM-50
+        # trajectory, int8 UNet weights
+        "int8": lambda: _with_unet_int8(FrameworkConfig()),
     }
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--weights", default="weights")
+    # default resolves against the repo (module-CLI runs from anywhere);
+    # an explicit --weights keeps its shell meaning
+    ap.add_argument("--weights",
+                    default=os.path.join(REPO_ROOT, "weights"))
     ap.add_argument("--out", default="CLIP_REPORT.json")
     ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
-    ap.add_argument("--presets", default="ddim50,dpmpp25,deepcache,turbo")
+    ap.add_argument("--presets",
+                    default="ddim50,dpmpp25,deepcache,turbo,int8")
     ap.add_argument("--seeds", type=int, default=2,
                     help="image batches per preset (n = seeds * 8 prompts)")
     ap.add_argument("--tiny", action="store_true",
@@ -126,14 +143,19 @@ def main() -> None:
         "prompts": len(PROMPTS), "seeds": args.seeds,
         "presets": {},
     }
-    first_pipe = None
+    anchors = []  # one anchor pipeline per distinct models config
     for name in wanted:
-        # presets share one set of loaded param trees (they differ only
-        # in sampler config) — checkpoints are read and converted once
-        pipe = Text2ImagePipeline(factories[name](),
-                                  weights_dir=weights_dir,
-                                  share_params_with=first_pipe)
-        first_pipe = first_pipe or pipe
+        cfg = factories[name]()
+        # presets with identical model configs share one set of loaded
+        # param trees (checkpoints read and converted once); the int8
+        # arm differs (quantized tree) and anchors its own group,
+        # regardless of preset order
+        share = next((p for p in anchors if p.cfg.models == cfg.models),
+                     None)
+        pipe = Text2ImagePipeline(cfg, weights_dir=weights_dir,
+                                  share_params_with=share)
+        if share is None:
+            anchors.append(pipe)
         sims = []
         for seed in range(args.seeds):
             images = pipe.generate(PROMPTS, seed=seed)
