@@ -11,6 +11,7 @@ door; this one wraps every runnable surface:
 - ``quantize-weights`` offline int8 LM checkpoints (tools/quantize_weights.py)
 - ``clip-report``      CLIP-sim quality gate across presets (tools/clip_report.py)
 - ``build-wordlist``   regenerate the spellcheck lexicon (tools/build_wordlist.py)
+- ``lm-int8-ab``       fp-vs-int8 LM decode A/B (tools/lm_int8_ab.py)
 - ``train-diffusion``  dp×tp×sp UNet fine-tuning loop (synthetic or .npy data)
 - ``train-lm``         LM fine-tuning loop (GPT-2 by default)
 - ``version``
@@ -101,6 +102,10 @@ def cmd_clip_report(argv) -> int:
 
 def cmd_build_wordlist(argv) -> int:
     return _run_script(os.path.join("tools", "build_wordlist.py"), argv)
+
+
+def cmd_lm_int8_ab(argv) -> int:
+    return _run_script(os.path.join("tools", "lm_int8_ab.py"), argv)
 
 
 def _train_parser(desc: str) -> argparse.ArgumentParser:
@@ -295,6 +300,7 @@ COMMANDS = {
     "quantize-weights": cmd_quantize_weights,
     "clip-report": cmd_clip_report,
     "build-wordlist": cmd_build_wordlist,
+    "lm-int8-ab": cmd_lm_int8_ab,
     "train-diffusion": cmd_train_diffusion,
     "train-lm": cmd_train_lm,
 }

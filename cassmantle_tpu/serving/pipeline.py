@@ -179,9 +179,12 @@ class Text2ImagePipeline:
                  ) -> None:
         """``share_params_with``: reuse another pipeline's already-loaded
         param trees (device buffers are shared, nothing is copied) when
-        the model configs match — presets that differ only in sampler
-        (ddim50 vs dpmpp25 vs deepcache) then skip re-reading and
-        re-converting the multi-GB checkpoints per variant."""
+        the model architectures match — presets that differ only in
+        sampler (ddim50 vs dpmpp25 vs deepcache) then skip re-reading
+        and re-converting the multi-GB checkpoints per variant. A donor
+        that differs ONLY in ``unet_int8`` still shares CLIP/VAE, and an
+        int8 pipeline derives its quantized UNet from the donor's
+        in-memory fp tree instead of re-reading the checkpoint."""
         enable_compile_cache()
         m = cfg.models
         self.cfg = cfg
@@ -190,8 +193,11 @@ class Text2ImagePipeline:
         self.unet = UNet(m.unet)
         self.vae = VAEDecoder(m.vae)
         if share_params_with is not None:
-            assert share_params_with.cfg.models == m, (
-                "share_params_with needs identical model configs"
+            sm = share_params_with.cfg.models
+            assert (sm.clip_text == m.clip_text and sm.unet == m.unet
+                    and sm.vae == m.vae
+                    and sm.param_dtype == m.param_dtype), (
+                "share_params_with needs matching model architectures"
             )
         self.tokenizer = load_tokenizer(
             weights_dir, "clip", m.clip_text.vocab_size
@@ -203,10 +209,37 @@ class Text2ImagePipeline:
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
 
         if share_params_with is not None:
-            self.clip_params = share_params_with.clip_params
-            self.unet_params = share_params_with.unet_params
-            self.vae_params = share_params_with.vae_params
-            self.loaded_real_weights = share_params_with.loaded_real_weights
+            donor = share_params_with
+            self.clip_params = donor.clip_params
+            self.vae_params = donor.vae_params
+            if donor.cfg.models.unet_int8 == m.unet_int8:
+                self.unet_params = donor.unet_params
+            elif m.unet_int8:
+                # int8 arm joining an fp donor: quantize the donor's
+                # in-memory tree (host-side) — no second checkpoint read
+                from cassmantle_tpu.ops.quant import quantize_tree_host
+
+                self.unet_params = quantize_tree_host(donor.unet_params)
+            else:
+                # fp arm joining an int8 donor: dequantization is lossy,
+                # so load the fp tree properly
+                loaded_unet = maybe_load(
+                    weights_dir, "unet.safetensors",
+                    lambda t: convert_unet(t, m.unet), "unet",
+                    cast_to=m.param_dtype)
+                lat_hw = cfg.sampler.image_size // self.vae_scale
+                self.unet_params = (
+                    loaded_unet if loaded_unet is not None
+                    else init_params_cached(
+                        self.unet, 2,
+                        jnp.zeros((1, lat_hw, lat_hw, 4), jnp.float32),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.zeros((1, self.pad_len, m.unet.context_dim),
+                                  jnp.float32),
+                        cache_path=param_cache_path("unet", m.unet),
+                        cast_to=m.param_dtype)
+                )
+            self.loaded_real_weights = donor.loaded_real_weights
         else:
             ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
             loaded_clip = maybe_load(

@@ -143,15 +143,19 @@ def main() -> None:
         "prompts": len(PROMPTS), "seeds": args.seeds,
         "presets": {},
     }
-    anchors = []  # one anchor pipeline per distinct models config
+    def arch_match(a, b):
+        # the contract share_params_with asserts: same architectures
+        # and storage dtype (unet_int8 may differ — the pipeline then
+        # derives/loads its own UNet tree but still shares CLIP/VAE)
+        return (a.clip_text == b.clip_text and a.unet == b.unet
+                and a.vae == b.vae and a.param_dtype == b.param_dtype)
+
+    anchors = []  # one anchor pipeline per distinct architecture
     for name in wanted:
         cfg = factories[name]()
-        # presets with identical model configs share one set of loaded
-        # param trees (checkpoints read and converted once); the int8
-        # arm differs (quantized tree) and anchors its own group,
-        # regardless of preset order
-        share = next((p for p in anchors if p.cfg.models == cfg.models),
-                     None)
+        share = next(
+            (p for p in anchors if arch_match(p.cfg.models, cfg.models)),
+            None)
         pipe = Text2ImagePipeline(cfg, weights_dir=weights_dir,
                                   share_params_with=share)
         if share is None:

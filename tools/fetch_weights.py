@@ -77,7 +77,11 @@ SOURCES = {
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--out", default="weights")
+    # default resolves against the repo (where bench/clip-report/serve
+    # look for weights/); an explicit --out keeps its shell meaning
+    parser.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "weights"))
     args = parser.parse_args()
     os.makedirs(args.out, exist_ok=True)
 

@@ -1,0 +1,180 @@
+"""On-hardware A/B for weights-only int8 LM decode (VERDICT round-1 #6).
+
+The int8 story ("halves decode step time, fits Mistral-7B on a 16 GB
+chip with headroom") must be a measurement, not an assertion. This tool
+builds the SAME prompt-LM family twice — fp (param_dtype storage) and
+weights-only int8 (ops/quant.py) — runs identical fixed-length greedy
+decodes through the serving PromptGenerator, and reports tokens/sec,
+param-tree bytes, and device memory stats side by side as one JSON
+line. Works for GPT-2 (default) and Mistral (--family mistral; at
+Mistral-7B dims the fp arm may not fit a 16 GB chip — that OOM is
+itself the result the int8 path exists to fix, reported as such).
+
+Usage: python tools/lm_int8_ab.py [--family gpt2|mistral]
+           [--tokens 64] [--reps 3] [--weights weights]
+           [--platform cpu] [--tiny] [--out LM_INT8_AB.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+SEED_TEXT = "The lighthouse keeper counted the storms of"
+
+
+def _build_cfg(family: str, tiny: bool, int8: bool, tokens: int):
+    from cassmantle_tpu.config import (
+        FrameworkConfig,
+        MistralConfig,
+        test_config,
+    )
+
+    cfg = test_config() if tiny else FrameworkConfig()
+    models = cfg.models
+    if family == "mistral":
+        models = dataclasses.replace(
+            models,
+            mistral=MistralConfig.tiny() if tiny else MistralConfig())
+    models = dataclasses.replace(models, lm_int8=int8)
+    # fixed decode length: both arms generate exactly `tokens` tokens,
+    # so tokens/sec is comparable
+    sampler = dataclasses.replace(
+        cfg.sampler, min_new_tokens=tokens, max_new_tokens=tokens)
+    return cfg.replace(models=models, sampler=sampler)
+
+
+def _device_mem() -> dict:
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {k: stats[k] for k in
+            ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+            if k in stats}
+
+
+def _measure_arm(cfg, weights_dir, tokens: int, reps: int) -> dict:
+    import jax
+
+    from cassmantle_tpu.ops.quant import QTensor, tree_nbytes
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    gen = PromptGenerator(cfg, weights_dir=weights_dir)
+    gen.generate(SEED_TEXT, max_new_tokens=tokens)   # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        text = gen.generate(SEED_TEXT, max_new_tokens=tokens)
+    dt = (time.perf_counter() - t0) / reps
+    jax.effects_barrier()
+    n_q = sum(1 for leaf in jax.tree_util.tree_leaves(
+        gen.params, is_leaf=lambda x: isinstance(x, QTensor))
+        if isinstance(leaf, QTensor))
+    return {
+        "tokens_per_sec": round(tokens / dt, 1),
+        "decode_s": round(dt, 4),
+        "param_bytes": tree_nbytes(gen.params),
+        # 0 in the int8 arm means nothing met the size predicate (tiny
+        # smoke dims) — the A/B is then a no-op, not a measurement
+        "quantized_leaves": n_q,
+        "memory": _device_mem(),
+        "sample_chars": len(text),
+    }
+
+
+_DEFAULT_WEIGHTS = os.path.join(REPO_ROOT, "weights")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--family", default="gpt2",
+                    choices=["gpt2", "mistral"])
+    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--weights", default=_DEFAULT_WEIGHTS)
+    ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny dims (plumbing smoke, not a measurement)")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON to this path")
+    ap.add_argument("--arm", default=None, choices=["fp", "int8"],
+                    help=argparse.SUPPRESS)  # internal: one-arm child
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
+
+    if os.path.isdir(args.weights):
+        weights_dir = args.weights
+    elif args.weights != _DEFAULT_WEIGHTS:
+        # an explicitly named directory that doesn't exist must not be
+        # silently demoted to a random-init run
+        sys.exit(f"--weights {args.weights!r} is not a directory")
+    else:
+        weights_dir = None
+
+    if args.arm:  # child mode: measure ONE arm, print its JSON
+        cfg = _build_cfg(args.family, args.tiny, args.arm == "int8",
+                         args.tokens)
+        print(json.dumps(_measure_arm(cfg, weights_dir, args.tokens,
+                                      args.reps)))
+        return
+
+    report = {
+        "metric": f"lm_int8_decode_ab_{args.family}",
+        "family": args.family,
+        "tokens": args.tokens,
+        "tiny": args.tiny,
+        "real_weights": weights_dir is not None,
+    }
+    # each arm runs in its OWN subprocess: XLA's peak_bytes_in_use is
+    # process-cumulative, so in-process sequencing would charge the fp
+    # arm's footprint to the int8 arm's memory report
+    import subprocess
+
+    for arm in ("fp", "int8"):
+        child = [sys.executable, os.path.abspath(__file__),
+                 "--arm", arm, "--family", args.family,
+                 "--tokens", str(args.tokens), "--reps", str(args.reps),
+                 "--weights", args.weights, "--platform", args.platform]
+        if args.tiny:
+            child.append("--tiny")
+        try:
+            proc = subprocess.run(child, capture_output=True, text=True,
+                                  timeout=3600)
+            if proc.returncode != 0:   # OOM on the fp arm IS a result
+                report[arm] = {"error": proc.stderr[-800:]}
+            else:
+                report[arm] = json.loads(proc.stdout.splitlines()[-1])
+        except Exception as exc:
+            report[arm] = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"[lm_int8_ab] {arm}: {report[arm]}", file=sys.stderr)
+
+    fp, q8 = report.get("fp", {}), report.get("int8", {})
+    if "tokens_per_sec" in fp and "tokens_per_sec" in q8:
+        report["speedup"] = round(
+            q8["tokens_per_sec"] / fp["tokens_per_sec"], 3)
+    if "param_bytes" in fp and "param_bytes" in q8 and fp["param_bytes"]:
+        report["param_shrink"] = round(
+            q8["param_bytes"] / fp["param_bytes"], 3)
+
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
