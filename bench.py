@@ -374,43 +374,115 @@ SUITE = {
 }
 
 
+def _run_entry_isolated(name: str, weights_dir: str,
+                        timeout_s: float, cpu: bool = False) -> dict:
+    """Run one suite entry as ``bench.py --entry NAME`` in a child
+    process with a wall-clock timeout. Isolation matters for the two
+    non-exception failure modes that can't be caught in-process: a
+    device tunnel dying MID-suite (the call hangs forever, never
+    raises — round 1 lost its numbers this way) and an OOM poisoning
+    the shared process for every later entry. The persistent
+    ``.jax_cache`` keeps per-child recompiles cheap."""
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--entry", name, weights_dir]
+    if cpu:
+        cmd.insert(2, "--platform-cpu")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"metric": name,
+                "error": f"timeout after {timeout_s:.0f}s "
+                         f"(device hang mid-suite?)"}
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        return {"metric": name,
+                "error": f"exit {proc.returncode}: {proc.stderr[-500:]}"}
+    try:
+        return json.loads(proc.stdout.splitlines()[-1])
+    except Exception:
+        return {"metric": name,
+                "error": f"unparseable output: {proc.stdout[-300:]}"}
+
+
 def main() -> None:
     args = list(sys.argv[1:])
     suite = "--suite" in args
+    # --platform-cpu: CPU smoke of the bench harness itself (skips the
+    # device probe; numbers are NOT measurements). Must pin before any
+    # jax import — a dead accelerator tunnel otherwise hangs backend
+    # init even for CPU-only work.
+    cpu = "--platform-cpu" in args
+    if cpu:
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
+    entry = None
+    if "--entry" in args:
+        i = args.index("--entry")
+        if i + 1 >= len(args):
+            sys.exit("--entry needs a suite entry name")
+        entry = args[i + 1]
+        del args[i:i + 2]
+        if entry not in SUITE:
+            sys.exit(f"unknown suite entry {entry!r}")
     flags = [a for a in args if a.startswith("--")]
-    unknown = [f for f in flags if f != "--suite"]
+    unknown = [f for f in flags
+               if f not in ("--suite", "--platform-cpu")]
     if unknown:
-        sys.exit(f"unknown flag(s): {' '.join(unknown)} (only --suite)")
+        sys.exit(f"unknown flag(s): {' '.join(unknown)} "
+                 f"(--suite, --entry, --platform-cpu)")
     args = [a for a in args if not a.startswith("--")]
     # defaults resolve against the repo, not the cwd (module-CLI runs
     # from anywhere); an explicit positional path keeps shell meaning
     repo = os.path.dirname(os.path.abspath(__file__))
     weights_dir = args[0] if args else os.path.join(repo, "weights")
 
-    probe_device()
+    if entry:  # child mode: one entry, one JSON line, no probe
+        t0 = time.perf_counter()
+        res = SUITE[entry](weights_dir)
+        res["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps(res))
+        return
+
+    if not cpu:
+        probe_device()
     if not suite:
         print(json.dumps(bench_sd15(weights_dir)))
         return
 
+    entry_timeout = float(os.environ.get("BENCH_ENTRY_TIMEOUT", "2400"))
+    wanted = os.environ.get("BENCH_SUITE_ENTRIES")
+    if wanted:
+        names = [n.strip() for n in wanted.split(",") if n.strip()]
+        bad = sorted(set(names) - set(SUITE))
+        if bad or not names:
+            # a typo must not buy a successful empty overnight run
+            sys.exit(f"BENCH_SUITE_ENTRIES has unknown entries {bad}; "
+                     f"valid: {sorted(SUITE)}")
+    else:
+        names = list(SUITE)
     results = {}
     north_star = None
-    for name, fn in SUITE.items():
-        try:
-            t0 = time.perf_counter()
-            res = fn(weights_dir)
-            res["bench_wall_s"] = round(time.perf_counter() - t0, 1)
-        except Exception as exc:  # keep the suite going; record the failure
-            res = {"metric": name, "error": f"{type(exc).__name__}: {exc}"}
+    for name in names:
+        res = _run_entry_isolated(name, weights_dir, entry_timeout,
+                                  cpu=cpu)
         results[name] = res
         if name == "sd15":
             north_star = res
         print(json.dumps(res), file=sys.stderr)
-    with open(os.path.join(repo, "BENCH_SUITE.json"), "w") as f:
+    suite_path = os.path.join(repo, "BENCH_SUITE.json")
+    if wanted:  # partial run: never clobber the full suite record
+        suite_path = os.path.join(repo, "BENCH_SUITE.partial.json")
+    with open(suite_path, "w") as f:
         json.dump(results, f, indent=2)
-    if north_star is None or "error" in north_star:
+    if "sd15" in names and (north_star is None or "error" in north_star):
         # never emit a malformed north-star line with a zero exit
         sys.exit(f"north-star bench failed: {north_star}")
-    print(json.dumps(north_star))
+    if north_star is not None:
+        print(json.dumps(north_star))
 
 
 if __name__ == "__main__":

@@ -74,6 +74,19 @@ def dp_sharded_sampler(sample_impl, mesh):
     return fn, int(mesh.shape["dp"])
 
 
+def share_compatible(models_a, models_b) -> bool:
+    """True when two ModelZooConfigs can share Text2ImagePipeline param
+    trees (same architectures + storage dtype; ``unet_int8`` MAY differ
+    — the pipeline then derives/loads its own UNet). The single
+    definition of the ``share_params_with`` contract: the pipeline's
+    assert and callers picking anchors (tools/clip_report.py) both use
+    this."""
+    return (models_a.clip_text == models_b.clip_text
+            and models_a.unet == models_b.unet
+            and models_a.vae == models_b.vae
+            and models_a.param_dtype == models_b.param_dtype)
+
+
 def int8_unet_tools(models_cfg):
     """(loader transform, apply wrapper) for the weights-only int8 UNet
     option — the one place the int8 serving contract lives (shared by
@@ -193,10 +206,7 @@ class Text2ImagePipeline:
         self.unet = UNet(m.unet)
         self.vae = VAEDecoder(m.vae)
         if share_params_with is not None:
-            sm = share_params_with.cfg.models
-            assert (sm.clip_text == m.clip_text and sm.unet == m.unet
-                    and sm.vae == m.vae
-                    and sm.param_dtype == m.param_dtype), (
+            assert share_compatible(share_params_with.cfg.models, m), (
                 "share_params_with needs matching model architectures"
             )
         self.tokenizer = load_tokenizer(
@@ -207,6 +217,29 @@ class Text2ImagePipeline:
         # pixels per latent: one 2x upsample per VAE level transition
         self.vae_scale = 2 ** (len(m.vae.channel_mults) - 1)
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
+
+        if share_params_with is not None:
+            donor = share_params_with
+            self.clip_params = donor.clip_params
+            self.vae_params = donor.vae_params
+        def load_unet(transform):
+            """maybe_load-or-init for the UNet tree, shared by the
+            fresh-load and fp-joins-int8-donor paths."""
+            lat_hw = cfg.sampler.image_size // self.vae_scale
+            loaded = maybe_load(
+                weights_dir, "unet.safetensors",
+                lambda t: convert_unet(t, m.unet), "unet",
+                cast_to=m.param_dtype, transform=transform)
+            if loaded is not None:
+                return loaded, True
+            return init_params_cached(
+                self.unet, 2,
+                jnp.zeros((1, lat_hw, lat_hw, 4), jnp.float32),
+                jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1, self.pad_len, m.unet.context_dim),
+                          jnp.float32),
+                cache_path=param_cache_path("unet", m.unet),
+                cast_to=m.param_dtype, transform=transform), False
 
         if share_params_with is not None:
             donor = share_params_with
@@ -223,22 +256,7 @@ class Text2ImagePipeline:
             else:
                 # fp arm joining an int8 donor: dequantization is lossy,
                 # so load the fp tree properly
-                loaded_unet = maybe_load(
-                    weights_dir, "unet.safetensors",
-                    lambda t: convert_unet(t, m.unet), "unet",
-                    cast_to=m.param_dtype)
-                lat_hw = cfg.sampler.image_size // self.vae_scale
-                self.unet_params = (
-                    loaded_unet if loaded_unet is not None
-                    else init_params_cached(
-                        self.unet, 2,
-                        jnp.zeros((1, lat_hw, lat_hw, 4), jnp.float32),
-                        jnp.zeros((1,), jnp.int32),
-                        jnp.zeros((1, self.pad_len, m.unet.context_dim),
-                                  jnp.float32),
-                        cache_path=param_cache_path("unet", m.unet),
-                        cast_to=m.param_dtype)
-                )
+                self.unet_params, _ = load_unet(None)
             self.loaded_real_weights = donor.loaded_real_weights
         else:
             ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
@@ -255,20 +273,7 @@ class Text2ImagePipeline:
             )
             lat_hw = cfg.sampler.image_size // self.vae_scale
             lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
-            t0 = jnp.zeros((1,), dtype=jnp.int32)
-            ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
-                            dtype=jnp.float32)
-            loaded_unet = maybe_load(
-                weights_dir, "unet.safetensors",
-                lambda t: convert_unet(t, m.unet), "unet",
-                cast_to=m.param_dtype, transform=unet_transform)
-            self.unet_params = (
-                loaded_unet if loaded_unet is not None
-                else init_params_cached(
-                    self.unet, 2, lat, t0, ctx,
-                    cache_path=param_cache_path("unet", m.unet),
-                    cast_to=m.param_dtype, transform=unet_transform)
-            )
+            self.unet_params, unet_was_loaded = load_unet(unet_transform)
             loaded_vae = maybe_load(
                 weights_dir, "vae.safetensors",
                 lambda t: convert_vae_decoder(t, m.vae), "vae")
@@ -284,7 +289,7 @@ class Text2ImagePipeline:
             # random-init pipeline a measurement
             self.loaded_real_weights = (
                 loaded_clip is not None
-                and loaded_unet is not None
+                and unet_was_loaded
                 and loaded_vae is not None
             )
         self.unet_apply = wrap_unet_apply(self.unet.apply)
@@ -461,7 +466,10 @@ class PromptGenerator:
         ids = jnp.zeros((1, 8), dtype=jnp.int32)
         self.params = (self._load_int8_checkpoint(loader[2], weights_dir)
                        if cfg.models.lm_int8 else None)
-        if self.params is None:
+        if self.params is not None:
+            # pre-quantized checkpoint straight from disk
+            self.loaded_real_weights = True
+        else:
             transform = None
             if cfg.models.lm_int8:
                 # Quantize on HOST, before device placement: peak HBM
@@ -471,11 +479,15 @@ class PromptGenerator:
                 from cassmantle_tpu.ops.quant import quantize_tree_host
 
                 transform = quantize_tree_host
+            loaded = maybe_load(
+                weights_dir, loader[0], loader[1], loader[2],
+                cast_to=cfg.models.param_dtype, transform=transform)
+            # measurement tools (tools/lm_int8_ab.py) refuse to label a
+            # random-init decode a real-weights number
+            self.loaded_real_weights = loaded is not None
             self.params = (
-                maybe_load(weights_dir, loader[0], loader[1], loader[2],
-                           cast_to=cfg.models.param_dtype,
-                           transform=transform)
-                or init_params_cached(
+                loaded if loaded is not None
+                else init_params_cached(
                     self.model, 5, ids,
                     cache_path=param_cache_path(loader[2], m),
                     cast_to=cfg.models.param_dtype, transform=transform)

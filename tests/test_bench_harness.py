@@ -1,0 +1,42 @@
+"""Bench suite harness resilience (the round-1 failure mode: a device
+tunnel dying mid-suite hangs an in-process entry forever and loses
+every number). Entries run in per-entry subprocesses with wall-clock
+timeouts; a hung entry becomes a clean error record and the suite
+moves on."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def test_suite_survives_hung_entry(tmp_path):
+    """With a 3s entry budget, the scorer entry (which needs ~2min on
+    CPU) times out — the suite records the timeout as data instead of
+    hanging, and exits cleanly because the north star wasn't asked
+    for."""
+    env = dict(os.environ,
+               BENCH_SUITE_ENTRIES="scorer", BENCH_ENTRY_TIMEOUT="3")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--suite", "--platform-cpu"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    partial = os.path.join(REPO, "BENCH_SUITE.partial.json")
+    try:
+        results = json.load(open(partial))
+    finally:
+        os.path.exists(partial) and os.remove(partial)
+    assert "timeout" in results["scorer"]["error"]
+
+
+def test_unknown_entry_rejected():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--entry", "nope", "--platform-cpu"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "unknown suite entry" in proc.stderr

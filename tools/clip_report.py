@@ -143,18 +143,14 @@ def main() -> None:
         "prompts": len(PROMPTS), "seeds": args.seeds,
         "presets": {},
     }
-    def arch_match(a, b):
-        # the contract share_params_with asserts: same architectures
-        # and storage dtype (unet_int8 may differ — the pipeline then
-        # derives/loads its own UNet tree but still shares CLIP/VAE)
-        return (a.clip_text == b.clip_text and a.unet == b.unet
-                and a.vae == b.vae and a.param_dtype == b.param_dtype)
+    from cassmantle_tpu.serving.pipeline import share_compatible
 
     anchors = []  # one anchor pipeline per distinct architecture
     for name in wanted:
         cfg = factories[name]()
         share = next(
-            (p for p in anchors if arch_match(p.cfg.models, cfg.models)),
+            (p for p in anchors
+             if share_compatible(p.cfg.models, cfg.models)),
             None)
         pipe = Text2ImagePipeline(cfg, weights_dir=weights_dir,
                                   share_params_with=share)

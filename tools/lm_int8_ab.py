@@ -30,7 +30,7 @@ sys.path.insert(0, REPO_ROOT)
 SEED_TEXT = "The lighthouse keeper counted the storms of"
 
 
-def _build_cfg(family: str, tiny: bool, int8: bool, tokens: int):
+def _build_cfg(family: str, tiny: bool, int8: bool):
     from cassmantle_tpu.config import (
         FrameworkConfig,
         MistralConfig,
@@ -44,11 +44,10 @@ def _build_cfg(family: str, tiny: bool, int8: bool, tokens: int):
             models,
             mistral=MistralConfig.tiny() if tiny else MistralConfig())
     models = dataclasses.replace(models, lm_int8=int8)
-    # fixed decode length: both arms generate exactly `tokens` tokens,
-    # so tokens/sec is comparable
-    sampler = dataclasses.replace(
-        cfg.sampler, min_new_tokens=tokens, max_new_tokens=tokens)
-    return cfg.replace(models=models, sampler=sampler)
+    # decode length is fixed by the explicit max_new_tokens passed to
+    # generate() (greedy_decode runs a fixed-length lax.scan), so
+    # tokens/sec is comparable across arms without touching the config
+    return cfg.replace(models=models)
 
 
 def _device_mem() -> dict:
@@ -75,7 +74,6 @@ def _measure_arm(cfg, weights_dir, tokens: int, reps: int) -> dict:
     for _ in range(reps):
         text = gen.generate(SEED_TEXT, max_new_tokens=tokens)
     dt = (time.perf_counter() - t0) / reps
-    jax.effects_barrier()
     n_q = sum(1 for leaf in jax.tree_util.tree_leaves(
         gen.params, is_leaf=lambda x: isinstance(x, QTensor))
         if isinstance(leaf, QTensor))
@@ -87,6 +85,7 @@ def _measure_arm(cfg, weights_dir, tokens: int, reps: int) -> dict:
         # smoke dims) — the A/B is then a no-op, not a measurement
         "quantized_leaves": n_q,
         "memory": _device_mem(),
+        "real_weights": gen.loaded_real_weights,
         "sample_chars": len(text),
     }
 
@@ -125,8 +124,7 @@ def main() -> None:
         weights_dir = None
 
     if args.arm:  # child mode: measure ONE arm, print its JSON
-        cfg = _build_cfg(args.family, args.tiny, args.arm == "int8",
-                         args.tokens)
+        cfg = _build_cfg(args.family, args.tiny, args.arm == "int8")
         print(json.dumps(_measure_arm(cfg, weights_dir, args.tokens,
                                       args.reps)))
         return
@@ -136,7 +134,6 @@ def main() -> None:
         "family": args.family,
         "tokens": args.tokens,
         "tiny": args.tiny,
-        "real_weights": weights_dir is not None,
     }
     # each arm runs in its OWN subprocess: XLA's peak_bytes_in_use is
     # process-cumulative, so in-process sequencing would charge the fp
@@ -162,6 +159,9 @@ def main() -> None:
         print(f"[lm_int8_ab] {arm}: {report[arm]}", file=sys.stderr)
 
     fp, q8 = report.get("fp", {}), report.get("int8", {})
+    # a real-weights A/B needs BOTH arms loaded from checkpoints
+    report["real_weights"] = bool(
+        fp.get("real_weights") and q8.get("real_weights"))
     if "tokens_per_sec" in fp and "tokens_per_sec" in q8:
         report["speedup"] = round(
             q8["tokens_per_sec"] / fp["tokens_per_sec"], 3)
