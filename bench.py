@@ -138,6 +138,25 @@ def bench_sd15_turbo(weights_dir: str) -> dict:
         weights_dir)
 
 
+def bench_sdxl_turbo(weights_dir: str) -> dict:
+    """SDXL-1024 with the composed turbo path (DPM++(2M)@24 +
+    deepcache) — the samplers/deepcache machinery is shared with SD1.5
+    (serving/pipeline.py:run_cfg_denoise), so the workload-level
+    speedups apply to the reference's actual image model too."""
+    import dataclasses as _dc
+
+    from cassmantle_tpu.config import sdxl_config
+
+    def cfg():
+        base = sdxl_config()
+        return base.replace(sampler=_dc.replace(
+            base.sampler, kind="dpmpp_2m", num_steps=24, deepcache=True))
+
+    return _bench_sdxl_with(
+        cfg, "sdxl_1024px_dpmpp24_deepcache_images_per_sec_per_chip",
+        weights_dir)
+
+
 def bench_sd15_int8(weights_dir: str) -> dict:
     """A/B arm for weights-only int8 UNet on the fixed DDIM-50 config:
     same trajectory as `sd15`, int8 weight streaming (halved per-step
@@ -213,16 +232,20 @@ def bench_gpt2(weights_dir: str) -> dict:
     }
 
 
-def bench_sdxl(weights_dir: str) -> dict:
-    """BASELINE ladder #4: SDXL-base 1024², batched, data-parallel."""
+def _bench_sdxl_with(config_factory, metric: str,
+                     weights_dir: str) -> dict:
+    """Shared SDXL harness (one timing methodology for both SDXL
+    entries): dp mesh over the local devices, one prompt per device,
+    images/sec/chip."""
     jax = _setup_jax()
-    from cassmantle_tpu.config import MeshConfig, sdxl_config
+    from cassmantle_tpu.config import MeshConfig
     from cassmantle_tpu.parallel.mesh import make_mesh
     from cassmantle_tpu.serving.sdxl import SDXLPipeline
 
     n = jax.local_device_count()
     mesh = make_mesh(MeshConfig(dp=-1, tp=1, sp=1)) if n > 1 else None
-    pipe = SDXLPipeline(sdxl_config(), weights_dir=weights_dir, mesh=mesh)
+    pipe = SDXLPipeline(config_factory(), weights_dir=weights_dir,
+                        mesh=mesh)
     prompts = (PROMPTS * ((n + len(PROMPTS) - 1) // len(PROMPTS)))[: max(n, 1)]
     pipe.generate(prompts, seed=0)  # warmup
 
@@ -233,11 +256,20 @@ def bench_sdxl(weights_dir: str) -> dict:
     elapsed = time.perf_counter() - t0
     ips_chip = reps * len(prompts) / elapsed / max(1, n)
     return {
-        "metric": "sdxl_1024px_ddim50_images_per_sec_per_chip",
+        "metric": metric,
         "value": round(ips_chip, 4),
         "unit": "images/sec/chip",
         "vs_baseline": None,
     }
+
+
+def bench_sdxl(weights_dir: str) -> dict:
+    """BASELINE ladder #4: SDXL-base 1024², batched, data-parallel."""
+    from cassmantle_tpu.config import sdxl_config
+
+    return _bench_sdxl_with(
+        sdxl_config, "sdxl_1024px_ddim50_images_per_sec_per_chip",
+        weights_dir)
 
 
 def bench_e2e_round(weights_dir: str) -> dict:
@@ -369,6 +401,7 @@ SUITE = {
     "sd15_turbo": bench_sd15_turbo,
     "sd15_int8": bench_sd15_int8,
     "sdxl": bench_sdxl,
+    "sdxl_turbo": bench_sdxl_turbo,
     "e2e": bench_e2e_round,
     "soak": bench_soak,
 }
@@ -392,10 +425,16 @@ def _run_entry_isolated(name: str, weights_dir: str,
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        # keep whatever the child said before the kill: the only
+        # diagnostics for how far the entry got
+        tail = (exc.stderr or b"")
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "ignore")
         return {"metric": name,
                 "error": f"timeout after {timeout_s:.0f}s "
-                         f"(device hang mid-suite?)"}
+                         f"(device hang mid-suite?)",
+                "stderr_tail": tail[-500:]}
     sys.stderr.write(proc.stderr[-4000:])
     if proc.returncode != 0:
         return {"metric": name,

@@ -218,10 +218,6 @@ class Text2ImagePipeline:
         self.vae_scale = 2 ** (len(m.vae.channel_mults) - 1)
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
 
-        if share_params_with is not None:
-            donor = share_params_with
-            self.clip_params = donor.clip_params
-            self.vae_params = donor.vae_params
         def load_unet(transform):
             """maybe_load-or-init for the UNet tree, shared by the
             fresh-load and fp-joins-int8-donor paths."""
@@ -467,8 +463,18 @@ class PromptGenerator:
         self.params = (self._load_int8_checkpoint(loader[2], weights_dir)
                        if cfg.models.lm_int8 else None)
         if self.params is not None:
-            # pre-quantized checkpoint straight from disk
-            self.loaded_real_weights = True
+            # Pre-quantized checkpoint straight from disk. Provenance:
+            # tools/quantize_weights.py falls back to random init when
+            # no fp checkpoint exists, so the int8 file only counts as
+            # real weights if its fp source (file or shards) is present
+            # (the staleness check already ensures int8 is the newer).
+            import glob as _glob
+
+            stem = loader[0].rsplit(".", 1)[0]
+            self.loaded_real_weights = bool(
+                os.path.exists(os.path.join(weights_dir, loader[0]))
+                or _glob.glob(os.path.join(
+                    weights_dir, f"{stem}-*.safetensors")))
         else:
             transform = None
             if cfg.models.lm_int8:

@@ -124,3 +124,20 @@ def test_sdxl_data_parallel_pads_partial_batch(cfg):
     s = cfg.sampler.image_size
     out = dp_pipe.generate(["a", "b", "c"], seed=1)  # 3 pads to dp width
     assert out.shape == (3, s, s, 3)
+
+
+def test_sdxl_turbo_combo():
+    """SDXL + the composed turbo path (dpmpp_2m + deepcache pairing):
+    the shared run_cfg_denoise machinery serves the dual-tower pipeline
+    too (bench entry sdxl_turbo)."""
+    import dataclasses
+
+    from cassmantle_tpu.config import test_sdxl_config
+    from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+    cfg = test_sdxl_config()
+    cfg = cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, kind="dpmpp_2m", num_steps=4, deepcache=True))
+    pipe = SDXLPipeline(cfg)
+    imgs = pipe.generate(["a brass harbor at dusk"], seed=4)
+    assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
