@@ -143,11 +143,19 @@ def multi_head_attention(
 
             return flash_attention(q, k, v, scale=scale)
         if flash_cross_ok(q, k):
-            # ragged-S_k cross-attention (UNet text context, S_k=77):
-            # K/V pad into the kernel, pad columns masked by kv_len
-            from cassmantle_tpu.ops.flash_attention import (
-                flash_cross_attention,
-            )
+            import os
 
-            return flash_cross_attention(q, k, v, scale=scale)
+            # ragged-S_k cross-attention (UNet text context, S_k=77):
+            # K/V pad into the kernel, pad columns masked by kv_len.
+            # CASSMANTLE_NO_FLASH_CROSS=1 is the operator kill switch —
+            # one env var reverts every cross site to the XLA path if
+            # this newer kernel misbehaves on some TPU generation,
+            # without touching the proven self-attention flash path.
+            if os.environ.get(
+                    "CASSMANTLE_NO_FLASH_CROSS", "") in ("", "0"):
+                from cassmantle_tpu.ops.flash_attention import (
+                    flash_cross_attention,
+                )
+
+                return flash_cross_attention(q, k, v, scale=scale)
     return xla_attention(q, k, v, mask=mask, scale=scale)

@@ -163,3 +163,30 @@ def test_dispatcher_routes_ragged_cross_attention():
     ref = xla_attention(q, k, v)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_cross_kill_switch(monkeypatch):
+    """CASSMANTLE_NO_FLASH_CROSS reverts ragged cross-attention to the
+    XLA path (operator insurance for a misbehaving kernel). Routing is
+    asserted directly: the cross kernel must not be INVOKED when the
+    switch is set ('0' and unset mean on), since the two paths are
+    parity-equal by design and output comparison can't see routing."""
+    import cassmantle_tpu.ops.flash_attention as fa_mod
+    from cassmantle_tpu.ops.attention import multi_head_attention
+
+    calls = []
+    real = fa_mod.flash_cross_attention
+    monkeypatch.setattr(
+        fa_mod, "flash_cross_attention",
+        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), 1, BLOCK_Q, 2, 40,
+                        seq_k=77)
+    monkeypatch.setenv("CASSMANTLE_NO_FLASH_CROSS", "1")
+    off = multi_head_attention(q, k, v, use_flash=True)
+    assert not calls, "kill switch set but cross kernel was invoked"
+    monkeypatch.setenv("CASSMANTLE_NO_FLASH_CROSS", "0")  # conventional re-enable
+    on = multi_head_attention(q, k, v, use_flash=True)
+    assert calls, "switch '0' must mean enabled"
+    np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                               atol=2e-5, rtol=2e-5)
