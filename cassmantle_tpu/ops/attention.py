@@ -152,7 +152,8 @@ def multi_head_attention(
             # this newer kernel misbehaves on some TPU generation,
             # without touching the proven self-attention flash path.
             if os.environ.get(
-                    "CASSMANTLE_NO_FLASH_CROSS", "") in ("", "0"):
+                    "CASSMANTLE_NO_FLASH_CROSS", ""
+            ).lower() in ("", "0", "false", "no", "off"):
                 from cassmantle_tpu.ops.flash_attention import (
                     flash_cross_attention,
                 )

@@ -68,18 +68,30 @@ def load_styles() -> List[str]:
 def load_wordlist() -> Tuple[str, ...]:
     """Dictionary words backing client-side spellcheck (data/wordlist.txt
     + every word appearing in seeds/styles; the reference ships a hunspell
-    en_US dictionary for the same purpose, SURVEY.md §2 #13/F3). Cached:
-    the list is immutable at runtime and /wordlist is hit per page load."""
-    words = set(_load_lines(os.path.join(DATA_DIR, "wordlist.txt"), []))
+    en_US dictionary for the same purpose, SURVEY.md §2 #13/F3). FILE
+    ORDER IS PRESERVED: tools/build_wordlist.py writes most-common-first,
+    and both spellcheckers rank suggestions by list position. Seed/style
+    vocabulary appends after the file (always checkable, ranked behind
+    the mined body). Cached: immutable at runtime, /wordlist per page
+    load."""
+    words = list(dict.fromkeys(
+        _load_lines(os.path.join(DATA_DIR, "wordlist.txt"), [])))
+    seen = set(words)
+
+    def add(w: str) -> None:
+        if w not in seen:
+            seen.add(w)
+            words.append(w)
+
     for line in load_seeds() + load_styles():
         for token in line.lower().split():
             token = token.strip("'-.,;:!?\"")
             # whole token (keeps 'ukiyo-e', 'low-poly' checkable exactly)
             if re.fullmatch(r"[a-z]+(?:[-'][a-z]+)*", token) and \
                     len(token) >= 2:
-                words.add(token)
+                add(token)
             # plus each alpha run, so the parts are guessable too
             for part in re.findall(r"[a-z]+", token):
                 if len(part) >= 2:
-                    words.add(part)
-    return tuple(sorted(words))
+                    add(part)
+    return tuple(words)

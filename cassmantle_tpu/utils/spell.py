@@ -25,7 +25,15 @@ _ALPHABET = "abcdefghijklmnopqrstuvwxyz"
 
 class Spell:
     def __init__(self, words: Iterable[str]) -> None:
-        self.words = {str(w).lower() for w in words or ()}
+        # insertion order IS the frequency rank (the served wordlist is
+        # most-common-first, tools/build_wordlist.py); suggestions sort
+        # by it so common words beat obscure ones
+        self.rank = {}
+        for w in words or ():
+            w = str(w).lower()
+            if w not in self.rank:
+                self.rank[w] = len(self.rank)
+        self.words = set(self.rank)
 
     def _stems(self, word: str) -> List[str]:
         w = word.lower()
@@ -68,9 +76,26 @@ class Spell:
         return any(s in self.words for s in self._stems(word))
 
     def suggest(self, word: str, limit: int = 5) -> List[str]:
+        """Edit-distance-1 candidates that pass check(), ranked by
+        corpus frequency (list position), generation order breaking
+        ties — a typo of a common word surfaces the common word first
+        (the role of hunspell's replacement tables in the reference's
+        typo.js). Candidates accepted only via stemming carry their
+        stem's rank."""
         w = str(word).lower()
         seen = set()
         out: List[str] = []
+
+        def cand_rank(cand: str):
+            # direct lexicon entries strictly beat stem-only matches:
+            # the stemmer accepts constructions like "form"+"est" that
+            # must never outrank a real word
+            r = self.rank.get(cand)
+            if r is not None:
+                return (0, r)
+            return (1, min((self.rank[s] for s in self._stems(cand)
+                            if s in self.rank),
+                           default=len(self.rank)))
 
         def consider(cand: str) -> None:
             if cand not in seen and cand != w and self.check(cand):
@@ -87,6 +112,5 @@ class Spell:
                 consider(head + c + tail)                      # insertion
                 if tail:
                     consider(head + c + tail[1:])              # substitution
-            if len(out) >= limit:
-                break
+        out.sort(key=cand_rank)  # stable: generation order breaks ties
         return out[:limit]

@@ -17,8 +17,15 @@
 
 class Spell {
   constructor(words) {
-    this.words = new Set();
-    for (const w of words || []) this.words.add(String(w).toLowerCase());
+    /* insertion order IS the frequency rank (the served wordlist is
+     * most-common-first); suggestions sort by it so common words beat
+     * obscure ones. */
+    this.rank = new Map();
+    for (const w of words || []) {
+      const lw = String(w).toLowerCase();
+      if (!this.rank.has(lw)) this.rank.set(lw, this.rank.size);
+    }
+    this.words = new Set(this.rank.keys());
     this.alphabet = "abcdefghijklmnopqrstuvwxyz";
   }
 
@@ -48,11 +55,26 @@ class Spell {
     return false;
   }
 
+  /* Edit-distance-1 candidates that pass check(), ranked by corpus
+   * frequency (list position), generation order breaking ties;
+   * stem-only matches carry their stem's rank. KEEP IN LOCKSTEP with
+   * utils/spell.py. */
   suggest(word, limit) {
     limit = limit || 5;
     const w = String(word).toLowerCase();
     const seen = new Set();
     const out = [];
+    /* direct lexicon entries strictly beat stem-only matches: the
+     * stemmer accepts constructions like "form"+"est" that must never
+     * outrank a real word */
+    const candRank = (cand) => {
+      if (this.rank.has(cand)) return this.rank.get(cand);
+      let best = this.rank.size;
+      for (const s of this._stems(cand)) {
+        if (this.rank.has(s)) best = Math.min(best, this.rank.get(s));
+      }
+      return this.rank.size + best;
+    };
     const consider = (cand) => {
       if (!seen.has(cand) && cand !== w && this.check(cand)) {
         seen.add(cand);
@@ -69,9 +91,12 @@ class Spell {
         consider(head + c + tail);                           // insertion
         if (tail) consider(head + c + tail.slice(1));        // substitution
       }
-      if (out.length >= limit) break;
     }
-    return out.slice(0, limit);
+    // stable sort: generation order breaks rank ties
+    return out.map((c, i) => [candRank(c), i, c])
+      .sort((a, b) => a[0] - b[0] || a[1] - b[1])
+      .map((t) => t[2])
+      .slice(0, limit);
   }
 }
 

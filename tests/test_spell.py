@@ -127,3 +127,26 @@ def test_wordlist_endpoint_scale():
             await client.close()
 
     asyncio.run(run())
+
+
+def test_suggest_ranks_common_words_first(spell):
+    """The served list is frequency-ordered and suggest() ranks by it:
+    classic typos surface the intended word at TOP-1, and a direct
+    lexicon entry always beats a stem-only construction (the stemmer
+    accepts 'form'+'est', which must not outrank 'forest')."""
+    for typo, want in (
+        ("forrest", "forest"), ("stromy", "stormy"),
+        ("silvr", "silver"), ("velvte", "velvet"),
+        ("anceint", "ancient"),
+    ):
+        got = spell.suggest(typo, 3)
+        assert got and got[0] == want, f"{typo}: {got}"
+
+
+def test_wordlist_is_frequency_ordered():
+    """data/wordlist.txt leads with high-frequency English (the rank
+    signal suggest() relies on), not the alphabet."""
+    head = [ln.strip() for ln in open(
+        os.path.join(REPO, "data", "wordlist.txt")).readlines()[:50]]
+    assert "the" in head and "and" in head
+    assert head != sorted(head)  # not alphabetical

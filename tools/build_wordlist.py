@@ -21,6 +21,13 @@ Filters (deterministic):
 - the existing curated game list (data/wordlist.txt) is merged in, so
   regeneration never loses hand-picked vocabulary.
 
+Output order is DOCUMENT FREQUENCY, most common first (ties, curated
+seeds, and merged hand-picked words alphabetical at their frequency
+tier): both spellcheckers (static/spell.js, utils/spell.py) rank
+did-you-mean suggestions by list position, so a one-edit typo surfaces
+the intended COMMON word ahead of an obscure one — the role hunspell's
+replacement tables play in the reference's typo.js.
+
 Usage:  python tools/build_wordlist.py [--out data/wordlist.txt]
             [--min-df 3] [--no-merge-existing]
 """
@@ -164,11 +171,14 @@ def main() -> None:
             if w and curated_re.fullmatch(w):
                 words.add(w)
 
-    final = sorted(words)
+    # frequency order, most common first; words the miner never counted
+    # (curated seeds, merged hand-picked entries) land after the mined
+    # body at df=0; alphabetical tie-break keeps the output deterministic
+    final = sorted(words, key=lambda w: (-df.get(w, 0), w))
     with open(args.out, "w", encoding="utf-8") as f:
         f.write("\n".join(final) + "\n")
     print(f"[build_wordlist] {mined} mined + curated merge -> "
-          f"{len(final)} words at {args.out}")
+          f"{len(final)} words (frequency-ordered) at {args.out}")
 
 
 if __name__ == "__main__":
