@@ -74,24 +74,18 @@ def load_wordlist() -> Tuple[str, ...]:
     vocabulary appends after the file (always checkable, ranked behind
     the mined body). Cached: immutable at runtime, /wordlist per page
     load."""
-    words = list(dict.fromkeys(
-        _load_lines(os.path.join(DATA_DIR, "wordlist.txt"), [])))
-    seen = set(words)
-
-    def add(w: str) -> None:
-        if w not in seen:
-            seen.add(w)
-            words.append(w)
-
+    # one insertion-ordered dict: order is the rank, keys the dedup
+    seen = dict.fromkeys(
+        _load_lines(os.path.join(DATA_DIR, "wordlist.txt"), []))
     for line in load_seeds() + load_styles():
         for token in line.lower().split():
             token = token.strip("'-.,;:!?\"")
             # whole token (keeps 'ukiyo-e', 'low-poly' checkable exactly)
             if re.fullmatch(r"[a-z]+(?:[-'][a-z]+)*", token) and \
                     len(token) >= 2:
-                add(token)
+                seen.setdefault(token)
             # plus each alpha run, so the parts are guessable too
             for part in re.findall(r"[a-z]+", token):
                 if len(part) >= 2:
-                    add(part)
-    return tuple(words)
+                    seen.setdefault(part)
+    return tuple(seen)

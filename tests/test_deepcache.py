@@ -117,10 +117,12 @@ def test_deepcache_rejects_odd_steps_or_wrong_sampler():
 
 
 def test_sdxl_pipeline_with_deepcache_config():
-    from cassmantle_tpu.config import test_sdxl_config
+    from cassmantle_tpu.config import (
+        test_sdxl_config as _tiny_sdxl_config,
+    )
     from cassmantle_tpu.serving.sdxl import SDXLPipeline
 
-    cfg = test_sdxl_config()
+    cfg = _tiny_sdxl_config()
     cfg = cfg.replace(sampler=dataclasses.replace(
         cfg.sampler, kind="ddim", deepcache=True, num_steps=4))
     pipe = SDXLPipeline(cfg)

@@ -11,7 +11,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from cassmantle_tpu.config import MeshConfig, test_sdxl_config
+from cassmantle_tpu.config import (
+    MeshConfig,
+    test_sdxl_config as _tiny_sdxl_config,
+)
 from cassmantle_tpu.models.clip_text import ClipTextEncoder
 from cassmantle_tpu.models.unet import UNet
 from cassmantle_tpu.ops.ddim import make_cfg_denoiser
@@ -21,7 +24,7 @@ from cassmantle_tpu.serving.sdxl import SDXLPipeline
 
 @pytest.fixture(scope="module")
 def cfg():
-    return test_sdxl_config()
+    return _tiny_sdxl_config()
 
 
 @pytest.fixture(scope="module")
@@ -132,10 +135,9 @@ def test_sdxl_turbo_combo():
     too (bench entry sdxl_turbo)."""
     import dataclasses
 
-    from cassmantle_tpu.config import test_sdxl_config
     from cassmantle_tpu.serving.sdxl import SDXLPipeline
 
-    cfg = test_sdxl_config()
+    cfg = _tiny_sdxl_config()
     cfg = cfg.replace(sampler=dataclasses.replace(
         cfg.sampler, kind="dpmpp_2m", num_steps=4, deepcache=True))
     pipe = SDXLPipeline(cfg)

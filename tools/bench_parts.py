@@ -40,7 +40,9 @@ def main() -> None:
     enable_compile_cache()
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 4
     cfg = FrameworkConfig()
-    pipe = Text2ImagePipeline(cfg, weights_dir="weights")
+    pipe = Text2ImagePipeline(cfg, weights_dir=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "weights"))
 
     ids = jnp.asarray(pipe._tokenize(["a lighthouse over a stormy sea"] * batch))
     uncond = jnp.asarray(pipe._tokenize([""] * batch))

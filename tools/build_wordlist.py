@@ -102,9 +102,15 @@ def iter_text_files(roots):
 
 
 def mine(roots, progress_every: int = 10_000):
-    """-> (lowercase document frequency, capitalized document frequency)."""
+    """-> (document frequency, capitalized df, PROSE document frequency).
+
+    ``prose_df`` counts only non-.py files (docs, READMEs, licenses):
+    the inclusion filter uses the full corpus for coverage, but the
+    RANKING signal must not let code identifiers ('def', 'args',
+    'lset') outrank story-English — suggest() sorts by list position."""
     df: collections.Counter = collections.Counter()
     caps: collections.Counter = collections.Counter()
+    prose_df: collections.Counter = collections.Counter()
     n = 0
     for path in iter_text_files(roots):
         try:
@@ -114,6 +120,7 @@ def mine(roots, progress_every: int = 10_000):
         n += 1
         if progress_every and n % progress_every == 0:
             print(f"[build_wordlist] ... {n} files", file=sys.stderr)
+        is_prose = not path.endswith(".py")
         lower, upper = set(), set()
         for m in WORD_RE.finditer(text):
             w = m.group(0)
@@ -123,10 +130,12 @@ def mine(roots, progress_every: int = 10_000):
                 upper.add(w.lower())
         for w in lower:
             df[w] += 1
+            if is_prose:
+                prose_df[w] += 1
         for w in upper:
             caps[w] += 1
     print(f"[build_wordlist] scanned {n} files", file=sys.stderr)
-    return df, caps
+    return df, caps, prose_df
 
 
 def select(df, caps, min_df: int):
@@ -157,7 +166,7 @@ def main() -> None:
                     help="drop the current curated list instead of merging")
     args = ap.parse_args()
 
-    df, caps = mine(args.roots)
+    df, caps, prose_df = mine(args.roots)
     words = set(select(df, caps, args.min_df))
     mined = len(words)
 
@@ -171,10 +180,12 @@ def main() -> None:
             if w and curated_re.fullmatch(w):
                 words.add(w)
 
-    # frequency order, most common first; words the miner never counted
-    # (curated seeds, merged hand-picked entries) land after the mined
-    # body at df=0; alphabetical tie-break keeps the output deterministic
-    final = sorted(words, key=lambda w: (-df.get(w, 0), w))
+    # Rank by PROSE frequency first (code identifiers must not outrank
+    # story-English), full-corpus frequency as the tie-break, then
+    # alphabetical for determinism; words the miner never counted
+    # (curated seeds, merged hand-picked entries) land at their tier end
+    final = sorted(words, key=lambda w: (-prose_df.get(w, 0),
+                                         -df.get(w, 0), w))
     with open(args.out, "w", encoding="utf-8") as f:
         f.write("\n".join(final) + "\n")
     print(f"[build_wordlist] {mined} mined + curated merge -> "
