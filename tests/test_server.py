@@ -270,3 +270,36 @@ async def test_index_ships_privacy_modal():
         assert 'id="privacy-close"' in text
     finally:
         await client.close()
+
+
+@pytest.mark.asyncio
+async def test_full_stack_real_backend_round():
+    """The one seam the fake-backend tests can't cover: HTTP -> engine
+    -> REAL serving stack (tiny CLIP->DDIM->VAE pipeline, GPT-2 prompt
+    decode, MiniLM guess scorer) end to end. A client initializes,
+    fetches a genuinely generated round image, and scores a guess
+    against the real embedding scorer."""
+    from cassmantle_tpu.server.app import build_game
+
+    cfg = make_cfg()
+    game = build_game(cfg, fake=False)
+    app = create_app(game, cfg, start_timer=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        await game.startup()
+        await client.get("/init")
+        res = await client.get("/fetch/contents")
+        data = await res.json()
+        raw = base64.b64decode(data["image"])
+        assert raw[:2] == b"\xff\xd8"            # real generated JPEG
+        prompt = data["prompt"]
+        assert prompt["tokens"] and prompt["masks"]
+        res = await client.post(
+            "/compute_score",
+            json={"inputs": {str(prompt["masks"][0]): "stormy"}})
+        scores = await res.json()
+        assert "won" in scores
+    finally:
+        await client.close()
+        await game.shutdown()
