@@ -285,9 +285,8 @@ async def test_full_stack_real_backend_round():
     game = build_game(cfg, fake=False)
     app = create_app(game, cfg, start_timer=False)
     client = TestClient(TestServer(app))
-    await client.start_server()
+    await client.start_server()   # create_app's hooks run game.startup()
     try:
-        await game.startup()
         await client.get("/init")
         res = await client.get("/fetch/contents")
         data = await res.json()
@@ -301,5 +300,4 @@ async def test_full_stack_real_backend_round():
         scores = await res.json()
         assert "won" in scores
     finally:
-        await client.close()
-        await game.shutdown()
+        await client.close()   # cleanup hook runs game.shutdown()
