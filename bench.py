@@ -425,11 +425,15 @@ def _run_entry_isolated(name: str, weights_dir: str,
     the shared process for every later entry. The persistent
     ``.jax_cache`` keeps per-child recompiles cheap.
 
-    A child that FAILS fast (nonzero exit — e.g. a Pallas kernel a TPU
-    generation rejects at compile) gets ONE retry with the flash-cross
-    kill switch set: a number on the proven path beats an error record.
-    Timeouts never retry (a dead tunnel would double the suite's wall
-    clock for nothing)."""
+    A child whose failure LOOKS like the flash-cross kernel (Pallas/
+    Mosaic markers in stderr — e.g. a TPU generation rejecting it at
+    compile) gets ONE retry with the kill switch set, budgeted within
+    the entry's REMAINING time: a number on the proven path beats an
+    error record, but a retry must never double the entry's wall-clock
+    budget, and unrelated failures (missing weights, OOM) fail
+    immediately with their real diagnostic. Timeouts never retry. A
+    successful retry is sticky: the caller pre-sets the kill switch
+    for every later entry, so one doomed compile isn't repeated 8x."""
     import subprocess
 
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -437,20 +441,28 @@ def _run_entry_isolated(name: str, weights_dir: str,
     if cpu:
         cmd.insert(2, "--platform-cpu")
 
-    def run_once(extra_env: dict):
+    def run_once(extra_env: dict, budget_s: float):
         return subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cmd, capture_output=True, text=True, timeout=budget_s,
             env={**os.environ, **extra_env})
 
     try:
-        proc = run_once({})
+        t0 = time.perf_counter()
+        proc = run_once({}, timeout_s)
         retried = False
-        if proc.returncode != 0 and not _kill_switch_already_set():
+        flash_markers = ("pallas", "mosaic", "flash_cross")
+        if (proc.returncode != 0 and not _kill_switch_already_set()
+                and any(m in proc.stderr.lower()
+                        for m in flash_markers)):
+            remaining = max(60.0, timeout_s
+                            - (time.perf_counter() - t0))
             sys.stderr.write(
                 f"[suite] {name} failed (exit {proc.returncode}); "
                 f"first attempt stderr tail:\n{proc.stderr[-1500:]}\n"
-                f"[suite] retrying with CASSMANTLE_NO_FLASH_CROSS=1\n")
-            proc = run_once({"CASSMANTLE_NO_FLASH_CROSS": "1"})
+                f"[suite] retrying with CASSMANTLE_NO_FLASH_CROSS=1 "
+                f"({remaining:.0f}s budget)\n")
+            proc = run_once({"CASSMANTLE_NO_FLASH_CROSS": "1"},
+                            remaining)
             retried = True
     except subprocess.TimeoutExpired as exc:
         # keep whatever the child said before the kill: the only
@@ -529,11 +541,17 @@ def main() -> None:
         try:
             res = bench_sd15(weights_dir)
         except Exception:
-            if _kill_switch_already_set():
-                raise
             import traceback
 
-            traceback.print_exc()
+            tb = traceback.format_exc()
+            sys.stderr.write(tb)
+            # only flash-kernel-shaped failures earn the fallback; an
+            # unrelated error (missing path, OOM) must surface its real
+            # diagnostic immediately, not after a second pipeline build
+            if _kill_switch_already_set() or not any(
+                    m in tb.lower()
+                    for m in ("pallas", "mosaic", "flash_cross")):
+                raise
             print("[bench] retrying with CASSMANTLE_NO_FLASH_CROSS=1",
                   file=sys.stderr)
             retry = True
@@ -560,6 +578,10 @@ def main() -> None:
     for name in names:
         res = _run_entry_isolated(name, weights_dir, entry_timeout,
                                   cpu=cpu)
+        if res.get("flash_cross_disabled"):
+            # sticky: don't repeat the doomed kernel compile in every
+            # remaining entry (children inherit our env)
+            os.environ["CASSMANTLE_NO_FLASH_CROSS"] = "1"
         results[name] = res
         if name == "sd15":
             north_star = res
