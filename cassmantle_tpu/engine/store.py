@@ -88,6 +88,20 @@ def _to_bytes(v: Value) -> bytes:
     return str(v).encode()
 
 
+def _report_lock_hazard(kind: str, name: str) -> None:
+    """Lock-TTL hazard telemetry: a hold that outlived its timeout means
+    mutual exclusion was NOT guaranteed (another worker may have entered
+    the critical section). Counted at ``store.lock_{kind}`` and logged —
+    turning the reference's silent failure window into a signal."""
+    from cassmantle_tpu.utils.logging import get_logger, metrics
+
+    metrics.inc(f"store.lock_{kind}")
+    get_logger("store").warning(
+        "lock %r %s: hold exceeded its TTL — mutual exclusion was not "
+        "guaranteed; raise the lock timeout above the slowest critical "
+        "section", name, kind.replace("_", " "))
+
+
 class MemoryStore(StateStore):
     """In-process store with redis-like TTL + lock semantics."""
 
@@ -241,8 +255,21 @@ class MemoryStore(StateStore):
         finally:
             async with self._lock_cond:
                 held = self._locks.get(name)
+                now = self._clock()
                 if held is not None and held[0] == token:
+                    if now >= held[1]:
+                        # race DETECTION (SURVEY.md §5.2 — the
+                        # reference only avoids): we held past the TTL,
+                        # so exclusion was not guaranteed for the tail
+                        # of this critical section. Size lock timeouts
+                        # to the slowest holder, or this becomes the
+                        # double-generation bug the locks exist to stop.
+                        _report_lock_hazard("overrun", name)
                     del self._locks[name]
+                else:
+                    # expired mid-hold and (possibly) reacquired by
+                    # another worker — two holders may have overlapped
+                    _report_lock_hazard("expired_in_hold", name)
                 self._lock_cond.notify_all()
 
     # -- durability (the reference gets this from redis persistence) ------

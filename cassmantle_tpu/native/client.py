@@ -18,7 +18,12 @@ import time
 import uuid
 from typing import AsyncIterator, Dict, Optional, Set
 
-from cassmantle_tpu.engine.store import LockTimeout, StateStore, Value
+from cassmantle_tpu.engine.store import (
+    LockTimeout,
+    StateStore,
+    Value,
+    _report_lock_hazard,
+)
 from cassmantle_tpu.utils.logging import get_logger
 
 log = get_logger("native.store")
@@ -235,7 +240,14 @@ class MantleStore(StateStore):
             yield
         finally:
             with contextlib.suppress(Exception):
-                await self._cmd(b"UNLOCK", name.encode(), token)
+                released = await self._cmd(b"UNLOCK", name.encode(), token)
+                # same hazard taxonomy as MemoryStore: :2 = our token
+                # outlived its TTL unclaimed (overrun); :0 = gone
+                # entirely, possibly reacquired by another worker
+                if released == 2:
+                    _report_lock_hazard("overrun", name)
+                elif released == 0:
+                    _report_lock_hazard("expired_in_hold", name)
 
     async def flushall(self) -> None:
         await self._cmd(b"FLUSHALL")

@@ -322,10 +322,15 @@ static void execute(Store& store, const std::vector<std::string>& argv,
     }
   } else if (cmd == "UNLOCK" && argv.size() == 3) {
     // UNLOCK name token -> :1 released | :0 not held by this token
+    // (TTL lapsed AND reacquired/steal-eligible) | :2 own token found
+    // but past its TTL (overrun: exclusion not guaranteed for the hold
+    // tail). The client maps :0/:2 onto the same hazard taxonomy as
+    // MemoryStore — see cassmantle_tpu/native/client.py.
     auto it = store.locks_.find(argv[1]);
     if (it != store.locks_.end() && it->second.token == argv[2]) {
+      bool live = now_s() < it->second.deadline;
       store.locks_.erase(it);
-      resp_int(out, 1);
+      resp_int(out, live ? 1 : 2);
     } else {
       resp_int(out, 0);
     }

@@ -233,3 +233,30 @@ async def test_snapshot_chunks_large_collections(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+@pytest.mark.asyncio
+async def test_lock_expired_in_hold_detected(store):
+    """The native client reports the same hazard taxonomy MemoryStore
+    detects: a hold past its TTL that nobody reclaimed is an 'overrun'
+    (UNLOCK :2); one another worker reacquired is 'expired_in_hold'
+    (UNLOCK :0)."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    key = "store.lock_overrun"
+    before = metrics.snapshot()["counters"].get(key, 0)
+    async with store.lock("l4", timeout=0.2, blocking_timeout=0.1):
+        await asyncio.sleep(0.3)   # hold past the TTL, unclaimed
+    after = metrics.snapshot()["counters"].get(key, 0)
+    assert after == before + 1
+
+    other = MantleStore(port=PORT)
+    key = "store.lock_expired_in_hold"
+    before = metrics.snapshot()["counters"].get(key, 0)
+    async with store.lock("l5", timeout=0.2, blocking_timeout=0.1):
+        await asyncio.sleep(0.3)
+        async with other.lock("l5", timeout=1.0, blocking_timeout=0.5):
+            pass      # another worker reacquired the expired lock
+    after = metrics.snapshot()["counters"].get(key, 0)
+    assert after == before + 1
+    await other.close()

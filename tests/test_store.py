@@ -137,3 +137,29 @@ async def test_snapshot_restore(tmp_path, store, clock):
     assert await store2.hget("prompt", "current") == b'{"tokens": []}'
     assert await store2.ttl("countdown") == pytest.approx(6.0)
     assert await store2.smembers("sessions") == {"s1"}
+
+
+@pytest.mark.asyncio
+async def test_lock_overrun_detected(store, clock):
+    """Race DETECTION (SURVEY §5.2 upgrade over the reference's silent
+    window): a hold that outlives its TTL is counted and logged —
+    'overrun' when still unclaimed, 'expired_in_hold' when another
+    worker took it meanwhile."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    before = metrics.snapshot()["counters"].get("store.lock_overrun", 0)
+    async with store.lock("l", timeout=2.0, blocking_timeout=0.1):
+        clock.t = 5.0   # critical section ran past the TTL
+    after = metrics.snapshot()["counters"].get("store.lock_overrun", 0)
+    assert after == before + 1
+
+    before = metrics.snapshot()["counters"].get(
+        "store.lock_expired_in_hold", 0)
+    async with store.lock("l2", timeout=2.0, blocking_timeout=0.1):
+        clock.t += 5.0  # expire...
+        async with store.lock("l2", timeout=2.0, blocking_timeout=0.1):
+            pass        # ...reacquired and released live by "another
+            # worker", so the outer release finds its token gone
+    after = metrics.snapshot()["counters"].get(
+        "store.lock_expired_in_hold", 0)
+    assert after == before + 1
