@@ -34,26 +34,84 @@ PROMPTS = [
 ]
 
 
-def probe_device(timeout_s: float = 180.0) -> None:
-    """Fail FAST if the accelerator is unreachable. A dead device
-    tunnel makes the first jax backend init block indefinitely (not
-    error), which would hang the whole bench run; probing in a
-    subprocess turns that into a clean, attributable failure."""
+def probe_device(attempt_timeout_s: float = 90.0) -> None:
+    """Wait for the accelerator, polling until ``BENCH_PROBE_DEADLINE_S``.
+
+    A dead device tunnel makes the first jax backend init block
+    indefinitely (not error); probing in a subprocess turns that into a
+    timed, attributable failure. Tunnel outages last hours while the
+    driver invokes this file exactly ONCE per round — a single one-shot
+    probe forfeits the round's only externally-credible perf channel
+    whenever that invocation lands inside an outage window. So: retry
+    every ~60 s until the deadline (default 45 min, env-tunable),
+    logging every attempt; a still-failing exit carries the attempt
+    count and window, proving the outage spanned the whole window.
+
+    A *deterministic* failure (import error, bad flag — fails fast with
+    a nonzero exit rather than hanging) is not an outage and surfaces
+    after two consecutive fast failures instead of burning the window.
+    """
+    import datetime
     import subprocess
 
+    deadline_s = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "2700"))
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((64, 64)); (x @ x).block_until_ready(); "
             "print(jax.devices())")
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s, capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        sys.exit(f"device probe timed out after {timeout_s:.0f}s — "
-                 f"accelerator tunnel down or wedged; not starting bench")
-    if proc.returncode != 0:
-        sys.exit("device probe failed:\n" + proc.stderr[-2000:])
+
+    def now() -> str:
+        return datetime.datetime.now(
+            datetime.timezone.utc).strftime("%H:%M:%SZ")
+
+    t_start = time.monotonic()
+    attempts = 0
+    fast_failures = 0
+    repeat_failures = 0
+    last_stderr = None
+    last_diag = ""
+    while True:
+        attempts += 1
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=attempt_timeout_s, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            proc = None
+            last_diag = (f"attempt hung past {attempt_timeout_s:.0f}s "
+                         f"(backend init blocked — tunnel down)")
+            fast_failures = 0
+            repeat_failures = 0
+            last_stderr = None
+        took = time.monotonic() - t0
+        if proc is not None:
+            if proc.returncode == 0:
+                print(f"[probe] {now()} attempt {attempts}: device up "
+                      f"({took:.1f}s)", file=sys.stderr)
+                return
+            last_diag = f"exit {proc.returncode}: {proc.stderr[-500:]}"
+            # two strikes for fast failures, three for slow ones that
+            # fail IDENTICALLY (e.g. a runtime version mismatch raised
+            # after a slow init) — either way deterministic, not outage
+            fast_failures = fast_failures + 1 if took < 10.0 else 0
+            repeat_failures = (repeat_failures + 1
+                               if proc.stderr == last_stderr else 1)
+            last_stderr = proc.stderr
+            if fast_failures >= 2 or repeat_failures >= 3:
+                sys.exit("device probe failed deterministically "
+                         f"({attempts} attempts, not an outage): "
+                         f"{last_diag}")
+        elapsed = time.monotonic() - t_start
+        print(f"[probe] {now()} attempt {attempts} failed "
+              f"({elapsed / 60:.1f}/{deadline_s / 60:.0f} min): "
+              f"{last_diag}", file=sys.stderr)
+        if elapsed + 5.0 >= deadline_s:
+            sys.exit(
+                f"device probe: {attempts} attempts over "
+                f"{elapsed / 60:.1f} min, all failed — accelerator "
+                f"tunnel down for the entire probe window; "
+                f"last: {last_diag}")
+        time.sleep(max(0.0, 60.0 - took))
 
 
 def _setup_jax():
