@@ -93,7 +93,8 @@ def main() -> None:
     # an explicit --weights keeps its shell meaning
     ap.add_argument("--weights",
                     default=os.path.join(REPO_ROOT, "weights"))
-    ap.add_argument("--out", default="CLIP_REPORT.json")
+    ap.add_argument("--out",
+                    default=os.path.join(REPO_ROOT, "CLIP_REPORT.json"))
     ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
     ap.add_argument("--presets",
                     default="ddim50,dpmpp25,deepcache,turbo,int8")

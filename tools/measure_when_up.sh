@@ -35,14 +35,41 @@ done
 
 set -x
 ENTRY_TIMEOUT=${BENCH_ENTRY_TIMEOUT:-2000}
-ENTRIES=11
+# entry count drives the outer timeout: derive it from bench.py (or the
+# entry selection, when one is set) so a suite grown since this line was
+# written can't be silently under-budgeted and killed mid-run
+if [ -n "${BENCH_SUITE_ENTRIES:-}" ]; then
+  ENTRIES=$(python -c "import os; print(len([e for e in \
+    os.environ['BENCH_SUITE_ENTRIES'].split(',') if e.strip()]))")
+else
+  ENTRIES=$(python -c 'import bench; print(len(bench.SUITE))')
+fi
+[ -n "$ENTRIES" ] || { echo "[watcher] could not count suite entries"; exit 1; }
 # per-entry retries are budgeted INSIDE each entry's timeout, so the
-# suite's worst case is entries x timeout; +1h slack for probes/io
-SUITE_TIMEOUT=$((ENTRIES * ENTRY_TIMEOUT + 3600))
+# suite's worst case is entries x timeout, plus bench.py's own probe
+# window (the tunnel can flap between our probe and bench's) and 1h
+# slack for io
+SUITE_TIMEOUT=$((ENTRIES * ENTRY_TIMEOUT + ${BENCH_PROBE_DEADLINE_S:-2700} + 3600))
 BENCH_ENTRY_TIMEOUT=$ENTRY_TIMEOUT \
   timeout "$SUITE_TIMEOUT" python bench.py --suite \
   2>BENCH_SUITE.stderr.log
 timeout 3600 python tools/profile_unet.py 2>&1 | tee PROFILE_UNET.txt
 timeout 3600 python tools/lm_int8_ab.py --tokens 64 --out LM_INT8_AB.json
+# Quality gate: on a weights-provisioned host this same command emits
+# the real_weights=true CLIP parity verdict (ddim50 vs dpmpp25 vs
+# deepcache vs turbo vs int8 — parity_vs_ddim50 per preset). Without
+# checkpoints a CLIP report would be plumbing-only noise, so skip it.
+# real_weights=true needs EVERY stage from a checkpoint (pipeline +
+# CLIP harness); a partial provision would burn a 2h run on a
+# plumbing-only report, so require all three — whole files or the
+# sharded form (<stem>-*.safetensors) that load_checkpoint_tensors merges
+have_ckpt() {
+  ls "weights/$1.safetensors" "weights/$1"-*.safetensors >/dev/null 2>&1
+}
+if have_ckpt clip_text && have_ckpt unet && have_ckpt vae; then
+  timeout 7200 python tools/clip_report.py --seeds 2
+else
+  echo "[watcher] weights/ missing checkpoints — skipping CLIP quality report"
+fi
 set +x
 echo "[watcher] measurements complete"
