@@ -281,15 +281,37 @@ def _wordlist_payload() -> bytes:
     }).encode()
 
 
+@functools.lru_cache(maxsize=1)
+def _wordlist_etag() -> str:
+    import hashlib
+
+    return '"' + hashlib.sha256(_wordlist_payload()).hexdigest()[:16] + '"'
+
+
 async def handle_wordlist(request: web.Request) -> web.Response:
     """Dictionary + stopwords for client-side spellcheck (replaces the
     reference's vendored hunspell dictionary + typo.js, §2 F3; the client
-    runs static/spell.js check/suggest over these words)."""
+    runs static/spell.js check/suggest over these words).
+
+    Served with a content-hash ETag and ``no-cache`` (= cache but
+    revalidate): a plain max-age would keep a regenerated lexicon — and
+    its suggestion ranking — stale in browsers for the full window after
+    a redeploy, while revalidation costs one conditional request
+    answered 304 with no body."""
+    etag = _wordlist_etag()
+    headers = {"Cache-Control": "no-cache", "ETag": etag}
+    inm = request.headers.get("If-None-Match", "")
+    # weak-aware, list-aware compare: a compressing reverse proxy may
+    # weaken the validator to W/"..." and clients echo it back that
+    # way; an exact string compare would silently defeat every 304
+    client_tags = {t.strip().removeprefix("W/")
+                   for t in inm.split(",") if t.strip()}
+    if etag in client_tags or inm.strip() == "*":
+        return web.Response(status=304, headers=headers)
     return web.Response(
         body=_wordlist_payload(),
         content_type="application/json",
-        # immutable per process; let the browser keep it for a day
-        headers={"Cache-Control": "public, max-age=86400"},
+        headers=headers,
     )
 
 

@@ -241,6 +241,7 @@ class Text2ImagePipeline:
             donor = share_params_with
             self.clip_params = donor.clip_params
             self.vae_params = donor.vae_params
+            unet_was_loaded = True
             if donor.cfg.models.unet_int8 == m.unet_int8:
                 self.unet_params = donor.unet_params
             elif m.unet_int8:
@@ -252,8 +253,13 @@ class Text2ImagePipeline:
             else:
                 # fp arm joining an int8 donor: dequantization is lossy,
                 # so load the fp tree properly
-                self.unet_params, _ = load_unet(None)
-            self.loaded_real_weights = donor.loaded_real_weights
+                self.unet_params, unet_was_loaded = load_unet(None)
+            # the donor's flag vouches only for tensors actually taken
+            # from the donor; the fp-joins-int8-donor arm re-loads its
+            # own UNet, and if the checkpoint vanished between the two
+            # constructions that arm is random-init and must say so
+            self.loaded_real_weights = (
+                donor.loaded_real_weights and unet_was_loaded)
         else:
             ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
             loaded_clip = maybe_load(

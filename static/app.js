@@ -179,7 +179,10 @@ async function submitGuesses() {
   // a word the player already saw held goes through on any later submit
   const fresh = flagged.filter((f) => !state.confirmed.has(f.word));
   if (fresh.length) {
-    fresh.forEach((f) => state.confirmed.add(f.word));
+    // hold ONLY the word whose hint is displayed: confirming the whole
+    // batch here would let the other flagged words sail through the
+    // next submit without the player ever seeing their suggestions
+    state.confirmed.add(fresh[0].word);
     $("feedback").textContent = fresh[0].hint;
     return;
   }

@@ -240,6 +240,29 @@ def test_unet_int8_pipeline_generates():
     assert out.shape[-1] == 3 and out.dtype == np.uint8
 
 
+def test_fp_arm_joining_int8_donor_reports_honest_weights_flag():
+    """The fp-joins-int8-donor path re-loads its own UNet (dequant is
+    lossy); the donor's loaded_real_weights flag must not vouch for a
+    load the donor never did — if the checkpoint is gone by then, the
+    fp arm is random-init and must report False (ADVICE r2)."""
+    import dataclasses
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    base = test_config()
+    int8_cfg = base.replace(models=dataclasses.replace(
+        base.models, unet_int8=True))
+    donor = Text2ImagePipeline(int8_cfg)
+    donor.loaded_real_weights = True  # simulate a weights-provisioned donor
+    fp = Text2ImagePipeline(base, share_params_with=donor)
+    assert fp.loaded_real_weights is False
+
+    # same-arch arm taking every tensor from the donor keeps its word
+    clone = Text2ImagePipeline(int8_cfg, share_params_with=donor)
+    assert clone.loaded_real_weights is True
+
+
 def test_lm_int8_ab_tool_smoke(tmp_path):
     """tools/lm_int8_ab.py runs both arms end to end at tiny dims on
     CPU and emits one comparable JSON report (the on-hardware A/B the

@@ -172,6 +172,22 @@ async def test_wordlist_endpoint():
         assert "stormy" in data["words"]
         # seed/style vocabulary is always guessable
         assert "watercolor" in data["words"]
+        # cache contract: content-hash ETag + revalidation, so a
+        # redeployed lexicon invalidates browser caches immediately
+        etag = res.headers["ETag"]
+        assert "no-cache" in res.headers["Cache-Control"]
+        res2 = await client.get("/wordlist",
+                                headers={"If-None-Match": etag})
+        assert res2.status == 304
+        assert res2.headers["ETag"] == etag
+        # a compressing proxy may weaken the validator; clients echo
+        # W/"..." (possibly in a list) and must still get the 304
+        weak = await client.get("/wordlist", headers={
+            "If-None-Match": f'W/{etag}, "other"'})
+        assert weak.status == 304
+        res3 = await client.get("/wordlist",
+                                headers={"If-None-Match": '"stale"'})
+        assert res3.status == 200
     finally:
         await client.close()
 
