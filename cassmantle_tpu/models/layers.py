@@ -55,6 +55,11 @@ class MultiHeadAttention(nn.Module):
     head_dim: Optional[int] = None
     out_dim: Optional[int] = None
     use_bias: bool = True
+    # The published SD UNet (data/manifests/unet_*.json) is bias-free on
+    # to_q/to_k/to_v but carries a bias on to_out.0 — the two knobs must
+    # be independent or real weights can't load faithfully. None -> same
+    # as use_bias.
+    out_bias: Optional[bool] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -104,7 +109,10 @@ class MultiHeadAttention(nn.Module):
         out = multi_head_attention(q, k, v, mask=mask, causal=causal)
         out = out.reshape(out.shape[:-2] + (inner,))
         out = nn.Dense(
-            out_dim, use_bias=self.use_bias, dtype=self.dtype, name="out"
+            out_dim,
+            use_bias=(self.use_bias if self.out_bias is None
+                      else self.out_bias),
+            dtype=self.dtype, name="out",
         )(out)
         if kv_out is not None:
             return out, kv_out

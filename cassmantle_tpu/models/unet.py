@@ -70,14 +70,16 @@ class BasicTransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, context):
         h = LayerNorm32(name="ln1")(x)
+        # bias-free q/k/v but biased out-projection: the published UNet
+        # layout (manifests unet_sd15/unet_sdxl: to_out.0 has a bias)
         x = x + MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype, use_bias=False,
-            name="self_attn",
+            out_bias=True, name="self_attn",
         )(h)
         h = LayerNorm32(name="ln2")(x)
         x = x + MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype, use_bias=False,
-            name="cross_attn",
+            out_bias=True, name="cross_attn",
         )(h, context=context)
         h = LayerNorm32(name="ln3")(x)
         x = x + GEGLU(

@@ -17,6 +17,7 @@ numerical equality after mapping.
 
 from __future__ import annotations
 
+import fnmatch
 import os
 import re
 from typing import Dict, Optional
@@ -64,17 +65,21 @@ def set_in_tree(tree: dict, path: str, value: np.ndarray) -> None:
 class Converter:
     """Accumulates {flax_path: array} then materializes a param tree.
 
-    ``ignore_prefixes``: source keys under these prefixes are expected
-    to go unused (e.g. the OTHER tower of a full CLIPModel checkpoint)
-    and are excluded from the unused-tensors warning, which otherwise
-    would fire spuriously on every real-weights boot and drown genuine
-    missing-tensor signals."""
+    ``ignore``: fnmatch patterns for source keys expected to go unused —
+    the OTHER tower of a full CLIPModel checkpoint, buffers persisted by
+    older library versions (``embeddings.position_ids``, GPT-2's causal
+    mask), the encoder half of a VAE file feeding the decoder converter.
+    Each converter's patterns are mirrored in its checkpoint manifest
+    (data/manifests/, tools/make_manifests.py), and the manifest tests
+    require consume-or-ignore to cover the authentic inventory exactly;
+    at load time they keep the unused-tensors warning from firing
+    spuriously and drowning genuine missing-tensor signals."""
 
     def __init__(self, tensors: Tensors, model_name: str,
-                 ignore_prefixes: tuple = ()) -> None:
+                 ignore: tuple = ()) -> None:
         self.src = tensors
         self.model_name = model_name
-        self.ignore_prefixes = ignore_prefixes
+        self.ignore = ignore
         self.out: Dict[str, np.ndarray] = {}
         self.used = set()
 
@@ -118,11 +123,24 @@ class Converter:
     def embed(self, src: str, dst: str) -> None:
         self.put(f"{dst}/embedding", self.take(f"{src}.weight"))
 
+    def ignored(self, key: str) -> bool:
+        return any(fnmatch.fnmatchcase(key, p) for p in self.ignore)
+
     def tree(self) -> dict:
-        unused = {
-            k for k in set(self.src) - self.used
-            if not any(k.startswith(p) for p in self.ignore_prefixes)
-        }
+        n_ignored = 0
+        unused = set()
+        for k in set(self.src) - self.used:
+            if self.ignored(k):
+                n_ignored += 1
+            else:
+                unused.add(k)
+        # per-stage key-match coverage: the one-line audit trail that a
+        # real-weights boot actually consumed its checkpoint (a silent
+        # partial match is how a boot degrades to random init unnoticed)
+        log.info("%s: consumed %d/%d checkpoint tensors "
+                 "(%d ignored-by-design) -> %d param arrays",
+                 self.model_name, len(self.used), len(self.src),
+                 n_ignored, len(self.out))
         if unused:
             log.warning("%s: %d source tensors unused (e.g. %s)",
                         self.model_name, len(unused),
@@ -139,12 +157,15 @@ class Converter:
 
 # A full CLIPModel checkpoint carries both towers + projections; each
 # single-tower converter expects the other side's tensors to go unused.
-_CLIP_FULL_EXTRAS = ("logit_scale",)
+# position_ids: arange buffers persisted by the save-era transformers
+# (<4.31) — present in the published files, carried as "optional" in
+# data/manifests/clip_full.json.
+_CLIP_FULL_EXTRAS = ("logit_scale", "*.embeddings.position_ids")
 
 
 def convert_clip_text(tensors: Tensors, num_layers: int) -> dict:
-    c = Converter(tensors, "clip_text", ignore_prefixes=(
-        "vision_model.", "visual_projection.", "text_projection.",
+    c = Converter(tensors, "clip_text", ignore=(
+        "vision_model.*", "visual_projection.*", "text_projection.*",
     ) + _CLIP_FULL_EXTRAS)
     p = "text_model."
     c.embed(f"{p}embeddings.token_embedding", "token_embedding")
@@ -173,8 +194,8 @@ def convert_clip_vision(tensors: Tensors, num_layers: int) -> dict:
     loads both towers from one file. Mirrors the reference's image-side
     quality check role (/root/reference/src/backend.py:270-295 trusts a
     hosted SDXL endpoint; we score images against prompts locally)."""
-    c = Converter(tensors, "clip_vision", ignore_prefixes=(
-        "text_model.", "text_projection.",
+    c = Converter(tensors, "clip_vision", ignore=(
+        "text_model.*", "text_projection.*",
     ) + _CLIP_FULL_EXTRAS)
     p = "vision_model."
     c.put("class_embedding", c.take(f"{p}embeddings.class_embedding"))
@@ -214,7 +235,10 @@ def convert_clip_text_projection(tensors: Tensors) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def convert_gpt2(tensors: Tensors, num_layers: int, hidden: int) -> dict:
-    c = Converter(tensors, "gpt2")
+    # the published gpt2 file persists the (re-derivable) causal-mask
+    # buffers of its save era (data/manifests/gpt2.json "optional")
+    c = Converter(tensors, "gpt2", ignore=(
+        "h.*.attn.bias", "h.*.attn.masked_bias"))
 
     def conv1d(src: str, dst: str) -> None:
         c.put(f"{dst}/kernel", c.take(f"{src}.weight"))
@@ -249,7 +273,9 @@ def convert_mistral(tensors: Tensors, num_layers: int) -> dict:
 
     RMSNorm has scale only (no bias); all projections are bias-free.
     """
-    c = Converter(tensors, "mistral")
+    # some save eras persist per-layer RoPE tables (manifest "optional")
+    c = Converter(tensors, "mistral", ignore=(
+        "model.layers.*.self_attn.rotary_emb.inv_freq",))
 
     def rmsnorm(src: str, dst: str) -> None:
         c.put(f"{dst}/scale", c.take(f"{src}.weight"))
@@ -279,7 +305,11 @@ def convert_mistral(tensors: Tensors, num_layers: int) -> dict:
 # ---------------------------------------------------------------------------
 
 def convert_minilm(tensors: Tensors, num_layers: int) -> dict:
-    c = Converter(tensors, "minilm")
+    # pooler: BertModel ships one, sentence-embedding scoring (mean
+    # pooling, ops/scorer.py) never runs it; position_ids: persisted
+    # buffer of the save era (data/manifests/minilm.json "optional")
+    c = Converter(tensors, "minilm", ignore=(
+        "pooler.*", "embeddings.position_ids"))
     c.embed("embeddings.word_embeddings", "word_embeddings")
     pos = c.take("embeddings.position_embeddings.weight")
     if c.has("embeddings.token_type_embeddings.weight"):
@@ -402,15 +432,27 @@ def _convert_vae_resblock(c: Converter, src: str, dst: str) -> None:
 
 
 def _convert_vae_attn(c: Converter, src: str, dst: str) -> None:
+    """Mid-block attention under EITHER published naming era.
+
+    The SD1.5-era VAE file (saved before the diffusers Attention
+    refactor) names these ``query/key/value/proj_attn``; the SDXL-era
+    file uses ``to_q/to_k/to_v/to_out.0``. Both inventories are pinned
+    in data/manifests/vae_{sd15,sdxl}.json — a converter that read only
+    the modern names would silently random-init on the actual SD1.5
+    artifact."""
     c.groupnorm(f"{src}.group_norm", f"{dst}/norm")
-    c.dense(f"{src}.to_q", f"{dst}/attn/q")
-    c.dense(f"{src}.to_k", f"{dst}/attn/k")
-    c.dense(f"{src}.to_v", f"{dst}/attn/v")
-    c.dense(f"{src}.to_out.0", f"{dst}/attn/out")
+    legacy = c.has(f"{src}.query.weight")
+    names = (("query", "key", "value", "proj_attn") if legacy
+             else ("to_q", "to_k", "to_v", "to_out.0"))
+    for theirs, ours in zip(names, ("q", "k", "v", "out")):
+        c.dense(f"{src}.{theirs}", f"{dst}/attn/{ours}")
 
 
 def convert_vae_decoder(tensors: Tensors, cfg) -> dict:
-    c = Converter(tensors, "vae_decoder")
+    # the full AutoencoderKL file also carries the encoder half + its
+    # quant_conv; this converter serves the decode hot path only
+    c = Converter(tensors, "vae_decoder", ignore=(
+        "encoder.*", "quant_conv.*"))
     c.conv("post_quant_conv", "post_quant_conv")  # ours: 1x1 Conv
     c.conv("decoder.conv_in", "conv_in")
     _convert_vae_resblock(c, "decoder.mid_block.resnets.0", "mid_res_0")
@@ -433,7 +475,8 @@ def convert_vae_decoder(tensors: Tensors, cfg) -> dict:
 
 def convert_vae_encoder(tensors: Tensors, cfg) -> dict:
     """Encoder half of the same AutoencoderKL checkpoint (img2img path)."""
-    c = Converter(tensors, "vae_encoder")
+    c = Converter(tensors, "vae_encoder", ignore=(
+        "decoder.*", "post_quant_conv.*"))
     c.conv("quant_conv", "quant_conv")
     c.conv("encoder.conv_in", "conv_in")
     levels = len(cfg.channel_mults)

@@ -36,9 +36,12 @@ from cassmantle_tpu.models.unet import UNet
 from cassmantle_tpu.models.vae import VAEDecoder, postprocess_images
 from cassmantle_tpu.models.weights import (
     convert_clip_text,
+    convert_clip_text_projection,
+    convert_tensors,
     convert_unet,
     convert_vae_decoder,
     init_params_cached,
+    load_checkpoint_tensors,
     maybe_load,
 )
 from cassmantle_tpu.ops.ddim import initial_latents
@@ -106,16 +109,32 @@ class SDXLPipeline:
                 cache_path=param_cache_path("clip_text", m.clip_text),
                 cast_to=m.param_dtype)
         )
+        # read once: the same file carries the tower AND its
+        # text_projection (data/manifests/clip_bigg.json)
+        t2 = load_checkpoint_tensors(
+            weights_dir, "clip_text_2.safetensors", "clip_text_2")
+        converted2 = convert_tensors(
+            t2, lambda t: convert_clip_text(t, m.clip_text_2.num_layers),
+            "clip_text_2", cast_to=m.param_dtype)
         self.clip2_params = (
-            maybe_load(weights_dir, "clip_text_2.safetensors",
-                       lambda t: convert_clip_text(
-                           t, m.clip_text_2.num_layers),
-                       "clip_text_2", cast_to=m.param_dtype)
-            or init_params_cached(
+            converted2
+            if converted2 is not None
+            else init_params_cached(
                 self.clip2, 11, ids,
                 cache_path=param_cache_path("clip_text_2", m.clip_text_2),
                 cast_to=m.param_dtype)
         )
+        # Real SDXL conditions on text_projection(pooled) — the
+        # CLIPTextModelWithProjection text_embeds — not the raw pooled
+        # state; skipping the (square, 1280x1280) projection would
+        # silently divert from the published model the moment real
+        # weights load. Random init keeps the identity behavior.
+        self.clip2_proj = None
+        if converted2 is not None and t2 is not None \
+                and "text_projection.weight" in t2:
+            self.clip2_proj = jnp.asarray(
+                convert_clip_text_projection(t2),
+                dtype=jnp.dtype(m.param_dtype))
         lat_hw = cfg.sampler.image_size // self.vae_scale
         lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
         t0 = jnp.zeros((1,), dtype=jnp.int32)
@@ -154,6 +173,7 @@ class SDXLPipeline:
         # (see Text2ImagePipeline note on compile payloads).
         self._params = {
             "clip": self.clip_params, "clip2": self.clip2_params,
+            "clip2_proj": self.clip2_proj,  # None -> empty pytree leaf
             "unet": self.unet_params, "vae": self.vae_params,
         }
 
@@ -170,7 +190,10 @@ class SDXLPipeline:
         context = jnp.concatenate(
             [out1["penultimate"], out2["penultimate"]], axis=-1
         )
-        return context, out2["pooled"]
+        pooled = out2["pooled"]
+        if self.clip2_proj is not None:  # static at trace time
+            pooled = pooled @ params["clip2_proj"]
+        return context, pooled
 
     def _time_ids(self, batch: int) -> jax.Array:
         """SDXL size/crop conditioning: (orig_h, orig_w, crop_t, crop_l,

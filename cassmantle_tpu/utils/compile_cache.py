@@ -37,7 +37,15 @@ def enable_compile_cache() -> None:
         pass
 
 
+# Bump when a module's param STRUCTURE changes without a config change
+# (the digest below only sees the config repr) — a stale cached init
+# tree would otherwise load with missing/extra leaves and fail at apply.
+# v2: UNet attention out-projections gained their published bias.
+_PARAM_SCHEMA_VERSION = 2
+
+
 def param_cache_path(name: str, cfg) -> str:
-    """Stable cache file name for (model name, config)."""
-    digest = hashlib.sha256(repr(cfg).encode()).hexdigest()[:16]
+    """Stable cache file name for (model name, config, schema)."""
+    digest = hashlib.sha256(
+        f"v{_PARAM_SCHEMA_VERSION}:{cfg!r}".encode()).hexdigest()[:16]
     return os.path.join(PARAM_CACHE_DIR, f"{name}-{digest}.safetensors")

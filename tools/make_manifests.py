@@ -1,0 +1,449 @@
+"""Generate authoritative checkpoint key manifests (data/manifests/).
+
+VERDICT r2 #3: the weight-conversion tests previously fabricated torch
+checkpoints from an in-repo reverse mapping — written by the same hand,
+against the same assumptions, as the converters they test. A naming or
+layout mismatch with the real published artifacts would keep every test
+green while the first real-weights boot silently fell back to random
+init. These manifests pin the converters to the *authentic* inventories
+(tests/test_weights.py feeds them through the real converters and
+requires 100% key coverage; see ``manifest tests`` there).
+
+Authority, per model family (this container has zero egress, so the
+inventories cannot be downloaded — they are derived from sources that
+are themselves authoritative):
+
+- transformers-hosted checkpoints (CLIP, GPT-2, MiniLM/BERT, Mistral):
+  the safetensors files on the Hub hold exactly the torch
+  ``state_dict()`` of the corresponding transformers model class at the
+  published config. We instantiate those classes on the ``meta`` device
+  (no weights, no memory) and dump name+shape — the same library code
+  path that produced the real files' key sets. Known save-era deltas
+  (buffers persisted by older transformers, e.g.
+  ``embeddings.position_ids``; GPT-2's causal-mask buffers) are appended
+  as ``optional`` keys: present in the published files, absent from a
+  modern state_dict, and semantically ignorable.
+- diffusers-hosted checkpoints (SD1.5/SDXL UNet + VAE — diffusers is
+  NOT installed here): generated from the diffusers state-dict naming
+  grammar at the published configs, then validated against the exact
+  published parameter totals (SD1.5 UNet 859,520,964; SDXL UNet
+  2,567,463,684; AutoencoderKL 83,653,863). A wrong block layout,
+  missing tensor, or wrong shape cannot sum to the right total.
+  Era note: the SD1.5-era VAE file predates the diffusers Attention
+  refactor and names mid-block attention ``query/key/value/proj_attn``;
+  the SDXL-era file uses ``to_q/to_k/to_v/to_out.0``. Both manifests
+  encode their own era's naming and models/weights.py accepts both.
+
+Usage:  python tools/make_manifests.py [--check]
+  --check: regenerate in-memory and diff against data/manifests/
+           (non-zero exit on drift) instead of writing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO_ROOT, "data", "manifests")
+
+# Exact published totals (parameters, not tensors). The transformers
+# ones double-check our config transcription; the diffusers ones are the
+# primary validation of the grammar-generated inventories.
+EXPECTED_TOTALS = {
+    "clip_full": 427_616_513,     # openai/clip-vit-large-patch14
+    "clip_bigg": 694_659_840,     # SDXL text_encoder_2 (OpenCLIP bigG)
+    "gpt2": 124_439_808,          # gpt2 (small), tied head not re-counted
+    "minilm": 22_713_216,         # all-MiniLM-L6-v2 (BertModel incl pooler)
+    "mistral": 7_241_732_096,     # Mistral-7B-Instruct-v0.1
+    "unet_sd15": 859_520_964,     # SD1.5 UNet2DConditionModel
+    "unet_sdxl": 2_567_463_684,   # SDXL-base UNet2DConditionModel
+    "vae_sd15": 83_653_863,       # AutoencoderKL (full: enc+dec+quant)
+    "vae_sdxl": 83_653_863,       # same architecture, SDXL-era naming
+}
+
+
+# ---------------------------------------------------------------- meta dumps
+
+def _meta_state_shapes(model) -> dict:
+    return {k: list(v.shape) for k, v in model.state_dict().items()}
+
+
+def manifest_clip_full() -> tuple:
+    import torch
+    from transformers import CLIPConfig, CLIPModel
+
+    cfg = CLIPConfig(
+        projection_dim=768,
+        text_config=dict(
+            vocab_size=49408, hidden_size=768, intermediate_size=3072,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=77, projection_dim=768),
+        vision_config=dict(
+            hidden_size=1024, intermediate_size=4096,
+            num_hidden_layers=24, num_attention_heads=16,
+            image_size=224, patch_size=14, projection_dim=768),
+    )
+    with torch.device("meta"):
+        shapes = _meta_state_shapes(CLIPModel(cfg))
+    # persisted by the save-era transformers (<4.31); in the real file
+    optional = {
+        "text_model.embeddings.position_ids": [1, 77],
+        "vision_model.embeddings.position_ids": [1, 257],
+    }
+    return shapes, optional
+
+
+def manifest_clip_bigg() -> tuple:
+    import torch
+    from transformers import CLIPTextConfig, CLIPTextModelWithProjection
+
+    cfg = CLIPTextConfig(
+        vocab_size=49408, hidden_size=1280, intermediate_size=5120,
+        num_hidden_layers=32, num_attention_heads=20,
+        max_position_embeddings=77, projection_dim=1280,
+        hidden_act="gelu",
+    )
+    with torch.device("meta"):
+        shapes = _meta_state_shapes(CLIPTextModelWithProjection(cfg))
+    optional = {"text_model.embeddings.position_ids": [1, 77]}
+    return shapes, optional
+
+
+def manifest_gpt2() -> tuple:
+    import torch
+    from transformers import GPT2Config, GPT2Model
+
+    with torch.device("meta"):
+        shapes = _meta_state_shapes(GPT2Model(GPT2Config()))
+    # the published file carries the (re-derivable) causal-mask buffers
+    optional = {}
+    for i in range(12):
+        optional[f"h.{i}.attn.bias"] = [1, 1, 1024, 1024]
+        optional[f"h.{i}.attn.masked_bias"] = []
+    return shapes, optional
+
+
+def manifest_minilm() -> tuple:
+    import torch
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(
+        vocab_size=30522, hidden_size=384, num_hidden_layers=6,
+        num_attention_heads=12, intermediate_size=1536,
+        max_position_embeddings=512,
+    )
+    with torch.device("meta"):
+        shapes = _meta_state_shapes(BertModel(cfg))
+    optional = {"embeddings.position_ids": [1, 512]}
+    return shapes, optional
+
+
+def manifest_mistral() -> tuple:
+    import torch
+    from transformers import MistralConfig, MistralForCausalLM
+
+    cfg = MistralConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32,
+        num_key_value_heads=8, head_dim=128, max_position_embeddings=32768,
+        sliding_window=4096, tie_word_embeddings=False,
+    )
+    with torch.device("meta"):
+        shapes = _meta_state_shapes(MistralForCausalLM(cfg))
+    # some save eras persist per-layer RoPE tables
+    optional = {f"model.layers.{i}.self_attn.rotary_emb.inv_freq": [64]
+                for i in range(32)}
+    return shapes, optional
+
+
+# ------------------------------------------------------- diffusers grammars
+
+def _resblock(out, src, cin, cout, temb=None):
+    out[f"{src}.norm1.weight"] = [cin]
+    out[f"{src}.norm1.bias"] = [cin]
+    out[f"{src}.conv1.weight"] = [cout, cin, 3, 3]
+    out[f"{src}.conv1.bias"] = [cout]
+    if temb:
+        out[f"{src}.time_emb_proj.weight"] = [cout, temb]
+        out[f"{src}.time_emb_proj.bias"] = [cout]
+    out[f"{src}.norm2.weight"] = [cout]
+    out[f"{src}.norm2.bias"] = [cout]
+    out[f"{src}.conv2.weight"] = [cout, cout, 3, 3]
+    out[f"{src}.conv2.bias"] = [cout]
+    if cin != cout:
+        out[f"{src}.conv_shortcut.weight"] = [cout, cin, 1, 1]
+        out[f"{src}.conv_shortcut.bias"] = [cout]
+
+
+def _spatial_transformer(out, src, ch, depth, ctx, linear_proj):
+    out[f"{src}.norm.weight"] = [ch]
+    out[f"{src}.norm.bias"] = [ch]
+    proj_shape = [ch, ch] if linear_proj else [ch, ch, 1, 1]
+    out[f"{src}.proj_in.weight"] = proj_shape
+    out[f"{src}.proj_in.bias"] = [ch]
+    for k in range(depth):
+        t = f"{src}.transformer_blocks.{k}"
+        for n in ("norm1", "norm2", "norm3"):
+            out[f"{t}.{n}.weight"] = [ch]
+            out[f"{t}.{n}.bias"] = [ch]
+        for attn, kv in (("attn1", ch), ("attn2", ctx)):
+            out[f"{t}.{attn}.to_q.weight"] = [ch, ch]
+            out[f"{t}.{attn}.to_k.weight"] = [ch, kv]
+            out[f"{t}.{attn}.to_v.weight"] = [ch, kv]
+            out[f"{t}.{attn}.to_out.0.weight"] = [ch, ch]
+            out[f"{t}.{attn}.to_out.0.bias"] = [ch]
+        out[f"{t}.ff.net.0.proj.weight"] = [8 * ch, ch]  # GEGLU
+        out[f"{t}.ff.net.0.proj.bias"] = [8 * ch]
+        out[f"{t}.ff.net.2.weight"] = [ch, 4 * ch]
+        out[f"{t}.ff.net.2.bias"] = [ch]
+    out[f"{src}.proj_out.weight"] = proj_shape
+    out[f"{src}.proj_out.bias"] = [ch]
+
+
+def _unet_manifest(chs, blocks, attn_levels, depths, ctx, temb, add_dim,
+                   linear_proj) -> dict:
+    out: dict = {}
+    base = chs[0]
+    levels = len(chs)
+    out["conv_in.weight"] = [base, 4, 3, 3]
+    out["conv_in.bias"] = [base]
+    out["time_embedding.linear_1.weight"] = [temb, base]
+    out["time_embedding.linear_1.bias"] = [temb]
+    out["time_embedding.linear_2.weight"] = [temb, temb]
+    out["time_embedding.linear_2.bias"] = [temb]
+    if add_dim:
+        out["add_embedding.linear_1.weight"] = [temb, add_dim]
+        out["add_embedding.linear_1.bias"] = [temb]
+        out["add_embedding.linear_2.weight"] = [temb, temb]
+        out["add_embedding.linear_2.bias"] = [temb]
+
+    skips = [base]
+    prev = base
+    for lvl, ch in enumerate(chs):
+        for b in range(blocks):
+            _resblock(out, f"down_blocks.{lvl}.resnets.{b}", prev, ch, temb)
+            if attn_levels[lvl] and depths[lvl]:
+                _spatial_transformer(
+                    out, f"down_blocks.{lvl}.attentions.{b}", ch,
+                    depths[lvl], ctx, linear_proj)
+            prev = ch
+            skips.append(ch)
+        if lvl != levels - 1:
+            out[f"down_blocks.{lvl}.downsamplers.0.conv.weight"] = \
+                [ch, ch, 3, 3]
+            out[f"down_blocks.{lvl}.downsamplers.0.conv.bias"] = [ch]
+            skips.append(ch)
+
+    mid = chs[-1]
+    mid_depth = max([d for lvl, d in enumerate(depths)
+                     if attn_levels[lvl]] or [1])
+    _resblock(out, "mid_block.resnets.0", mid, mid, temb)
+    _spatial_transformer(out, "mid_block.attentions.0", mid, mid_depth,
+                         ctx, linear_proj)
+    _resblock(out, "mid_block.resnets.1", mid, mid, temb)
+
+    for i in range(levels):
+        lvl = levels - 1 - i
+        ch = chs[lvl]
+        for b in range(blocks + 1):
+            skip = skips.pop()
+            _resblock(out, f"up_blocks.{i}.resnets.{b}", prev + skip, ch,
+                      temb)
+            if attn_levels[lvl] and depths[lvl]:
+                _spatial_transformer(
+                    out, f"up_blocks.{i}.attentions.{b}", ch, depths[lvl],
+                    ctx, linear_proj)
+            prev = ch
+        if lvl != 0:
+            out[f"up_blocks.{i}.upsamplers.0.conv.weight"] = [ch, ch, 3, 3]
+            out[f"up_blocks.{i}.upsamplers.0.conv.bias"] = [ch]
+
+    out["conv_norm_out.weight"] = [base]
+    out["conv_norm_out.bias"] = [base]
+    out["conv_out.weight"] = [4, base, 3, 3]
+    out["conv_out.bias"] = [4]
+    return out
+
+
+def manifest_unet_sd15() -> tuple:
+    return _unet_manifest(
+        chs=(320, 640, 1280, 1280), blocks=2,
+        attn_levels=(True, True, True, False), depths=(1, 1, 1, 1),
+        ctx=768, temb=1280, add_dim=0, linear_proj=False), {}
+
+
+def manifest_unet_sdxl() -> tuple:
+    return _unet_manifest(
+        chs=(320, 640, 1280), blocks=2,
+        attn_levels=(False, True, True), depths=(0, 2, 10),
+        ctx=2048, temb=1280, add_dim=2816, linear_proj=True), {}
+
+
+def _vae_attn(out, src, ch, era_new: bool):
+    if era_new:  # SDXL-era diffusers Attention naming
+        out[f"{src}.group_norm.weight"] = [ch]
+        out[f"{src}.group_norm.bias"] = [ch]
+        names = ("to_q", "to_k", "to_v", "to_out.0")
+    else:  # SD1.5-era AttentionBlock naming
+        out[f"{src}.group_norm.weight"] = [ch]
+        out[f"{src}.group_norm.bias"] = [ch]
+        names = ("query", "key", "value", "proj_attn")
+    for n in names:
+        out[f"{src}.{n}.weight"] = [ch, ch]
+        out[f"{src}.{n}.bias"] = [ch]
+
+
+def _vae_resblock(out, src, cin, cout):
+    _resblock(out, src, cin, cout, temb=None)
+
+
+def manifest_vae(era_new: bool) -> tuple:
+    chs = (128, 256, 512, 512)
+    blocks = 2
+    levels = len(chs)
+    latent = 4
+    out: dict = {}
+
+    # encoder
+    out["encoder.conv_in.weight"] = [chs[0], 3, 3, 3]
+    out["encoder.conv_in.bias"] = [chs[0]]
+    prev = chs[0]
+    for lvl, ch in enumerate(chs):
+        for b in range(blocks):
+            _vae_resblock(out, f"encoder.down_blocks.{lvl}.resnets.{b}",
+                          prev, ch)
+            prev = ch
+        if lvl != levels - 1:
+            out[f"encoder.down_blocks.{lvl}.downsamplers.0.conv.weight"] \
+                = [ch, ch, 3, 3]
+            out[f"encoder.down_blocks.{lvl}.downsamplers.0.conv.bias"] = [ch]
+    mid = chs[-1]
+    _vae_resblock(out, "encoder.mid_block.resnets.0", mid, mid)
+    _vae_attn(out, "encoder.mid_block.attentions.0", mid, era_new)
+    _vae_resblock(out, "encoder.mid_block.resnets.1", mid, mid)
+    out["encoder.conv_norm_out.weight"] = [mid]
+    out["encoder.conv_norm_out.bias"] = [mid]
+    out["encoder.conv_out.weight"] = [2 * latent, mid, 3, 3]
+    out["encoder.conv_out.bias"] = [2 * latent]
+    out["quant_conv.weight"] = [2 * latent, 2 * latent, 1, 1]
+    out["quant_conv.bias"] = [2 * latent]
+    out["post_quant_conv.weight"] = [latent, latent, 1, 1]
+    out["post_quant_conv.bias"] = [latent]
+
+    # decoder
+    out["decoder.conv_in.weight"] = [mid, latent, 3, 3]
+    out["decoder.conv_in.bias"] = [mid]
+    _vae_resblock(out, "decoder.mid_block.resnets.0", mid, mid)
+    _vae_attn(out, "decoder.mid_block.attentions.0", mid, era_new)
+    _vae_resblock(out, "decoder.mid_block.resnets.1", mid, mid)
+    prev = mid
+    for i in range(levels):
+        lvl = levels - 1 - i
+        ch = chs[lvl]
+        for b in range(blocks + 1):
+            _vae_resblock(out, f"decoder.up_blocks.{i}.resnets.{b}",
+                          prev, ch)
+            prev = ch
+        if lvl != 0:
+            out[f"decoder.up_blocks.{i}.upsamplers.0.conv.weight"] = \
+                [ch, ch, 3, 3]
+            out[f"decoder.up_blocks.{i}.upsamplers.0.conv.bias"] = [ch]
+    out["decoder.conv_norm_out.weight"] = [chs[0]]
+    out["decoder.conv_norm_out.bias"] = [chs[0]]
+    out["decoder.conv_out.weight"] = [3, chs[0], 3, 3]
+    out["decoder.conv_out.bias"] = [3]
+    return out, {}
+
+
+SOURCES = {
+    "clip_full": ("openai/clip-vit-large-patch14", "model.safetensors",
+                  manifest_clip_full),
+    "clip_bigg": ("stabilityai/stable-diffusion-xl-base-1.0",
+                  "text_encoder_2/model.safetensors", manifest_clip_bigg),
+    "gpt2": ("gpt2", "model.safetensors", manifest_gpt2),
+    "minilm": ("sentence-transformers/all-MiniLM-L6-v2",
+               "model.safetensors", manifest_minilm),
+    "mistral": ("mistralai/Mistral-7B-Instruct-v0.1",
+                "model-0000*-of-00002.safetensors (merged)",
+                manifest_mistral),
+    "unet_sd15": ("runwayml/stable-diffusion-v1-5",
+                  "unet/diffusion_pytorch_model.safetensors",
+                  manifest_unet_sd15),
+    "unet_sdxl": ("stabilityai/stable-diffusion-xl-base-1.0",
+                  "unet/diffusion_pytorch_model.safetensors",
+                  manifest_unet_sdxl),
+    "vae_sd15": ("runwayml/stable-diffusion-v1-5",
+                 "vae/diffusion_pytorch_model.safetensors",
+                 lambda: manifest_vae(era_new=False)),
+    "vae_sdxl": ("stabilityai/stable-diffusion-xl-base-1.0",
+                 "vae/diffusion_pytorch_model.safetensors",
+                 lambda: manifest_vae(era_new=True)),
+}
+
+
+def build(name: str) -> dict:
+    repo, remote, fn = SOURCES[name]
+    tensors, optional = fn()
+    total = sum(int(np_prod(s)) for s in tensors.values())
+    expected = EXPECTED_TOTALS[name]
+    if total != expected:
+        sys.exit(f"{name}: generated inventory sums to {total:,} params, "
+                 f"published total is {expected:,} — grammar/config wrong")
+    return {
+        "source": {"repo": repo, "file": remote},
+        "params_total": total,
+        "tensor_count": len(tensors),
+        # keys some artifact eras carry on top of `tensors` (persisted
+        # buffers); converters must tolerate-and-ignore them
+        "optional": optional,
+        "tensors": dict(sorted(tensors.items())),
+    }
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= s
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="diff against data/manifests instead of writing")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of manifest names")
+    args = ap.parse_args()
+
+    names = (args.only.split(",") if args.only else list(SOURCES))
+    os.makedirs(OUT_DIR, exist_ok=True)
+    drift = []
+    for name in names:
+        manifest = build(name)
+        path = os.path.join(OUT_DIR, f"{name}.json")
+        if args.check:
+            on_disk = json.load(open(path)) if os.path.exists(path) else None
+            if on_disk != manifest:
+                drift.append(name)
+                print(f"[check] {name}: DRIFT")
+            else:
+                print(f"[check] {name}: ok "
+                      f"({manifest['tensor_count']} tensors, "
+                      f"{manifest['params_total']:,} params)")
+            continue
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=False)
+            f.write("\n")
+        print(f"[write] {name}: {manifest['tensor_count']} tensors, "
+              f"{manifest['params_total']:,} params -> {path}")
+    if drift:
+        print(f"{len(drift)} manifests drifted: {drift}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
