@@ -1,0 +1,203 @@
+"""Multi-host (DCN-leg) dryrun: 2 real processes, one global mesh.
+
+SURVEY.md §5.8 commits this framework to ``jax.distributed.initialize``
+for v5e-16-style multi-host serving; :func:`mesh.maybe_init_distributed`
+implements the join. VERDICT r2 called it the one SURVEY-promised leg
+with zero executions — nothing anywhere ran a second process. This
+module closes that: the parent spawns ``n_procs`` real OS processes,
+each pinned to CPU with ``local_devices`` virtual devices, that
+
+1. join one coordinator via ``maybe_init_distributed`` (the exact
+   production code path, driven by the CASSMANTLE_* env contract),
+2. build ONE cross-process ``Mesh`` over all ``n_procs*local_devices``
+   devices (``make_mesh`` sees the global device list),
+3. run an explicit shard_map psum across the cross-process dp axis, and
+4. run a jit'd dp train step (value_and_grad with dp-sharded batch,
+   replicated params) whose gradient psum XLA lowers onto the
+   cross-process channel — asserting loss and gradient equal the
+   single-host reference computed locally from the same seed.
+
+On real v5e-16 the same join runs with the TPU backend and the psum
+rides ICI/DCN instead of the CPU channel; everything above the backend
+is identical. Run standalone: ``python -m
+cassmantle_tpu.parallel.multihost_dryrun`` (parent mode — spawns and
+checks the children; the children re-enter this module with
+CASSMANTLE_COORDINATOR set).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_OK_MARKER = "MULTIHOST-DRYRUN-OK"
+
+
+def _child() -> None:
+    # Pin BEFORE any jax backend use: the parent strips its own
+    # XLA_FLAGS from our env so the device count here is authoritative.
+    from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+    pin_cpu_platform(
+        virtual_devices=True,
+        device_count=int(os.environ["CASSMANTLE_DRYRUN_LOCAL_DEVICES"]))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cassmantle_tpu.config import MeshConfig
+    from cassmantle_tpu.parallel.mesh import (
+        batch_sharding,
+        make_mesh,
+        maybe_init_distributed,
+        replicated,
+    )
+
+    assert maybe_init_distributed(), "coordinator env vars missing"
+    pid = jax.process_index()
+    n_procs = jax.process_count()
+    assert n_procs == int(os.environ["CASSMANTLE_NUM_PROCS"]), n_procs
+    local = jax.local_device_count()
+    n_dev = len(jax.devices())
+    assert n_dev == n_procs * local, (n_dev, n_procs, local)
+
+    mesh = make_mesh(MeshConfig(dp=-1, pp=1, tp=1, sp=1, ep=1))
+
+    # 1) explicit collective across the cross-process dp axis
+    ones = jax.make_array_from_process_local_data(
+        batch_sharding(mesh), np.ones((local, 1), np.float32))
+    total = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(jnp.sum(x), "dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P()))(ones)
+    assert float(total) == float(n_dev), float(total)
+
+    # 2) dp train step: dp-sharded batch, replicated params; GSPMD
+    #    inserts the cross-process gradient psum
+    dim, batch = 16, n_dev * 2
+    rng = np.random.default_rng(0)  # same seed everywhere
+    x_full = rng.standard_normal((batch, dim)).astype(np.float32)
+    y_full = rng.standard_normal((batch,)).astype(np.float32)
+    w0 = np.linspace(-1.0, 1.0, dim).astype(np.float32)
+    shard = batch // n_procs
+    sl = slice(pid * shard, (pid + 1) * shard)
+    dp = NamedSharding(mesh, P("dp"))
+    x_g = jax.make_array_from_process_local_data(dp, x_full[sl])
+    y_g = jax.make_array_from_process_local_data(dp, y_full[sl])
+    w_g = jax.device_put(jnp.asarray(w0), replicated(mesh))
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    step = jax.jit(
+        jax.value_and_grad(loss_fn),
+        in_shardings=(replicated(mesh), dp, dp),
+        out_shardings=(replicated(mesh), replicated(mesh)))
+    loss, grad = step(w_g, x_g, y_g)
+    w1 = w_g - 0.1 * grad  # the actual SGD update, on-mesh
+
+    resid = x_full @ w0 - y_full
+    ref_loss = float(np.mean(resid ** 2))
+    ref_grad = (2.0 / batch) * x_full.T @ resid
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), ref_grad,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(w1), w0 - 0.1 * ref_grad,
+                               rtol=1e-4, atol=1e-5)
+
+    print(f"[multihost] proc {pid}/{n_procs}: {n_dev} global devices, "
+          f"psum={float(total):.0f}, loss={float(loss):.6f} ok",
+          flush=True)
+    if pid == 0:
+        print(_OK_MARKER, flush=True)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_multihost_dryrun(n_procs: int = 2, local_devices: int = 4,
+                         timeout_s: float = 420.0) -> str:
+    """Spawn the children, wait, raise on any failure; returns proc-0
+    output (contains the OK marker)."""
+    from cassmantle_tpu.utils.xla_flags import (
+        COLLECTIVE_TIMEOUT_FLAGS,
+        virtual_device_flag,
+    )
+
+    port = _free_port()
+    # children must NOT inherit the parent's XLA_FLAGS: a pre-existing
+    # --xla_force_host_platform_device_count (e.g. conftest's 8) would
+    # win over ours by append_xla_flags' first-wins rule
+    base = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    flags = " ".join(
+        (virtual_device_flag(local_devices),) + COLLECTIVE_TIMEOUT_FLAGS)
+    procs = []
+    for pid in range(n_procs):
+        env = dict(
+            base, XLA_FLAGS=flags, JAX_PLATFORMS="cpu",
+            CASSMANTLE_COORDINATOR=f"localhost:{port}",
+            CASSMANTLE_NUM_PROCS=str(n_procs),
+            CASSMANTLE_PROC_ID=str(pid),
+            CASSMANTLE_DRYRUN_LOCAL_DEVICES=str(local_devices),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "cassmantle_tpu.parallel.multihost_dryrun"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    import time
+
+    deadline = time.monotonic() + timeout_s  # shared, not per-process
+    outs = [None] * n_procs
+    timed_out = False
+    for i, p in enumerate(procs):
+        try:
+            outs[i], _ = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            break
+    if timed_out:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        # reap + drain pipes so the hung child's own output (the only
+        # diagnostic of WHERE it hung) makes it into the error
+        for i, p in enumerate(procs):
+            if outs[i] is None:
+                try:
+                    outs[i], _ = p.communicate(timeout=10)
+                except Exception:
+                    outs[i] = ""
+        raise RuntimeError(
+            f"multihost dryrun timed out after {timeout_s:.0f}s; "
+            "children said:\n"
+            + "\n---\n".join((o or "")[-2000:] for o in outs))
+    bad = [i for i, p in enumerate(procs) if p.returncode != 0]
+    if bad:
+        raise RuntimeError(
+            f"multihost dryrun failed in process(es) {bad}:\n"
+            + "\n---\n".join(outs[i][-2000:] for i in bad))
+    if _OK_MARKER not in outs[0]:
+        raise RuntimeError(f"marker missing from proc 0:\n{outs[0][-2000:]}")
+    return outs[0]
+
+
+def main() -> None:
+    if os.environ.get("CASSMANTLE_COORDINATOR"):
+        _child()
+    else:
+        out = run_multihost_dryrun()
+        sys.stdout.write(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Multi-host (jax.distributed) dryrun — the SURVEY §5.8 DCN leg.
+
+Spawns 2 REAL OS processes (subprocesses of this test) that join one
+coordinator through the production ``maybe_init_distributed`` env
+contract, build a single cross-process mesh over 2x4 virtual CPU
+devices, and verify an explicit cross-process psum plus a dp train step
+(loss + gradient) against the single-host reference. See
+cassmantle_tpu/parallel/multihost_dryrun.py for what the children run.
+"""
+
+from cassmantle_tpu.parallel.multihost_dryrun import (
+    _OK_MARKER,
+    run_multihost_dryrun,
+)
+
+
+def test_two_process_distributed_join_and_dp_step():
+    out = run_multihost_dryrun(n_procs=2, local_devices=4)
+    assert _OK_MARKER in out
+    assert "8 global devices" in out
