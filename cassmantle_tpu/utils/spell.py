@@ -21,6 +21,8 @@ from typing import Iterable, List
 _WORD_RE = re.compile(r"^[a-zA-Z][a-zA-Z'-]*$")
 _DOUBLED = re.compile(r"^(.+?)([bdgklmnprt])\2(ed|ing)$")
 _ALPHABET = "abcdefghijklmnopqrstuvwxyz"
+# KEEP IN LOCKSTEP with static/spell.js PREFIXES (test_spell_rule_parity)
+_PREFIXES = ("un", "re", "dis", "mis", "pre", "non", "over", "under", "out", "semi", "anti")  # noqa: E501
 
 
 class Spell:
@@ -63,9 +65,41 @@ class Spell:
         if w.endswith("est"):
             add(w[:-3])
             add(w[:-2])
+        # y-inflections (happier/happiest/happily -> happy)
+        if w.endswith("ier"):
+            add(w[:-3] + "y")
+        if w.endswith("iest"):
+            add(w[:-4] + "y")
+        if w.endswith("ily"):
+            add(w[:-3] + "y")
+        # f/fe plurals (wolves -> wolf, knives -> knife)
+        if w.endswith("ves"):
+            add(w[:-3] + "f")
+            add(w[:-3] + "fe")
+        # derivational suffixes (brightness, hopeful, stormless,
+        # greenish, movement, drinkable)
+        if w.endswith("ness"):
+            add(w[:-4])
+        if w.endswith("ful"):
+            add(w[:-3])
+        if w.endswith("less"):
+            add(w[:-4])
+        if w.endswith("ish"):
+            add(w[:-3])
+        if w.endswith("ment"):
+            add(w[:-4])
+        if w.endswith("able"):
+            add(w[:-4])
+            add(w[:-4] + "e")
         m = _DOUBLED.match(w)
         if m:  # doubled final consonant before -ed/-ing (stopped -> stop)
             add(m.group(1) + m.group(2))
+        # prefix stripping composes with every suffix stem above
+        # (unfolded -> folded -> fold); one prefix layer, remainder >= 3
+        for s in list(out):
+            for p in _PREFIXES:
+                if s.startswith(p) and len(s) - len(p) >= 3:
+                    out.append(s[len(p):])
         return out
 
     def check(self, word: str) -> bool:

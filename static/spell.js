@@ -15,6 +15,9 @@
 
 "use strict";
 
+/* KEEP IN LOCKSTEP with cassmantle_tpu/utils/spell.py _PREFIXES */
+const PREFIXES = ["un", "re", "dis", "mis", "pre", "non", "over", "under", "out", "semi", "anti"];
+
 class Spell {
   constructor(words) {
     /* insertion order IS the frequency rank (the served wordlist is
@@ -41,9 +44,32 @@ class Spell {
     if (w.endsWith("ly")) add(w.slice(0, -2));
     if (w.endsWith("er")) { add(w.slice(0, -2)); add(w.slice(0, -1)); }
     if (w.endsWith("est")) { add(w.slice(0, -3)); add(w.slice(0, -2)); }
+    // y-inflections (happier/happiest/happily -> happy)
+    if (w.endsWith("ier")) add(w.slice(0, -3) + "y");
+    if (w.endsWith("iest")) add(w.slice(0, -4) + "y");
+    if (w.endsWith("ily")) add(w.slice(0, -3) + "y");
+    // f/fe plurals (wolves -> wolf, knives -> knife)
+    if (w.endsWith("ves")) { add(w.slice(0, -3) + "f"); add(w.slice(0, -3) + "fe"); }
+    // derivational suffixes (brightness, hopeful, stormless, greenish,
+    // movement, drinkable)
+    if (w.endsWith("ness")) add(w.slice(0, -4));
+    if (w.endsWith("ful")) add(w.slice(0, -3));
+    if (w.endsWith("less")) add(w.slice(0, -4));
+    if (w.endsWith("ish")) add(w.slice(0, -3));
+    if (w.endsWith("ment")) add(w.slice(0, -4));
+    if (w.endsWith("able")) { add(w.slice(0, -4)); add(w.slice(0, -4) + "e"); }
     // doubled final consonant before -ed/-ing (stopped -> stop)
     const m = w.match(/^(.+?)([bdgklmnprt])\2(ed|ing)$/);
     if (m) add(m[1] + m[2]);
+    // prefix stripping composes with every suffix stem above
+    // (unfolded -> folded -> fold); one prefix layer, remainder >= 3
+    for (const s of out.slice()) {
+      for (const p of PREFIXES) {
+        if (s.startsWith(p) && s.length - p.length >= 3) {
+          out.push(s.slice(p.length));
+        }
+      }
+    }
     return out;
   }
 
