@@ -128,8 +128,14 @@ class Game:
         """Per-session progressive reveal (server.py:129-133)."""
         radius = await self._reveal_radius(session)
         image = await self.rounds.fetch_current_image()
-        with metrics.timer("game.blur_s"):
-            return self.blur_fn(image, radius)
+
+        def render() -> np.ndarray:
+            # same off-loop rule as _render_bucket: blur is CPU/device
+            # work that must not stall the event loop
+            with metrics.timer("game.blur_s"):
+                return self.blur_fn(image, radius)
+
+        return await asyncio.to_thread(render)
 
     async def fetch_masked_image_b64(self, session: str) -> str:
         """The hot-request form of the reveal: blur radii quantize to
@@ -194,10 +200,19 @@ class Game:
 
         if raw is None:
             raw = await self.rounds.fetch_current_image_bytes()
-        image = decode_jpeg(raw)
-        with metrics.timer("game.blur_s"):
-            blurred = self.blur_fn(image, bucket)
-        encoded = image_to_base64(np.asarray(blurred))
+
+        def render() -> str:
+            # CPU-bound decode+blur+encode runs OFF the event loop: a
+            # bucket miss must not stall the 1 Hz WS clock pushes or
+            # concurrent requests for the tens of ms it takes (PIL and
+            # JPEG codecs release the GIL; the TPU blur op just blocks
+            # this worker thread on device dispatch)
+            image = decode_jpeg(raw)
+            with metrics.timer("game.blur_s"):
+                blurred = self.blur_fn(image, bucket)
+            return image_to_base64(np.asarray(blurred))
+
+        encoded = await asyncio.to_thread(render)
         # cache only if the version is provably still current: bumps
         # happen after bytes land, so unchanged version == our bytes
         # belong to it (isinstance check skips the re-read for legacy

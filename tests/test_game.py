@@ -310,6 +310,31 @@ async def test_masked_image_b64_single_flight():
 
 
 @pytest.mark.asyncio
+async def test_masked_image_render_runs_off_event_loop():
+    """The decode+blur+encode of a bucket miss is CPU work that must not
+    stall the event loop (the 1 Hz clock pushes ride it) — it runs in a
+    worker thread (VERDICT r2 weak #7)."""
+    import threading
+
+    game, _ = make_game()
+    await game.rounds.startup()
+    await game.init_client("c0")
+
+    loop_thread = threading.current_thread()
+    render_threads = []
+    orig = game.blur_fn
+
+    def recording_blur(image, radius):
+        render_threads.append(threading.current_thread())
+        return orig(image, radius)
+
+    game.blur_fn = recording_blur
+    await game.fetch_masked_image_b64("c0")
+    assert render_threads and all(
+        t is not loop_thread for t in render_threads)
+
+
+@pytest.mark.asyncio
 async def test_masked_image_b64_waiter_cancellation_isolated():
     """One waiter's cancellation (client disconnect mid-request) must
     not cancel the shared render or fail the other coalesced waiters."""
