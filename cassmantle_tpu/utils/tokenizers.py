@@ -126,9 +126,25 @@ class BPETokenizer(Tokenizer):
         else:
             self.eos_id = vocab.get("<|endoftext|>", self.vocab_size - 1)
             self.pad_id = self.eos_id
+        # Authentic split patterns (stdlib-re renderings of the published
+        # ones; [^\W\d_] = unicode letter). GPT-2: contractions, space-
+        # prefixed letter/digit/punct runs, then whitespace — with the
+        # trailing-whitespace lookahead, and NO newline collapsing (the
+        # real vocab carries Ġ/Ċ whitespace symbols). CLIP: punctuation
+        # splits off words and every digit stands alone — real CLIP
+        # tokenizes "depicting:" as depicting</w> :</w>, which the
+        # checkpoint's merge table expects; whitespace-splitting would
+        # mis-tokenize any word adjacent to punctuation under real
+        # weights.
+        # the published punct class is [^\s\p{L}\p{N}]+ which INCLUDES
+        # '_' ('\w' would exclude it — an unmatched '_' silently
+        # vanishes in finditer)
         self._word_re = re.compile(
-            r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?\d+| ?[^\sA-Za-z\d]+|\s+"
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+"
+            r"| ?(?:[^\s\w]|_)+|\s+(?!\S)|\s+"
         )
+        self._clip_re = re.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d|[^\W\d_]+|\d|(?:[^\s\w]|_)+")
         self._cache: Dict[str, Tuple[str, ...]] = {}
 
     @staticmethod
@@ -151,41 +167,43 @@ class BPETokenizer(Tokenizer):
         if chunk in self._cache:
             symbols = self._cache[chunk]
         else:
+            sym = tuple(self.byte_enc[b] for b in chunk.encode("utf-8"))
             if self.style == "clip":
-                sym = tuple(chunk[:-1]) + (chunk[-1] + "</w>",)
-            else:
-                sym = tuple(self.byte_enc[b] for b in chunk.encode("utf-8"))
+                # real CLIP byte-encodes the chunk too, then marks the
+                # last symbol as word-final before merging
+                sym = sym[:-1] + (sym[-1] + "</w>",)
             symbols = _bpe_merge(sym, self.ranks)
             self._cache[chunk] = symbols
         unk = self.vocab.get("<|unk|>", self.eos_id)
         return [self.vocab.get(s, unk) for s in symbols]
 
     def encode(self, text: str) -> List[int]:
+        import re
+
         if self.style == "clip":
-            words = text.lower().split()
+            # whitespace-clean + lowercase, as the published tokenizer
+            text = re.sub(r"\s+", " ", text.strip()).lower()
             ids = [self.bos_id]
-            for w in words:
-                ids.extend(self._encode_word(w))
+            for m in self._clip_re.finditer(text):
+                ids.extend(self._encode_word(m.group(0)))
             return ids
         ids: List[int] = []
         for m in self._word_re.finditer(text):
-            chunk = m.group(0)
-            if self.style == "gpt2" and chunk.isspace() and chunk != " ":
-                chunk = " "
-            ids.extend(self._encode_word(chunk))
+            ids.extend(self._encode_word(m.group(0)))
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:
         parts = [self.inv_vocab.get(int(i), "") for i in ids]
+        text = "".join(parts)
         if self.style == "clip":
-            text = "".join(parts)
-            text = text.replace("</w>", " ")
             for tok in ("<|startoftext|>", "<|endoftext|>"):
                 text = text.replace(tok, "")
-            return text.strip()
-        text = "".join(parts)
+            # symbols are byte-encoded (mirror of encode), so strip the
+            # word marks first, then byte-decode
+            text = text.replace("</w>", " ")
         data = bytes(self.byte_dec.get(c, 32) for c in text)
-        return data.decode("utf-8", errors="ignore")
+        out = data.decode("utf-8", errors="ignore")
+        return out.strip() if self.style == "clip" else out
 
 
 # ---------------------------------------------------------------------------

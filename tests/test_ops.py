@@ -147,6 +147,45 @@ def test_bpe_tokenizer_merges():
     assert t.decode(ids) == "low"
 
 
+def test_clip_tokenizer_authentic_split():
+    """Real CLIP splits punctuation off words, tokenizes every digit
+    alone, and lowercases: 'On: on' must reach on</w> :</w> on</w> —
+    the whole-word tokens the checkpoint's merge table expects. A
+    whitespace-only split would fuse ':' into the word chunk, which can
+    never merge to the whole-word token (real-weights mis-tokenization
+    of every prompt containing punctuation; all serving prompts do)."""
+    from cassmantle_tpu.utils.tokenizers import _bytes_to_unicode
+
+    b2u = _bytes_to_unicode()
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1,
+             "on</w>": 2, ":</w>": 3, "2</w>": 4, "4</w>": 5,
+             "o": 6, "n</w>": 7}
+    merges = [("o", "n</w>")]
+    t = BPETokenizer(vocab, merges, style="clip")
+    assert t.encode("On: on") == [0, 2, 3, 2]
+    # digits stand alone, each word-final
+    assert t.encode("24") == [0, 4, 5]
+    # whitespace cleanup: runs collapse before splitting
+    assert t.encode("  on \n on ") == [0, 2, 2]
+    assert t.decode([0, 2, 3, 2]) == "on : on"
+
+
+def test_gpt2_tokenizer_preserves_newlines():
+    """The real GPT-2 vocab carries whitespace symbols (Ġ space, Ċ
+    newline); collapsing '\\n' to a space would corrupt any multi-line
+    decode under real weights."""
+    from cassmantle_tpu.utils.tokenizers import _bytes_to_unicode
+
+    b2u = _bytes_to_unicode()
+    chars = {c: b2u[ord(c)] for c in "low \n"}
+    vocab = {v: i for i, v in enumerate(chars.values())}
+    vocab["<|endoftext|>"] = len(vocab)
+    t = BPETokenizer(vocab, [], style="gpt2")
+    ids = t.encode("low\nlow")
+    assert vocab[chars["\n"]] in ids
+    assert t.decode(ids) == "low\nlow"
+
+
 def test_wordpiece_tokenizer():
     vocab = {tok: i for i, tok in enumerate(
         ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "light", "##house", "sea"]
