@@ -23,6 +23,9 @@ class ClipTextConfig:
     num_layers: int = 12
     num_heads: int = 12
     max_positions: int = 77
+    # ViT-L/14 trained with quick_gelu; OpenCLIP bigG with exact gelu —
+    # the published hidden_act of each checkpoint.
+    hidden_act: str = "quick_gelu"
     # SDXL adds a second, bigger text tower (OpenCLIP ViT-bigG); same module,
     # different dims.
     @staticmethod
@@ -34,6 +37,7 @@ class ClipTextConfig:
             num_layers=32,
             num_heads=20,
             max_positions=77,
+            hidden_act="gelu",
         )
 
 

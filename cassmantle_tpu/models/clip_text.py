@@ -21,8 +21,12 @@ from cassmantle_tpu.config import ClipTextConfig
 from cassmantle_tpu.models.layers import (
     MultiHeadAttention,
     TransformerMLP,
+    exact_gelu,
     quick_gelu,
 )
+
+# published hidden_act per tower: ViT-L quick_gelu, OpenCLIP bigG gelu
+_ACTS = {"quick_gelu": quick_gelu, "gelu": exact_gelu}
 
 
 class ClipBlock(nn.Module):
@@ -31,15 +35,15 @@ class ClipBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask):
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
         h = MultiHeadAttention(
             num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
         )(h, mask=mask)
         x = x + h
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
         h = TransformerMLP(
             intermediate=self.cfg.intermediate_size,
-            activation=quick_gelu,
+            activation=_ACTS[self.cfg.hidden_act],
             dtype=self.dtype,
             name="mlp",
         )(h)
@@ -75,7 +79,7 @@ class ClipTextEncoder(nn.Module):
                 # ``hidden_states[-2]`` / clip-skip-1 convention.
                 penultimate = x
 
-        hidden = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        hidden = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_final")(x)
         # CLIP pools at the EOT token = argmax of ids (highest id is EOT).
         eot = jnp.argmax(input_ids, axis=-1)
         pooled = jnp.take_along_axis(

@@ -50,11 +50,11 @@ class ClipVisionBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
         x = x + MultiHeadAttention(
             num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
         )(h)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
         x = x + TransformerMLP(
             intermediate=self.cfg.intermediate_size,
             activation=quick_gelu, dtype=self.dtype, name="mlp",
@@ -93,10 +93,10 @@ class ClipVisionEncoder(nn.Module):
             (n_pos, cfg.hidden_size),
         )
         x = x + pos[None].astype(dtype)
-        x = nn.LayerNorm(dtype=jnp.float32, name="pre_ln")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="pre_ln")(x)
         for i in range(cfg.num_layers):
             x = ClipVisionBlock(cfg, dtype, name=f"block_{i}")(x)
-        pooled = nn.LayerNorm(dtype=jnp.float32, name="post_ln")(x[:, 0])
+        pooled = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="post_ln")(x[:, 0])
         proj = self.param(
             "projection", nn.initializers.normal(0.02),
             (cfg.hidden_size, cfg.projection_dim),

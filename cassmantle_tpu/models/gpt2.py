@@ -33,7 +33,7 @@ class GPT2Block(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, kv_cache=None, return_kv=False,
                  causal=False):
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
         attn_out = MultiHeadAttention(
             num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
         )(h, mask=mask, kv_cache=kv_cache, return_kv=return_kv,
@@ -43,7 +43,7 @@ class GPT2Block(nn.Module):
         else:
             a, kv = attn_out, None
         x = x + a
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
         x = x + TransformerMLP(
             intermediate=self.cfg.hidden_size * 4, dtype=self.dtype,
             name="mlp",
@@ -68,7 +68,7 @@ class GPT2LM(nn.Module):
             GPT2Block(self.cfg, dtype, name=f"block_{i}")
             for i in range(self.cfg.num_layers)
         ]
-        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self.ln_f = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_f")
 
     def _logits(self, hidden: jax.Array) -> jax.Array:
         # weight-tied LM head (fp32 matmul keeps greedy argmax stable)

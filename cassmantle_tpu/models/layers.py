@@ -150,8 +150,15 @@ class GEGLU(nn.Module):
 
 
 def quick_gelu(x):
-    """CLIP's activation: x * sigmoid(1.702 x)."""
+    """OpenAI CLIP's activation: x * sigmoid(1.702 x)."""
     return x * jax.nn.sigmoid(1.702 * x)
+
+
+def exact_gelu(x):
+    """Erf-based GELU — the published BERT and OpenCLIP-bigG activation
+    (jax.nn.gelu defaults to the tanh approximation, which is GPT-2's
+    gelu_new but NOT what those checkpoints were trained with)."""
+    return jax.nn.gelu(x, approximate=False)
 
 
 class LayerNorm32(nn.Module):

@@ -15,7 +15,11 @@ import jax
 import jax.numpy as jnp
 
 from cassmantle_tpu.config import MiniLMConfig
-from cassmantle_tpu.models.layers import MultiHeadAttention, TransformerMLP
+from cassmantle_tpu.models.layers import (
+    MultiHeadAttention,
+    TransformerMLP,
+    exact_gelu,
+)
 
 
 class BertBlock(nn.Module):
@@ -29,12 +33,14 @@ class BertBlock(nn.Module):
         a = MultiHeadAttention(
             num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
         )(x, mask=mask)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + a)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, name="ln1")(x + a)
+        # published BERT uses the EXACT (erf) gelu, not the tanh approx
         h = TransformerMLP(
             intermediate=self.cfg.intermediate_size, dtype=self.dtype,
+            activation=exact_gelu,
             name="mlp",
         )(x)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + h)
+        return nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, name="ln2")(x + h)
 
 
 class MiniLMEncoder(nn.Module):
@@ -53,7 +59,7 @@ class MiniLMEncoder(nn.Module):
             (self.cfg.max_positions, self.cfg.hidden_size),
         )
         x = x + pos[None, :s].astype(dtype)
-        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, name="embed_ln")(x)
 
         attend = attention_mask.astype(bool)[:, None, None, :]
         for i in range(self.cfg.num_layers):

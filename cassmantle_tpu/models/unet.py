@@ -100,7 +100,9 @@ class SpatialTransformer(nn.Module):
     def __call__(self, x, context):
         b, h, w, c = x.shape
         residual = x
-        x = GroupNorm32(name="norm")(x)
+        # diffusers' Transformer2DModel hardcodes eps=1e-6 for this norm
+        # (unlike the resblock norms at the 1e-5 norm_eps default)
+        x = GroupNorm32(epsilon=1e-6, name="norm")(x)
         x = nn.Dense(c, dtype=self.dtype, name="proj_in")(x)
         x = x.reshape(b, h * w, c)
         for i in range(self.depth):

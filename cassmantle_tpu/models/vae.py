@@ -31,11 +31,11 @@ class VAEResBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = GroupNorm32(name="norm1")(x)
+        h = GroupNorm32(epsilon=1e-6, name="norm1")(x)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=1,
                     dtype=self.dtype, name="conv1")(h)
-        h = GroupNorm32(name="norm2")(h)
+        h = GroupNorm32(epsilon=1e-6, name="norm2")(h)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=1,
                     dtype=self.dtype, name="conv2")(h)
@@ -52,7 +52,7 @@ class VAEAttnBlock(nn.Module):
     def __call__(self, x):
         b, h, w, c = x.shape
         residual = x
-        x = GroupNorm32(name="norm")(x)
+        x = GroupNorm32(epsilon=1e-6, name="norm")(x)
         x = x.reshape(b, h * w, c)
         x = MultiHeadAttention(num_heads=1, dtype=self.dtype, name="attn")(x)
         return residual + x.reshape(b, h, w, c)
@@ -87,7 +87,7 @@ class VAEDecoder(nn.Module):
                 x = nn.Conv(ch, (3, 3), padding=1, dtype=dtype,
                             name=f"up_{lvl}_upsample")(x)
 
-        x = GroupNorm32(name="norm_out")(x)
+        x = GroupNorm32(epsilon=1e-6, name="norm_out")(x)
         x = nn.silu(x)
         x = nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
                     name="conv_out")(x)
@@ -115,7 +115,7 @@ class VAEEncoder(nn.Module):
         x = VAEResBlock(ch, dtype, name="mid_res_0")(x)
         x = VAEAttnBlock(dtype, name="mid_attn")(x)
         x = VAEResBlock(ch, dtype, name="mid_res_1")(x)
-        x = GroupNorm32(name="norm_out")(x)
+        x = GroupNorm32(epsilon=1e-6, name="norm_out")(x)
         x = nn.silu(x)
         moments = nn.Conv(cfg.latent_channels * 2, (3, 3), padding=1,
                           dtype=jnp.float32, name="conv_out")(x)
