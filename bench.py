@@ -156,9 +156,18 @@ def bench_sd15(weights_dir: str) -> dict:
     """North-star: SD1.5 512², 50-step CFG DDIM, images/sec/chip."""
     from cassmantle_tpu.config import FrameworkConfig
 
-    return _bench_txt2img(
+    res = _bench_txt2img(
         FrameworkConfig, "sd15_512px_ddim50_images_per_sec_per_chip",
         weights_dir)
+    # Fixed-config physical ceiling (BASELINE.md): ~0.78 TF/UNet-forward
+    # x 100 CFG forwards/image on a ~197 TFLOP/s bf16 v5e chip = ~2.51
+    # img/s at MFU 1.0 — within the fixed DDIM-50 config, optimization
+    # is measured as fraction of THIS, not of the workload-level 4.0.
+    ceiling = float(os.environ.get("BENCH_CEILING_IPS", "2.51"))
+    if ceiling > 0:
+        res["fraction_of_fixed_config_ceiling"] = round(
+            res["value"] / ceiling, 4)
+    return res
 
 
 def bench_sd15_fast(weights_dir: str) -> dict:

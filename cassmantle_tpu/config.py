@@ -194,6 +194,11 @@ class SamplerConfig:
     guidance_scale: float = 7.5
     eta: float = 0.0
     image_size: int = 512
+    # CFG negative conditioning — the reference passes this to its
+    # hosted diffusion call (backend.py:284); "" disables (plain
+    # unconditional arm). Tokenized host-side per batch, so changing it
+    # never recompiles.
+    negative_prompt: str = "blurry, distorted, fake, abstract, negative"
     # Deep-feature reuse (DeepCache-style): steps run in full/shallow
     # pairs, the shallow pass reusing the previous step's deepest-level
     # activations (~60% of full compute; ddim only, even num_steps).
@@ -348,8 +353,12 @@ def test_config() -> FrameworkConfig:
             # tight and bit-stable
             param_dtype="float32",
         ),
+        # negative_prompt neutral: with random-init weights the uncond
+        # arm's content only adds noise to statistical test properties;
+        # the wiring is covered explicitly (test_pipeline.py)
         sampler=SamplerConfig(num_steps=4, image_size=64, max_new_tokens=8,
-                              min_new_tokens=2, prompt_pad_len=16),
+                              min_new_tokens=2, prompt_pad_len=16,
+                              negative_prompt=""),
         game=GameConfig(time_per_prompt=2.0, lock_timeout=5.0,
                         acquire_timeout=0.5),
     )

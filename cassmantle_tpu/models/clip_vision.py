@@ -110,10 +110,17 @@ CLIP_IMAGE_STD = (0.26862954, 0.26130258, 0.27577711)
 
 
 def preprocess_for_clip(images_u8: jax.Array, size: int = 224) -> jax.Array:
-    """uint8 (B, H, W, 3) -> resized, CLIP-normalized float32."""
+    """uint8 (B, H, W, 3) -> resized, CLIP-normalized float32.
+
+    Bicubic resize like the published CLIP eval transform (whose
+    shortest-side-resize + center-crop equals a straight resize for the
+    square images our pipelines emit)."""
     x = images_u8.astype(jnp.float32) / 255.0
     b, h, w, c = x.shape
-    x = jax.image.resize(x, (b, size, size, c), "bilinear")
+    # clamp the cubic overshoot: the reference transform resizes uint8
+    # (implicitly clamped) before normalizing
+    x = jnp.clip(jax.image.resize(x, (b, size, size, c), "cubic"),
+                 0.0, 1.0)
     mean = jnp.asarray(CLIP_IMAGE_MEAN)
     std = jnp.asarray(CLIP_IMAGE_STD)
     return (x - mean) / std

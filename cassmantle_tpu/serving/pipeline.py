@@ -333,7 +333,8 @@ class Text2ImagePipeline:
         """prompts -> (B, H, W, 3) uint8. One compiled graph per batch."""
         padded, n = pad_prompts_to_dp(prompts, self.dp)
         ids = jnp.asarray(self._tokenize(padded))
-        uncond = jnp.asarray(self._tokenize([""] * len(padded)))
+        uncond = jnp.asarray(self._tokenize(
+            [self.cfg.sampler.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
         with metrics.timer("pipeline.t2i_s"):
             images = self._sample(self._params, ids, uncond, rng)
@@ -417,7 +418,8 @@ class Text2ImagePipeline:
             np.asarray(images, dtype=np.float32) / 127.5 - 1.0
         )
         ids = jnp.asarray(self._tokenize(list(prompts)))
-        uncond = jnp.asarray(self._tokenize([""] * len(prompts)))
+        uncond = jnp.asarray(self._tokenize(
+            [self.cfg.sampler.negative_prompt] * len(prompts)))
         params = dict(self._params, vae_enc=self.enc_params)
         with metrics.timer("pipeline.i2i_s"):
             out = self._i2i_fns[k](

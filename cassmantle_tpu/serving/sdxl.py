@@ -244,7 +244,8 @@ class SDXLPipeline:
 
         padded, n = pad_prompts_to_dp(prompts, self.dp)
         ids = jnp.asarray(self._tokenize(padded))
-        uncond = jnp.asarray(self._tokenize([""] * len(padded)))
+        uncond = jnp.asarray(self._tokenize(
+            [self.cfg.sampler.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
         with metrics.timer("pipeline.sdxl_s"):
             images = self._sample(self._params, ids, uncond, rng)
