@@ -125,15 +125,17 @@ def _setup_jax():
     return jax
 
 
-def _bench_txt2img(config_factory, metric: str, weights_dir: str) -> dict:
+def _bench_txt2img(config_factory, metric: str, weights_dir: str,
+                   batch: int = None) -> dict:
     """Shared txt2img harness (one timing methodology for every image
     preset): build pipeline, warmup compile, TIMED_ROUNDS batches,
     report images/sec/chip."""
     jax = _setup_jax()
     from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
 
+    batch = BATCH if batch is None else batch
     pipe = Text2ImagePipeline(config_factory(), weights_dir=weights_dir)
-    prompts = (PROMPTS * ((BATCH + len(PROMPTS) - 1) // len(PROMPTS)))[:BATCH]
+    prompts = (PROMPTS * ((batch + len(PROMPTS) - 1) // len(PROMPTS)))[:batch]
     pipe.generate(prompts, seed=0)  # warmup / compile
 
     n_images = 0
@@ -149,6 +151,7 @@ def _bench_txt2img(config_factory, metric: str, weights_dir: str) -> dict:
         "value": round(ips_per_chip, 4),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC, 4),
+        "batch": batch,
     }
 
 
@@ -168,6 +171,18 @@ def bench_sd15(weights_dir: str) -> dict:
         res["fraction_of_fixed_config_ceiling"] = round(
             res["value"] / ceiling, 4)
     return res
+
+
+def bench_sd15_b8(weights_dir: str) -> dict:
+    """Batch-size A/B vs the `sd15` entry: same fixed DDIM-50 config at
+    DOUBLE the batch (2x BENCH_BATCH, so the comparison survives an env
+    override) — the cheapest MXU-utilization lever; if img/s/chip rises
+    here, the serving batch should too. Both entries record ``batch``."""
+    from cassmantle_tpu.config import FrameworkConfig
+
+    return _bench_txt2img(
+        FrameworkConfig, "sd15_512px_ddim50_2xbatch_images_per_sec_per_chip",
+        weights_dir, batch=2 * BATCH)
 
 
 def bench_sd15_fast(weights_dir: str) -> dict:
@@ -463,6 +478,7 @@ SUITE = {
     "scorer": bench_scorer,
     "gpt2": bench_gpt2,
     "sd15": bench_sd15,
+    "sd15_b8": bench_sd15_b8,
     "sd15_fast": bench_sd15_fast,
     "sd15_deepcache": bench_sd15_deepcache,
     "sd15_turbo": bench_sd15_turbo,
