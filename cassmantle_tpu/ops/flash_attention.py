@@ -33,9 +33,24 @@ from jax.experimental.pallas import tpu as pltpu
 # 1024-blocks measured ~2x faster than 512 at the UNet's level-0 site
 # (S=4096, d=40, bh=64) on v5e: fewer grid programs amortize the per-
 # program MXU setup over more work. (1024, 40)-bf16 q/k/v tiles plus two
-# (1024, 1024)-fp32 intermediates stay well inside VMEM.
-BLOCK_Q = 1024
-BLOCK_K = 1024
+# (1024, 1024)-fp32 intermediates stay well inside VMEM. Env-tunable so
+# a hardware window can sweep block sizes without an edit-reinstall
+# cycle (tools/profile_unet.py A/Bs per-resolution; each sweep point is
+# its own process, so import-time read is right).
+import os as _os
+
+def _block_env(name: str, default: int) -> int:
+    v = int(_os.environ.get(name, str(default)))
+    if v < 128 or v % 128:
+        # fail at import, not mid-sweep: 0 would ZeroDivision in the
+        # dispatch gate, negatives slip through it into a negative
+        # Pallas grid, and non-lane-multiples can't tile the MXU
+        raise ValueError(f"{name}={v}: need a positive multiple of 128")
+    return v
+
+
+BLOCK_Q = _block_env("CASSMANTLE_FLASH_BLOCK_Q", 1024)
+BLOCK_K = _block_env("CASSMANTLE_FLASH_BLOCK_K", 1024)
 MAX_HEAD_DIM = 256
 _NEG_INF = -1e30
 

@@ -101,3 +101,35 @@ def test_train_checkpoint_roundtrip(tmp_path):
         np.asarray(restored["params"]["w"]), np.asarray(params["w"]) * 2
     )
     ckpt.close()
+
+
+def test_checkpoint_restores_across_mesh_shapes(tmp_path):
+    """Elastic resume: a state saved under one mesh restores into a
+    DIFFERENT mesh's shardings (orbax StandardRestore reshards to the
+    template) — the grow-the-slice / degraded-slice recovery path."""
+    import jax
+
+    from cassmantle_tpu.config import MeshConfig
+    from cassmantle_tpu.parallel.mesh import batch_sharding, make_mesh
+    from cassmantle_tpu.utils.checkpoint import TrainCheckpointer
+
+    devices = jax.devices()
+    mesh_a = make_mesh(MeshConfig(dp=2, pp=1, tp=1, sp=1, ep=1),
+                       devices=devices[:2])
+    mesh_b = make_mesh(MeshConfig(dp=4, pp=1, tp=1, sp=1, ep=1),
+                       devices=devices[:4])
+    w = jnp.arange(16.0).reshape(8, 2)
+    wa = jax.device_put(w, batch_sharding(mesh_a))
+
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(1, {"w": wa}, opt_state=())
+    template = {
+        "params": {"w": jax.device_put(jnp.zeros_like(w),
+                                       batch_sharding(mesh_b))},
+        "opt_state": (),
+    }
+    restored = ckpt.restore(template=template)
+    rw = restored["params"]["w"]
+    assert rw.sharding.is_equivalent_to(batch_sharding(mesh_b), rw.ndim)
+    np.testing.assert_allclose(np.asarray(rw), np.asarray(w))
+    ckpt.close()
