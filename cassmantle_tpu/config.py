@@ -203,10 +203,14 @@ class SamplerConfig:
     # pairs, the shallow pass reusing the previous step's deepest-level
     # activations (~60% of full compute; ddim only, even num_steps).
     deepcache: bool = False
-    # Text decode (reference decodes 32-96 new tokens, backend.py:250-255).
+    # Text decode (reference decodes 32-96 new tokens, backend.py:250-255;
+    # its hosted call samples greedily — temperature 0 is reference
+    # parity, >0 enables top-k Gumbel sampling for story variety).
     min_new_tokens: int = 32
     max_new_tokens: int = 96
     prompt_pad_len: int = 77
+    text_temperature: float = 0.0
+    text_top_k: int = 40
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnums=(0, 5, 6))
+@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8))
 def greedy_decode(
     model_apply_pair,          # (prefill_fn, decode_step_fn), static; both
                                # take ``params`` first so weights enter the
@@ -27,11 +27,19 @@ def greedy_decode(
     params,                    # model param tree (traced argument)
     input_ids: jax.Array,      # (B, P) right-padded prompt bucket
     prompt_len: jax.Array,     # (B,)
-    rng_unused: jax.Array,     # reserved for future sampling modes
+    rng: jax.Array,            # consumed only when temperature > 0
     max_new_tokens: int,
     eos_token: int,
+    temperature: float = 0.0,
+    top_k: int = 40,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Returns (generated (B, max_new_tokens), gen_len (B,))."""
+    """Returns (generated (B, max_new_tokens), gen_len (B,)).
+
+    ``temperature=0`` (default) is exact greedy argmax — the reference's
+    hosted text-generation call decodes greedily (no sampling params,
+    backend.py:250-255). ``temperature>0`` switches to top-k Gumbel
+    sampling per step (the standard serving sampler), statically — the
+    greedy graph carries no sampling ops."""
     prefill_fn, decode_step_fn = model_apply_pair
     b, p = input_ids.shape
     max_len = p + max_new_tokens
@@ -41,9 +49,20 @@ def greedy_decode(
     positions = jnp.arange(max_len)[None, :]          # (1, L)
     prompt_valid = positions < prompt_len[:, None]     # (B, L)
 
+    def pick(logits, i):
+        if temperature <= 0.0:  # static branch: pure greedy
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        k = max(1, min(top_k, logits.shape[-1]))
+        k_logits, k_idx = jax.lax.top_k(logits, k)
+        choice = jax.random.categorical(
+            jax.random.fold_in(rng, i),
+            k_logits.astype(jnp.float32) / temperature, axis=-1)
+        return jnp.take_along_axis(
+            k_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
     def step(carry, i):
         logits, cache, done = carry
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = pick(logits, i)
         token = jnp.where(done, jnp.int32(eos_token), token)
         emitted = token
         done = done | (token == eos_token)

@@ -448,6 +448,7 @@ class PromptGenerator:
 
         enable_compile_cache()
         self.cfg = cfg
+        self._decode_calls = 0  # auto-advancing sampling key (decode_ids)
         if cfg.models.mistral is not None:
             m = cfg.models.mistral
             self.model = MistralLM(m)
@@ -570,11 +571,15 @@ class PromptGenerator:
         return path
 
     def decode_ids(self, seed_text: str,
-                   max_new_tokens: Optional[int] = None):
-        """Greedy continuation at the token level: seed text -> bucketed
+                   max_new_tokens: Optional[int] = None,
+                   seed: Optional[int] = None):
+        """Continuation at the token level: seed text -> bucketed
         prefill + cached decode; returns (tokens (1, max_new), gen_len
         (1,)). The serving path and the benchmark both use this, so they
-        measure the same computation."""
+        measure the same computation. Decode mode comes from the config
+        (text_temperature=0 -> greedy, the reference behavior; >0 ->
+        top-k sampling keyed on ``seed``, auto-advanced per call so
+        sampled stories vary round to round)."""
         m = self.mcfg
         max_new = max_new_tokens or self.cfg.sampler.max_new_tokens
         toks = self.tokenizer.encode(seed_text)
@@ -585,16 +590,29 @@ class PromptGenerator:
              if len(toks) <= b and b + max_new <= m.max_positions),
             limit,
         )
-        ids = np.full((1, bucket), self.tokenizer.pad_id, dtype=np.int32)
+        # pad id normalized into the MODEL's vocab: the byte-fallback
+        # tokenizer's pad (258) can exceed a small model vocab, and an
+        # out-of-range id NaN-fills flax Embed's take — the NaN then
+        # leaks through prefill into every decoded token
+        ids = np.full((1, bucket), self.tokenizer.pad_id % m.vocab_size,
+                      dtype=np.int32)
         ids[0, : len(toks)] = np.asarray(toks) % m.vocab_size
+        if seed is None:
+            seed = self._decode_calls
+            self._decode_calls += 1
         return greedy_decode(
             (self._prefill, self._step),
             self.params,
             jnp.asarray(ids),
             jnp.asarray([len(toks)], dtype=jnp.int32),
-            jax.random.PRNGKey(0),
+            jax.random.PRNGKey(seed),
             max_new,
-            self.tokenizer.eos_id,
+            # normalized like the ids above: an out-of-vocab eos could
+            # never match (dead early-stop) and, once forced into the
+            # emitted stream, would hit the same Embed OOB NaN-fill
+            self.tokenizer.eos_id % m.vocab_size,
+            self.cfg.sampler.text_temperature,
+            self.cfg.sampler.text_top_k,
         )
 
     def generate(self, seed_text: str, max_new_tokens: Optional[int] = None
