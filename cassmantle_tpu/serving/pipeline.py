@@ -74,6 +74,24 @@ def dp_sharded_sampler(sample_impl, mesh):
     return fn, int(mesh.shape["dp"])
 
 
+def spatially_shard_latents(lat, mesh):
+    """Latency scale-up for big latents (SURVEY §5.7's 1024²+ path,
+    IN SERVING): constrain (B, H, W, C) latents to P("dp", "sp") so
+    GSPMD spatially partitions the whole denoise over the mesh's sp
+    axis — halo exchanges around every conv, resharding around the
+    attention flattens, all compiler-inserted, riding ICI. A no-op
+    without a mesh or with sp=1 (the batch-throughput layout). sp must
+    divide the latent H."""
+    if mesh is None or int(mesh.shape.get("sp", 1)) <= 1:
+        return lat
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert lat.shape[1] % int(mesh.shape["sp"]) == 0, (
+        f"latent H {lat.shape[1]} not divisible by sp={mesh.shape['sp']}")
+    return jax.lax.with_sharding_constraint(
+        lat, NamedSharding(mesh, P("dp", "sp")))
+
+
 def share_compatible(models_a, models_b) -> bool:
     """True when two ModelZooConfigs can share Text2ImagePipeline param
     trees (same architectures + storage dtype; ``unet_int8`` MAY differ
@@ -201,6 +219,7 @@ class Text2ImagePipeline:
         enable_compile_cache()
         m = cfg.models
         self.cfg = cfg
+        self.mesh = mesh
         self._weights_dir = weights_dir
         self.clip = ClipTextEncoder(m.clip_text)
         self.unet = UNet(m.unet)
@@ -314,6 +333,7 @@ class Text2ImagePipeline:
             uncond = self.clip.apply(params["clip"], uncond_ids)["hidden"]
         lat = initial_latents(rng, ids.shape[0], self.cfg.sampler.image_size,
                               self.vae_scale)
+        lat = spatially_shard_latents(lat, self.mesh)
         with annotate("denoise_scan"):
             final = run_cfg_denoise(
                 self.cfg.sampler, self.sample_latents, self._dc_schedule,
@@ -607,10 +627,13 @@ class PromptGenerator:
             jnp.asarray([len(toks)], dtype=jnp.int32),
             jax.random.PRNGKey(seed),
             max_new,
-            # normalized like the ids above: an out-of-vocab eos could
-            # never match (dead early-stop) and, once forced into the
-            # emitted stream, would hit the same Embed OOB NaN-fill
-            self.tokenizer.eos_id % m.vocab_size,
+            # an out-of-vocab eos (byte-fallback tokenizer vs a smaller
+            # model vocab) can never be emitted: pass vocab_size as an
+            # unreachable sentinel so early-stop is cleanly disabled —
+            # a modulo here would ALIAS a real token as a phantom
+            # terminator and silently truncate generations
+            (self.tokenizer.eos_id
+             if self.tokenizer.eos_id < m.vocab_size else m.vocab_size),
             self.cfg.sampler.text_temperature,
             self.cfg.sampler.text_top_k,
         )

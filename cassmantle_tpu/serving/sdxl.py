@@ -216,8 +216,13 @@ class SDXLPipeline:
         uncond_add = jnp.concatenate([uncond_pooled, time_ids], axis=-1)
         lat = initial_latents(rng, b, self.cfg.sampler.image_size,
                               self.vae_scale)
+        from cassmantle_tpu.serving.pipeline import (
+            run_cfg_denoise,
+            spatially_shard_latents,
+        )
+
+        lat = spatially_shard_latents(lat, self.mesh)
         with annotate("sdxl_denoise_scan"):
-            from cassmantle_tpu.serving.pipeline import run_cfg_denoise
 
             final = run_cfg_denoise(
                 self.cfg.sampler, self.sample_latents, self._dc_schedule,

@@ -54,6 +54,14 @@ BENCH_ENTRY_TIMEOUT=$ENTRY_TIMEOUT \
   timeout "$SUITE_TIMEOUT" python bench.py --suite \
   2>BENCH_SUITE.stderr.log
 timeout 3600 python tools/profile_unet.py 2>&1 | tee PROFILE_UNET.txt
+# flash tile-size sweep (CASSMANTLE_FLASH_BLOCK_*, ops/flash_attention.py):
+# the 1024 default was tuned round 1 and never re-verified after the
+# flash-cross/fallback changes; ineligible sites fall back labeled
+for bq in 512 2048; do
+  CASSMANTLE_FLASH_BLOCK_Q=$bq CASSMANTLE_FLASH_BLOCK_K=$bq \
+    timeout 1800 python tools/profile_unet.py 2>&1 \
+    | tee "PROFILE_UNET_B${bq}.txt"
+done
 timeout 3600 python tools/lm_int8_ab.py --tokens 64 --out LM_INT8_AB.json
 # Quality gate: on a weights-provisioned host this same command emits
 # the real_weights=true CLIP parity verdict (ddim50 vs dpmpp25 vs
