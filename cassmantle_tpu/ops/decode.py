@@ -18,6 +18,24 @@ import jax
 import jax.numpy as jnp
 
 
+def make_apply_pair(model):
+    """(prefill_fn, decode_step_fn) for any zoo LM exposing the
+    prefill/decode_step contract — the one definition of the calling
+    convention ``greedy_decode`` expects (params threaded first so
+    weights stay traced jit arguments)."""
+    cls = type(model)
+
+    def prefill(params, ids, prompt_len, max_len):
+        return model.apply(params, ids, prompt_len, max_len,
+                           method=cls.prefill)
+
+    def decode_step(params, token, index, cache, valid):
+        return model.apply(params, token, index, cache, valid,
+                           method=cls.decode_step)
+
+    return prefill, decode_step
+
+
 @partial(jax.jit, static_argnums=(0, 5, 6, 7, 8))
 def greedy_decode(
     model_apply_pair,          # (prefill_fn, decode_step_fn), static; both

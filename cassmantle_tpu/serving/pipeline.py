@@ -529,13 +529,9 @@ class PromptGenerator:
             )
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
-        cls = type(self.model)
-        self._prefill = lambda p, ids_, len_, max_len: self.model.apply(
-            p, ids_, len_, max_len, method=cls.prefill
-        )
-        self._step = lambda p, tok, idx, cache, valid: self.model.apply(
-            p, tok, idx, cache, valid, method=cls.decode_step
-        )
+        from cassmantle_tpu.ops.decode import make_apply_pair
+
+        self._prefill, self._step = make_apply_pair(self.model)
         if cfg.models.lm_int8:
             from cassmantle_tpu.ops.quant import (
                 quantized_apply,

@@ -169,18 +169,15 @@ def test_sliding_window_limits_attention(model_and_params):
 
 
 def test_greedy_decode_integration(model_and_params):
-    from cassmantle_tpu.ops.decode import greedy_decode
+    from cassmantle_tpu.ops.decode import greedy_decode, make_apply_pair
 
     model, params = model_and_params
-    cls = MistralLM
-    prefill = lambda p, i, l, m: model.apply(p, i, l, m, method=cls.prefill)
-    step = lambda p, t, i, c, v: model.apply(p, t, i, c, v,
-                                             method=cls.decode_step)
     ids = jax.random.randint(jax.random.PRNGKey(9), (2, 8), 0,
                              CFG.vocab_size)
     plen = jnp.asarray([8, 4], dtype=jnp.int32)
     tokens, gen_len = greedy_decode(
-        (prefill, step), params, ids, plen, jax.random.PRNGKey(0), 6, 0
+        make_apply_pair(model), params, ids, plen,
+        jax.random.PRNGKey(0), 6, 0
     )
     assert tokens.shape == (2, 6)
     assert (np.asarray(gen_len) <= 6).all()
