@@ -7,6 +7,7 @@ optimization work. Usage: python tools/profile_unet.py [batch]
 
 from __future__ import annotations
 
+import os
 import sys
 
 import jax
@@ -35,7 +36,8 @@ def timeit(fn, *args, reps=10):
 
 def main():
     enable_compile_cache()
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    positional = [a for a in sys.argv[1:] if not a.startswith("--")]
+    batch = int(positional[0]) if positional else 8
     cfg = FrameworkConfig()
     ucfg = cfg.models.unet
     model = UNet(ucfg)
@@ -61,6 +63,16 @@ def main():
         ca = ca[0]
     flops = ca.get("flops", 0.0)
     bytes_ = ca.get("bytes accessed", 0.0)
+
+    if "--dump-hlo" in sys.argv:
+        # the backend-optimized module: what the TPU actually runs —
+        # fusion boundaries, layouts, pad/transpose insertions. Big
+        # (tens of MB for the full UNet), hence opt-in.
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "UNET_HLO.txt")
+        with open(path, "w") as f:
+            f.write(compiled.as_text())
+        print(f"optimized HLO -> {path}")
 
     dt = timeit(step, params, lat, ts, ctx)
     print(f"batch={batch} step={dt*1e3:.2f} ms  "
