@@ -34,10 +34,10 @@ def test_suite_survives_hung_entry(tmp_path):
 
 
 class _FakeCompleted:
-    def __init__(self, rc, stderr=""):
+    def __init__(self, rc, stderr="", stdout=""):
         self.returncode = rc
         self.stderr = stderr
-        self.stdout = ""
+        self.stdout = stdout
 
 
 def _import_bench():
@@ -118,6 +118,84 @@ def test_probe_deterministic_failure_exits_fast(monkeypatch):
         msg = str(e)
     assert len(calls) == 2
     assert "deterministically" in msg and "ModuleNotFoundError" in msg
+
+
+def test_flash_failure_retries_with_kill_switch(monkeypatch):
+    """A child whose stderr carries a Pallas/Mosaic marker gets exactly
+    one retry with CASSMANTLE_NO_FLASH_CROSS=1, and the measured result
+    is labeled flash_cross_disabled so the suite record says which path
+    produced the number (the auto-fallback of commit 75aab8c — its
+    trigger path, exercised)."""
+    bench = _import_bench()
+    calls = []
+
+    def fake_run(cmd, timeout, capture_output, text, env):
+        calls.append(env)
+        if len(calls) == 1:
+            return _FakeCompleted(
+                1, stderr="Mosaic lowering failed: bad tile")
+        return _FakeCompleted(
+            0, stdout=json.dumps({"metric": "sd15", "value": 2.0}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.delenv("CASSMANTLE_NO_FLASH_CROSS", raising=False)
+    res = bench._run_entry_isolated("sd15", "weights", timeout_s=300.0)
+    assert len(calls) == 2
+    assert calls[1]["CASSMANTLE_NO_FLASH_CROSS"] == "1"
+    assert res["flash_cross_disabled"] is True
+    assert res["value"] == 2.0
+
+
+def test_unrelated_failure_fails_immediately(monkeypatch):
+    """A failure without kernel markers (missing weights, OOM) must
+    surface its real diagnostic at once — no second pipeline build."""
+    bench = _import_bench()
+    calls = []
+
+    def fake_run(cmd, timeout, capture_output, text, env):
+        calls.append(1)
+        return _FakeCompleted(1, stderr="FileNotFoundError: weights/x")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.delenv("CASSMANTLE_NO_FLASH_CROSS", raising=False)
+    res = bench._run_entry_isolated("sd15", "weights", timeout_s=300.0)
+    assert len(calls) == 1
+    assert "FileNotFoundError" in res["error"]
+
+
+def test_timeout_never_retries(monkeypatch):
+    """A wall-clock timeout is a hang (tunnel death), not a kernel
+    rejection — retrying would double the entry budget for nothing."""
+    bench = _import_bench()
+    calls = []
+
+    def fake_run(cmd, timeout, capture_output, text, env):
+        calls.append(1)
+        raise subprocess.TimeoutExpired(cmd, timeout,
+                                        stderr=b"mosaic in the tail")
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.delenv("CASSMANTLE_NO_FLASH_CROSS", raising=False)
+    res = bench._run_entry_isolated("sd15", "weights", timeout_s=300.0)
+    assert len(calls) == 1
+    assert "timeout" in res["error"]
+
+
+def test_no_retry_when_kill_switch_already_set(monkeypatch):
+    """With the kill switch already in the environment (a prior entry's
+    sticky fallback) a mosaic-marked failure is final: the doomed
+    compile must not repeat."""
+    bench = _import_bench()
+    calls = []
+
+    def fake_run(cmd, timeout, capture_output, text, env):
+        calls.append(1)
+        return _FakeCompleted(1, stderr="Mosaic lowering failed again")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setenv("CASSMANTLE_NO_FLASH_CROSS", "1")
+    res = bench._run_entry_isolated("sd15", "weights", timeout_s=300.0)
+    assert len(calls) == 1
+    assert "error" in res
 
 
 def test_unknown_entry_rejected():

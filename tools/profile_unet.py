@@ -2,13 +2,21 @@
 
 Prints per-config step time, achieved TFLOP/s (from XLA's cost analysis),
 and a flash-vs-XLA attention A/B at each spatial resolution, to target
-optimization work. Usage: python tools/profile_unet.py [batch]
+optimization work.
+
+Usage: python tools/profile_unet.py [batch] [--dump-hlo]
+
+--dump-hlo additionally writes the backend-optimized HLO module (what
+the TPU actually runs) to UNET_HLO.txt at the repo root.
 """
 
 from __future__ import annotations
 
 import os
 import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
 import jax
 import jax.numpy as jnp
@@ -35,9 +43,16 @@ def timeit(fn, *args, reps=10):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Profile the SD1.5 UNet denoise step on the TPU")
+    ap.add_argument("batch", nargs="?", type=int, default=8)
+    ap.add_argument("--dump-hlo", action="store_true",
+                    help="write the backend-optimized HLO to UNET_HLO.txt")
+    opts = ap.parse_args()  # rejects unknown/typo'd flags
     enable_compile_cache()
-    positional = [a for a in sys.argv[1:] if not a.startswith("--")]
-    batch = int(positional[0]) if positional else 8
+    batch = opts.batch
     cfg = FrameworkConfig()
     ucfg = cfg.models.unet
     model = UNet(ucfg)
@@ -64,12 +79,11 @@ def main():
     flops = ca.get("flops", 0.0)
     bytes_ = ca.get("bytes accessed", 0.0)
 
-    if "--dump-hlo" in sys.argv:
+    if opts.dump_hlo:
         # the backend-optimized module: what the TPU actually runs —
         # fusion boundaries, layouts, pad/transpose insertions. Big
         # (tens of MB for the full UNet), hence opt-in.
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "UNET_HLO.txt")
+        path = os.path.join(REPO_ROOT, "UNET_HLO.txt")
         with open(path, "w") as f:
             f.write(compiled.as_text())
         print(f"optimized HLO -> {path}")
