@@ -18,19 +18,91 @@ def test_suite_survives_hung_entry(tmp_path):
     CPU) times out — the suite records the timeout as data instead of
     hanging, and exits cleanly because the north star wasn't asked
     for."""
-    env = dict(os.environ,
-               BENCH_SUITE_ENTRIES="scorer", BENCH_ENTRY_TIMEOUT="3")
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+    env = dict(os.environ, BENCH_SUITE_ENTRIES="scorer",
+               BENCH_ENTRY_TIMEOUT="3", BENCH_SUITE_PATH=suite_path)
     proc = subprocess.run(
         [sys.executable, BENCH, "--suite", "--platform-cpu"],
         capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-1000:]
-    partial = os.path.join(REPO, "BENCH_SUITE.partial.json")
-    try:
-        results = json.load(open(partial))
-    finally:
-        os.path.exists(partial) and os.remove(partial)
+    results = json.load(open(suite_path))
     assert "timeout" in results["scorer"]["error"]
+    assert "measured_at" in results["scorer"]
+
+
+def test_suite_error_never_clobbers_prior_success(tmp_path):
+    """Merge semantics (the round 1-3 failure mode: a mid-suite outage
+    zeroed whole runs): a fresh ERROR keeps the previously-measured
+    success; a fresh success overwrites; and the file is rewritten
+    per-entry, not at suite end."""
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+    prior = {"scorer": {"metric": "scorer", "value": 3702.4,
+                        "unit": "pairs/sec",
+                        "measured_at": "2026-07-01T00:00:00Z"}}
+    json.dump(prior, open(suite_path, "w"))
+    env = dict(os.environ, BENCH_SUITE_ENTRIES="scorer",
+               BENCH_ENTRY_TIMEOUT="3", BENCH_SUITE_PATH=suite_path)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--suite", "--platform-cpu"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    results = json.load(open(suite_path))
+    # the timeout error must NOT have replaced the measured number
+    assert results["scorer"]["value"] == 3702.4
+    assert "error" not in results["scorer"]
+    assert "keeping prior measurement" in proc.stderr
+
+
+def test_suite_persists_each_entry_as_it_lands(tmp_path, monkeypatch):
+    """The suite file must exist with entry 1's result BEFORE entry 2
+    runs — verified by having entry 2's (fake) runner read the file."""
+    bench = _import_bench()
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+    seen_at_entry2 = {}
+
+    def fake_isolated(name, weights_dir, timeout_s, cpu=False):
+        if name == "gpt2" and os.path.exists(suite_path):
+            seen_at_entry2.update(json.load(open(suite_path)))
+        return {"metric": name, "value": 1.0}
+
+    monkeypatch.setattr(bench, "_run_entry_isolated", fake_isolated)
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: None)
+    monkeypatch.setenv("BENCH_SUITE_PATH", suite_path)
+    monkeypatch.setenv("BENCH_SUITE_ENTRIES", "scorer,gpt2")
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--suite",
+                                      "--platform-cpu"])
+    bench.main()
+    assert seen_at_entry2["scorer"]["value"] == 1.0
+    final = json.load(open(suite_path))
+    assert set(final) == {"scorer", "gpt2"}
+
+
+def test_fresh_north_star_failure_exits_nonzero(tmp_path, monkeypatch):
+    """When sd15 fails THIS run, the suite must exit non-zero even
+    though the file keeps a prior measurement — callers keying on the
+    exit code must never mistake a stale number for a fresh run."""
+    bench = _import_bench()
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+    with open(suite_path, "w") as f:
+        json.dump({"sd15": {"metric": "sd15", "value": 1.19,
+                            "measured_at": "2026-06-01T00:00:00Z"}}, f)
+    monkeypatch.setattr(
+        bench, "_run_entry_isolated",
+        lambda name, w, t, cpu=False: {"metric": name,
+                                       "error": "tunnel died"})
+    monkeypatch.setenv("BENCH_SUITE_PATH", suite_path)
+    monkeypatch.setenv("BENCH_SUITE_ENTRIES", "sd15")
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--suite",
+                                      "--platform-cpu"])
+    try:
+        bench.main()
+        raise AssertionError("suite should have exited non-zero")
+    except SystemExit as e:
+        assert "north-star bench failed" in str(e)
+    # ...but the file still holds the prior hardware evidence
+    assert json.load(open(suite_path))["sd15"]["value"] == 1.19
 
 
 class _FakeCompleted:
