@@ -7,9 +7,12 @@ single sentence → idf ≡ 1; reference utils.py:74-110, SURVEY.md §2 #9).
 
 This implementation is self-contained (no NLTK corpus downloads at runtime):
 
-- candidate filter = word-like tokens, minus a built-in stop/function-word
-  list, minus very short words, minus obvious verb/aux forms — a lightweight
-  stand-in for the reference's {JJ, RB, NN} POS filter;
+- candidate filter = the vendored POS classifier (engine/pos.py): word-like
+  tokens that are not function words, not verbs (lexicon + morphology +
+  attributive-position rules), and not mid-sentence capitalized proper
+  nouns — the reference's JJ*/RB*/NN/NNS tag filter re-derived without
+  NLTK model downloads; agreement with hand-annotated NLTK-convention
+  tags is measured by eval/masking_agreement.py (see PARITY.md);
 - descriptiveness = L2 distance of the word's embedding from the mean
   embedding of all candidates, exactly the reference's ``semantic_distance``
   signal (utils.py:74-79) but computed with the framework's batched TPU
@@ -41,24 +44,20 @@ STOPWORDS = frozenset(
     """.split()
 )
 
-# Common non-descriptive verb forms that survive the stopword list.
-_VERB_SUFFIX_BLOCKLIST = ("ing",)  # gerunds often ARE descriptive; keep them
 _MIN_WORD_LEN = 3
 
 EmbedFn = Callable[[Sequence[str]], np.ndarray]
 
 
 def candidate_indices(tokens: Sequence[str]) -> List[int]:
-    """Indices of tokens eligible for masking."""
-    out = []
-    for i, tok in enumerate(tokens):
-        if not is_wordlike(tok):
-            continue
-        low = tok.lower()
-        if low in STOPWORDS or len(low) < _MIN_WORD_LEN:
-            continue
-        out.append(i)
-    return out
+    """Indices of tokens eligible for masking: POS-maskable (JJ*/RB*/
+    NN/NNS by the vendored classifier) and not too short to guess."""
+    from cassmantle_tpu.engine.pos import is_maskable
+
+    return [
+        i for i, tok in enumerate(tokens)
+        if len(tok) >= _MIN_WORD_LEN and is_maskable(tokens, i)
+    ]
 
 
 def select_masks(

@@ -676,11 +676,16 @@ class TPUContentBackend(ContentBackend):
         styles: Optional[List[str]] = None,
         rng: Optional[random.Random] = None,
         mesh=None,
+        t2i=None,
     ) -> None:
         from cassmantle_tpu.server.assets import load_styles
 
         self.cfg = cfg
-        if cfg.models.clip_text_2 is not None:
+        if t2i is not None:
+            # caller-owned pipeline (e.g. one already compiled for this
+            # mesh); skips a duplicate param init + jit compile
+            self.t2i = t2i
+        elif cfg.models.clip_text_2 is not None:
             # SDXL config (both text towers): serve rounds at SDXL-1024,
             # the reference's actual image model (backend.py:24).
             from cassmantle_tpu.serving.sdxl import SDXLPipeline

@@ -40,7 +40,8 @@ def default_serving_mesh(cfg: FrameworkConfig):
 class InferenceService:
     def __init__(self, cfg: FrameworkConfig,
                  weights_dir: Optional[str] = None,
-                 mesh=None) -> None:
+                 mesh=None,
+                 backend: Optional[TPUContentBackend] = None) -> None:
         if mesh is None:
             mesh = default_serving_mesh(cfg)
         self.cfg = cfg
@@ -49,8 +50,8 @@ class InferenceService:
             weights_dir=weights_dir,
             batch_buckets=cfg.serving.score_batch_sizes,
         )
-        self.backend = TPUContentBackend(cfg, weights_dir=weights_dir,
-                                         mesh=mesh)
+        self.backend = backend or TPUContentBackend(
+            cfg, weights_dir=weights_dir, mesh=mesh)
         self.score_queue: BatchingQueue = BatchingQueue(
             handler=self._score_batch,
             max_batch=max(cfg.serving.score_batch_sizes),
