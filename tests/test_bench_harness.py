@@ -105,6 +105,90 @@ def test_fresh_north_star_failure_exits_nonzero(tmp_path, monkeypatch):
     assert json.load(open(suite_path))["sd15"]["value"] == 1.19
 
 
+def test_north_star_only_runs_fast_path(tmp_path, monkeypatch):
+    """--north-star-only runs exactly NORTH_STAR_ENTRIES (sd15 first)
+    at 1 timed round unless the caller pinned a rep count — the
+    short-tunnel-window fast path."""
+    bench = _import_bench()
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+    ran = []
+
+    def fake_isolated(name, weights_dir, timeout_s, cpu=False):
+        ran.append((name, os.environ.get("BENCH_ROUNDS")))
+        return {"metric": name, "value": 2.0}
+
+    monkeypatch.setattr(bench, "_run_entry_isolated", fake_isolated)
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: None)
+    monkeypatch.setenv("BENCH_SUITE_PATH", suite_path)
+    monkeypatch.delenv("BENCH_ROUNDS", raising=False)
+    monkeypatch.delenv("BENCH_SUITE_ENTRIES", raising=False)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--north-star-only",
+                                      "--platform-cpu"])
+    bench.main()
+    assert [n for n, _ in ran] == list(bench.NORTH_STAR_ENTRIES)
+    assert ran[0] == ("sd15", "1")  # children inherit the 1-rep env
+    assert set(json.load(open(suite_path))) == set(bench.NORTH_STAR_ENTRIES)
+
+
+def test_suite_order_is_north_star_first():
+    """Tunnels die mid-suite: sd15 and sd15_turbo must be the first two
+    entries so a partial run still lands the perf-case numbers."""
+    bench = _import_bench()
+    assert list(bench.SUITE)[:2] == ["sd15", "sd15_turbo"]
+
+
+def test_kept_prior_is_annotated_with_fresh_error(tmp_path, monkeypatch):
+    """When a fresh error keeps a prior success, the persisted record
+    must say this run failed (last_error/last_error_at), and the
+    per-entry stderr JSON stream must carry the fresh error — not
+    reprint the old success as if re-measured."""
+    bench = _import_bench()
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+    with open(suite_path, "w") as f:
+        json.dump({"scorer": {"metric": "scorer", "value": 3702.4,
+                              "measured_at": "2026-07-01T00:00:00Z"}}, f)
+    monkeypatch.setattr(
+        bench, "_run_entry_isolated",
+        lambda name, w, t, cpu=False: {"metric": name,
+                                       "error": "tunnel died"})
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: None)
+    monkeypatch.setenv("BENCH_SUITE_PATH", suite_path)
+    monkeypatch.setenv("BENCH_SUITE_ENTRIES", "scorer")
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--suite",
+                                      "--platform-cpu"])
+    bench.main()
+    rec = json.load(open(suite_path))["scorer"]
+    assert rec["value"] == 3702.4          # evidence kept
+    assert rec["last_error"] == "tunnel died"
+    assert "last_error_at" in rec and "error" not in rec
+
+
+def test_persist_merges_concurrent_writers(tmp_path, monkeypatch):
+    """Two suite runs sharing one BENCH_SUITE.json must not drop each
+    other's entries: persist re-reads the file at write time, so an
+    entry another run landed mid-flight survives our write."""
+    bench = _import_bench()
+    suite_path = str(tmp_path / "BENCH_SUITE.json")
+
+    def fake_isolated(name, weights_dir, timeout_s, cpu=False):
+        # simulate a concurrent --north-star-only run landing sd15
+        # while our run is measuring the scorer
+        with open(suite_path, "w") as f:
+            json.dump({"sd15": {"metric": "sd15", "value": 1.8}}, f)
+        return {"metric": name, "value": 3000.0}
+
+    monkeypatch.setattr(bench, "_run_entry_isolated", fake_isolated)
+    monkeypatch.setattr(bench, "probe_device", lambda *a, **k: None)
+    monkeypatch.setenv("BENCH_SUITE_PATH", suite_path)
+    monkeypatch.setenv("BENCH_SUITE_ENTRIES", "scorer")
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--suite",
+                                      "--platform-cpu"])
+    bench.main()
+    final = json.load(open(suite_path))
+    assert final["sd15"]["value"] == 1.8       # concurrent entry kept
+    assert final["scorer"]["value"] == 3000.0  # ours landed too
+
+
 class _FakeCompleted:
     def __init__(self, rc, stderr="", stdout=""):
         self.returncode = rc

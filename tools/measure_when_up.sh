@@ -10,7 +10,9 @@ set -u
 cd "$(dirname "$0")/.."
 
 DEADLINE_S=${DEADLINE_S:-14400}   # give up after 4h by default
-POLL_S=${POLL_S:-300}
+POLL_S=${POLL_S:-60}              # outage windows end mid-poll; 60 s
+                                  # costs nothing and catches short
+                                  # tunnel windows a 5 min poll misses
 start=$(date +%s)
 
 probe() {
@@ -50,6 +52,13 @@ fi
 # window (the tunnel can flap between our probe and bench's) and 1h
 # slack for io
 SUITE_TIMEOUT=$((ENTRIES * ENTRY_TIMEOUT + ${BENCH_PROBE_DEADLINE_S:-2700} + 3600))
+# North-star fast path FIRST: sd15 + sd15_turbo at 1 timed round, short
+# probe (our own probe just passed). A tunnel window only minutes long
+# still lands the two numbers the perf case turns on; the full suite
+# then re-measures them at full reps (fresh success overwrites).
+BENCH_PROBE_DEADLINE_S=120 BENCH_ENTRY_TIMEOUT=$ENTRY_TIMEOUT \
+  timeout $((2 * ENTRY_TIMEOUT + 600)) python bench.py --north-star-only \
+  2>BENCH_NORTH_STAR.stderr.log
 BENCH_ENTRY_TIMEOUT=$ENTRY_TIMEOUT \
   timeout "$SUITE_TIMEOUT" python bench.py --suite \
   2>BENCH_SUITE.stderr.log
