@@ -369,11 +369,11 @@ def bench_e2e_round(weights_dir: str) -> dict:
     async def run() -> float:
         svc.score_queue.start()
         # warmup both paths
-        await svc.backend.generate("An old ship left the harbor", True)
+        await svc.content_backend.generate("An old ship left the harbor", True)
         await svc.similarity([("stormy", "windy")] * 64)
         t0 = time.perf_counter()
         content_task = asyncio.ensure_future(
-            svc.backend.generate("The market opened at dawn", False)
+            svc.content_backend.generate("The market opened at dawn", False)
         )
         # 1k guesses land while the round is generating (the serving
         # pressure point: queue coalescing + device contention)
@@ -403,7 +403,7 @@ async def soak_run(svc, rounds: int, workers: int = 32):
     import asyncio
 
     svc.score_queue.start()
-    await svc.backend.generate("An old ship left the harbor", True)
+    await svc.content_backend.generate("An old ship left the harbor", True)
     await svc.similarity([("stormy", "windy")] * 64)
 
     latencies: list = []
@@ -431,7 +431,7 @@ async def soak_run(svc, rounds: int, workers: int = 32):
                 for w in range(workers)]
     t0 = time.perf_counter()
     for r in range(rounds):
-        await svc.backend.generate(f"Round {r} under load", False)
+        await svc.content_backend.generate(f"Round {r} under load", False)
     elapsed = time.perf_counter() - t0
     stop.set()
     await asyncio.gather(*pressure, return_exceptions=True)
@@ -720,22 +720,37 @@ def main() -> None:
             return {}
         return data
 
-    this_run: dict = {}
+    def persist_entry(name: str, res: dict) -> None:
+        """Write ONE entry's outcome under an exclusive lock.
 
-    def persist() -> None:
-        # re-read at write time under an exclusive lock: a concurrent
-        # suite run (e.g. the watcher's full pass overlapping a manual
-        # --north-star-only) may have landed entries since our last
-        # read — an unlocked read-merge-replace could still overwrite
-        # a write that raced between our load and our replace, and a
-        # shared tmp name could be truncated mid-write by the other
-        # process. Lock + per-pid tmp close both.
+        Each entry is persisted exactly once, the moment it completes —
+        never re-merged at later persists — so a concurrent suite run's
+        fresher same-name measurement can't be clobbered by our older
+        one at suite end. The read-resolve-write runs under the lock
+        (per-pid tmp name) so two processes' writes can't interleave,
+        and the keep-prior decision sees the LIVE file, not a snapshot.
+        Merge rule: a fresh success overwrites; a fresh ERROR keeps a
+        previously-measured success (a dead tunnel must not erase
+        hardware evidence), annotated last_error/last_error_at so the
+        file records that this run could not reproduce it."""
         import fcntl
 
         with open(suite_path + ".lock", "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
             merged = load_disk()
-            merged.update(this_run)
+            prev = merged.get(name)
+            if ("error" in res and isinstance(prev, dict)
+                    and "error" not in prev):
+                sys.stderr.write(
+                    f"[suite] {name} failed this run; keeping prior "
+                    f"measurement from {prev.get('measured_at', '?')} "
+                    f"(new error: {res['error'][:200]})\n")
+                kept = dict(prev)
+                kept["last_error"] = res["error"][:300]
+                kept["last_error_at"] = res["measured_at"]
+                merged[name] = kept
+            else:
+                merged[name] = res
             tmp = f"{suite_path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(merged, f, indent=2)
@@ -760,23 +775,7 @@ def main() -> None:
         # the per-entry JSON stream always reports THIS run's outcome,
         # errors included; keep-prior only affects what's persisted
         print(json.dumps(res), file=sys.stderr)
-        prev = load_disk().get(name)
-        if ("error" in res and isinstance(prev, dict)
-                and "error" not in prev):
-            # a dead tunnel must not erase hardware evidence: keep the
-            # measured numbers, but stamp them with the fresh failure so
-            # the file records that THIS run could not reproduce them
-            sys.stderr.write(
-                f"[suite] {name} failed this run; keeping prior "
-                f"measurement from {prev.get('measured_at', '?')} "
-                f"(new error: {res['error'][:200]})\n")
-            kept = dict(prev)
-            kept["last_error"] = res["error"][:300]
-            kept["last_error_at"] = res["measured_at"]
-            this_run[name] = kept
-        else:
-            this_run[name] = res
-        persist()
+        persist_entry(name, res)
     if "sd15" in names and (north_star is None or "error" in north_star):
         # never emit a malformed north-star line with a zero exit
         sys.exit(f"north-star bench failed: {north_star}")

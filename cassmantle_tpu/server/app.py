@@ -402,7 +402,7 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
 
     service = InferenceService(cfg, weights_dir=weights_dir)
     return Game(
-        cfg, store, service.backend,
+        cfg, store, service.content_backend,
         embed=service.embed,
         similarity=service.similarity,
         blur_fn=service.blur,

@@ -586,64 +586,129 @@ class PromptGenerator:
         save_quantized(self.params, path)
         return path
 
-    def decode_ids(self, seed_text: str,
-                   max_new_tokens: Optional[int] = None,
-                   seed: Optional[int] = None):
-        """Continuation at the token level: seed text -> bucketed
-        prefill + cached decode; returns (tokens (1, max_new), gen_len
-        (1,)). The serving path and the benchmark both use this, so they
-        measure the same computation. Decode mode comes from the config
-        (text_temperature=0 -> greedy, the reference behavior; >0 ->
-        top-k sampling keyed on ``seed``, auto-advanced per call so
-        sampled stories vary round to round)."""
+    # Batch-size buckets: concurrent prompt requests coalesce into one
+    # decode whose batch dim pads to the next bucket, so the jitted
+    # greedy_decode graph is reused across calls instead of recompiling
+    # per batch size (the image pipeline's bucket discipline applied to
+    # text; reference issues one hosted LLM call per prompt,
+    # backend.py:240-268, and cannot batch at all).
+    BATCH_BUCKETS = (1, 2, 4, 8)
+
+    def _bucket_for(self, n_tokens: int, max_new: int, limit: int) -> int:
         m = self.mcfg
-        max_new = max_new_tokens or self.cfg.sampler.max_new_tokens
-        toks = self.tokenizer.encode(seed_text)
-        limit = m.max_positions - max_new - 1
-        toks = toks[-limit:] if len(toks) > limit else toks
-        bucket = next(
+        return next(
             (b for b in self.PROMPT_BUCKETS
-             if len(toks) <= b and b + max_new <= m.max_positions),
+             if n_tokens <= b and b + max_new <= m.max_positions),
             limit,
         )
-        # pad id normalized into the MODEL's vocab: the byte-fallback
-        # tokenizer's pad (258) can exceed a small model vocab, and an
-        # out-of-range id NaN-fills flax Embed's take — the NaN then
-        # leaks through prefill into every decoded token
-        ids = np.full((1, bucket), self.tokenizer.pad_id % m.vocab_size,
-                      dtype=np.int32)
-        ids[0, : len(toks)] = np.asarray(toks) % m.vocab_size
+
+    def decode_ids_batch(self, seed_texts: Sequence[str],
+                         max_new_tokens: Optional[int] = None,
+                         seed: Optional[int] = None):
+        """Batched continuation at the token level: N seed texts ->
+        one bucketed prefill + cached decode scan PER PROMPT BUCKET;
+        returns (tokens (N, max_new), gen_len (N,)).
+
+        Rows group by each prompt's OWN bucket — never the batch's
+        longest — because all rows of a (B, P) decode share cache
+        positions P+i: a short prompt co-batched into a longer prompt's
+        bucket would decode at different position ids than it would
+        alone, making round text depend on which requests happened to
+        batch with it. Grouping by own bucket keeps batch output
+        row-for-row IDENTICAL to single decodes (greedy; sampled rows
+        draw per-row independent Gumbel noise) while still coalescing
+        the common case — game seeds cluster in the same bucket. Each
+        group's batch dim pads to the next BATCH_BUCKETS size with
+        1-token dummy rows (decoded then dropped), keeping both shape
+        axes static across calls.
+
+        Decode mode comes from the config (text_temperature=0 -> greedy,
+        the reference behavior; >0 -> top-k sampling keyed on ``seed``,
+        auto-advanced per call so sampled stories vary round to round)."""
+        assert len(seed_texts) > 0, "decode_ids_batch needs >=1 prompt"
+        m = self.mcfg
+        max_new = max_new_tokens or self.cfg.sampler.max_new_tokens
+        limit = m.max_positions - max_new - 1
+        rows = []
+        for text in seed_texts:
+            toks = self.tokenizer.encode(text)
+            rows.append(toks[-limit:] if len(toks) > limit else toks)
         if seed is None:
             seed = self._decode_calls
             self._decode_calls += 1
-        return greedy_decode(
-            (self._prefill, self._step),
-            self.params,
-            jnp.asarray(ids),
-            jnp.asarray([len(toks)], dtype=jnp.int32),
-            jax.random.PRNGKey(seed),
-            max_new,
-            # an out-of-vocab eos (byte-fallback tokenizer vs a smaller
-            # model vocab) can never be emitted: pass vocab_size as an
-            # unreachable sentinel so early-stop is cleanly disabled —
-            # a modulo here would ALIAS a real token as a phantom
-            # terminator and silently truncate generations
-            (self.tokenizer.eos_id
-             if self.tokenizer.eos_id < m.vocab_size else m.vocab_size),
-            self.cfg.sampler.text_temperature,
-            self.cfg.sampler.text_top_k,
-        )
+        groups: dict = {}
+        for i, toks in enumerate(rows):
+            groups.setdefault(
+                self._bucket_for(len(toks), max_new, limit), []
+            ).append(i)
+        out_tokens = np.zeros((len(rows), max_new), dtype=np.int32)
+        out_len = np.zeros((len(rows),), dtype=np.int32)
+        for bucket, idxs in groups.items():
+            n = len(idxs)
+            n_pad = next((b for b in self.BATCH_BUCKETS if n <= b), n)
+            # pad id normalized into the MODEL's vocab: the byte-fallback
+            # tokenizer's pad (258) can exceed a small model vocab, and an
+            # out-of-range id NaN-fills flax Embed's take — the NaN then
+            # leaks through prefill into every decoded token
+            ids = np.full((n_pad, bucket),
+                          self.tokenizer.pad_id % m.vocab_size,
+                          dtype=np.int32)
+            lens = np.ones((n_pad,), dtype=np.int32)  # dummies: 1 pad token
+            for row, src in enumerate(idxs):
+                toks = rows[src]
+                ids[row, : len(toks)] = np.asarray(toks) % m.vocab_size
+                lens[row] = max(1, len(toks))
+            tokens, gen_len = greedy_decode(
+                (self._prefill, self._step),
+                self.params,
+                jnp.asarray(ids),
+                jnp.asarray(lens),
+                jax.random.PRNGKey(seed),
+                max_new,
+                # an out-of-vocab eos (byte-fallback tokenizer vs a smaller
+                # model vocab) can never be emitted: pass vocab_size as an
+                # unreachable sentinel so early-stop is cleanly disabled —
+                # a modulo here would ALIAS a real token as a phantom
+                # terminator and silently truncate generations
+                (self.tokenizer.eos_id
+                 if self.tokenizer.eos_id < m.vocab_size else m.vocab_size),
+                self.cfg.sampler.text_temperature,
+                self.cfg.sampler.text_top_k,
+            )
+            out_tokens[idxs] = np.asarray(tokens[:n])
+            out_len[idxs] = np.asarray(gen_len[:n])
+        return jnp.asarray(out_tokens), jnp.asarray(out_len)
+
+    def decode_ids(self, seed_text: str,
+                   max_new_tokens: Optional[int] = None,
+                   seed: Optional[int] = None):
+        """Single-prompt continuation: the B=1 case of
+        :meth:`decode_ids_batch` (one code path, so the benchmark and
+        the batched serving queue measure the same computation).
+        Returns (tokens (1, max_new), gen_len (1,))."""
+        return self.decode_ids_batch([seed_text], max_new_tokens, seed)
+
+    def generate_batch(self, seed_texts: Sequence[str],
+                       max_new_tokens: Optional[int] = None) -> List[str]:
+        """Batched greedy continuation: one device dispatch for N texts,
+        each trimmed to its first two sentences (reference
+        backend.py:253-265)."""
+        with metrics.timer("pipeline.prompt_s"):
+            out_tokens, gen_len = self.decode_ids_batch(
+                seed_texts, max_new_tokens)
+        texts = []
+        for i in range(len(seed_texts)):
+            k = int(gen_len[i])
+            texts.append(two_sentences(
+                self.tokenizer.decode(np.asarray(out_tokens[i, :k]).tolist())))
+        return texts
 
     def generate(self, seed_text: str, max_new_tokens: Optional[int] = None
                  ) -> str:
         """Greedy continuation of ``seed_text`` (the reference decodes
         32-96 tokens then keeps the first two sentences,
         backend.py:253-265)."""
-        with metrics.timer("pipeline.prompt_s"):
-            out_tokens, gen_len = self.decode_ids(seed_text, max_new_tokens)
-        n = int(gen_len[0])
-        text = self.tokenizer.decode(np.asarray(out_tokens[0, :n]).tolist())
-        return two_sentences(text)
+        return self.generate_batch([seed_text], max_new_tokens)[0]
 
 
 def sanitize_text(text: str) -> str:
@@ -702,11 +767,17 @@ class TPUContentBackend(ContentBackend):
         style = self.rng.choice(self.styles)
         return f"A {style.lower()} style piece depicting: {prompt}"
 
-    def generate_sync(self, seed: str, is_seed: bool) -> RoundContent:
+    def generate_sync(self, seed: str, is_seed: bool,
+                      text: Optional[str] = None) -> RoundContent:
+        """``text`` lets a caller inject an already-decoded continuation
+        (the InferenceService prompt queue batches decodes across
+        concurrent round generations); None decodes here, single."""
         from cassmantle_tpu.engine.content import template_text
         from cassmantle_tpu.utils.text import is_wordlike, tokenize_words
 
-        text = sanitize_text(self.prompt_gen.generate(seed))
+        if text is None:
+            text = self.prompt_gen.generate(seed)
+        text = sanitize_text(text)
         wordy = sum(is_wordlike(t) for t in tokenize_words(text))
         if wordy < self.cfg.game.num_masked + 1:
             # degenerate LM output (e.g. random weights): keep the round
@@ -720,8 +791,9 @@ class TPUContentBackend(ContentBackend):
         )
         return RoundContent(prompt_text=text, image=images[0])
 
-    async def generate(self, seed: str, is_seed: bool) -> RoundContent:
+    async def generate(self, seed: str, is_seed: bool,
+                       text: Optional[str] = None) -> RoundContent:
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(
-            None, self.generate_sync, seed, is_seed
+            None, self.generate_sync, seed, is_seed, text
         )

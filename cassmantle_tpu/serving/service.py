@@ -59,10 +59,27 @@ class InferenceService:
             max_pending=cfg.serving.max_pending,
             name="score",
         )
+        # Concurrent round generations (double-buffering overlapping a
+        # live promotion, or several Game instances sharing one service)
+        # coalesce their LM decodes into one batched greedy_decode
+        # dispatch (PromptGenerator.decode_ids_batch) instead of
+        # serializing single-prompt scans on the dispatch thread.
+        from cassmantle_tpu.serving.pipeline import PromptGenerator
 
-    # handler runs on the dispatch thread
+        self.prompt_queue: BatchingQueue = BatchingQueue(
+            handler=self._prompt_batch,
+            max_batch=max(PromptGenerator.BATCH_BUCKETS),
+            max_delay_ms=cfg.serving.max_queue_delay_ms,
+            max_pending=cfg.serving.max_pending,
+            name="prompt",
+        )
+
+    # handlers run on the dispatch thread
     def _score_batch(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
         return self.scorer.similarity(list(pairs))
+
+    def _prompt_batch(self, seeds: Sequence[str]):
+        return self.backend.prompt_gen.generate_batch(list(seeds))
 
     # -- engine injection points -----------------------------------------
     def embed(self, words) -> np.ndarray:
@@ -91,5 +108,47 @@ class InferenceService:
     def blur(image: np.ndarray, radius: float) -> np.ndarray:
         return device_blur(image, radius)
 
+    async def generate_content(self, seed: str, is_seed: bool):
+        """ContentBackend-compatible generate whose text decode rides
+        the prompt queue: N rounds generating concurrently become one
+        (N<=8)-row decode batch. Image generation still runs per round
+        in the executor. Queue overload degrades to the backend's own
+        single-prompt decode (skip-don't-crash)."""
+        text = None
+        if hasattr(self.backend, "prompt_gen"):
+            try:
+                text = await self.prompt_queue.submit(seed)
+            except QueueFull:
+                log.warning(
+                    "prompt queue full; decoding %r in-backend", seed[:40])
+        return await self.backend.generate(seed, is_seed, text=text)
+
+    @property
+    def content_backend(self):
+        """The ContentBackend the Game should own: same pipelines as
+        ``self.backend``, but generate() coalesces concurrent LM decodes
+        through the prompt queue. This is what server/app.py wires in —
+        handing ``service.backend`` to the Game instead would silently
+        bypass the batching."""
+        return _QueuedContentBackend(self)
+
     async def stop(self) -> None:
         await self.score_queue.stop()
+        await self.prompt_queue.stop()
+
+
+class _QueuedContentBackend:
+    """Thin ContentBackend adapter binding generate() to
+    InferenceService.generate_content (prompt-queue-batched decode)."""
+
+    def __init__(self, service: InferenceService) -> None:
+        self._service = service
+        # expose the underlying pipelines (tests and tools reach
+        # backend.t2i / backend.prompt_gen through the Game)
+        self.inner = service.backend
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    async def generate(self, seed: str, is_seed: bool):
+        return await self._service.generate_content(seed, is_seed)

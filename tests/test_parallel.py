@@ -173,8 +173,8 @@ def test_train_step_remat_matches(cfg):
 
 
 def test_ring_attention_causal_matches_reference():
-    """Causal ring attention (the LM long-context path) vs full-sequence
-    triangular-masked reference."""
+    """Contiguous causal ring attention (the schedule="contiguous"
+    oracle) vs full-sequence triangular-masked reference."""
     mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=8))
     b, s, h, d = 2, 64, 4, 16
     ks = jax.random.split(jax.random.PRNGKey(5), 3)
@@ -183,10 +183,48 @@ def test_ring_attention_causal_matches_reference():
     v = jax.random.normal(ks[2], (b, s, h, d))
     mask = jnp.tril(jnp.ones((s, s), bool))
     ref = xla_attention(q, k, v, mask=mask)
-    out = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    out = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                         schedule="contiguous")
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_ring_attention_causal_defaults_to_zigzag():
+    """ring_attention(causal=True) must route to the load-balanced
+    zigzag schedule when S divides 2n (VERDICT r4 #8): dispatch
+    observed directly, and the result still matches the masked
+    reference."""
+    from cassmantle_tpu.parallel import ring as ring_mod
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    b, s, h, d = 1, 64, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, h, d))
+    v = jax.random.normal(ks[2], (b, s, h, d))
+    called = []
+    orig = ring_mod.zigzag_ring_attention
+    ring_mod.zigzag_ring_attention = (
+        lambda *a, **kw: called.append(1) or orig(*a, **kw))
+    try:
+        out = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    finally:
+        ring_mod.zigzag_ring_attention = orig
+    assert called, "causal ring did not dispatch to zigzag"
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    ref = xla_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+    # sequences that divide n but not 2n must still work (contiguous
+    # fallback): S=8 over sp=8 -> one row per device
+    q8, k8, v8 = q[:, :8], k[:, :8], v[:, :8]
+    out8 = ring_attention(q8, k8, v8, mesh, axis_name="sp", causal=True)
+    ref8 = xla_attention(q8, k8, v8, mask=jnp.tril(jnp.ones((8, 8), bool)))
+    np.testing.assert_allclose(
+        np.asarray(out8), np.asarray(ref8), atol=1e-5, rtol=1e-5
     )
 
 
