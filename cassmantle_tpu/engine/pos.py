@@ -25,11 +25,17 @@ targets exactly the reference's exclusion classes:
     caravan", "under striped awnings", "gathered fallen fruit"):
     attributive = preceded by a determiner/preposition/verb (the start
     of a noun phrase) or sentence-initial;
-  - bare verb bases are verbs only after infinitive "to" or a modal
-    ("to return"); elsewhere the noun reading wins ("promised rest");
+  - bare verb bases are verbs after infinitive "to" or a modal
+    ("to return"), after a plural-noun subject ("Birds sing" — VBP),
+    or opening an imperative whose object follows ("Gather the
+    fallen branches" — VB); elsewhere the noun reading wins
+    ("promised rest", sentence-initial noun subjects like "Rain
+    tapped...");
   - ``-s`` forms are treated as plural nouns: in past-tense story
     prose a 3rd-person-singular present verb is rare, while plural
-    nouns after adjectives ("black rocks") are everywhere.
+    nouns after adjectives ("black rocks") are everywhere. Known
+    gap (quantified per-class by eval/masking_agreement.py): VBZ in
+    present-tense prompts ("the light fades") reads as NNS.
 
 Accuracy against hand-annotated NLTK-convention tags and end-to-end
 mask-selection agreement with the reference algorithm are measured by
@@ -78,8 +84,7 @@ MODALS = frozenset(
 # Number words: CD tags, not in descriptive_tags.
 NUMBERS = frozenset(
     """one two three four five six seven eight nine ten eleven twelve
-    twenty thirty forty fifty hundred thousand million first second
-    third""".split()
+    twenty thirty forty fifty hundred thousand million""".split()
 )
 
 # Sentence terminators: a capitalized token right after one is
@@ -96,7 +101,7 @@ IRREGULAR_PAST = frozenset(
     won wrote blew broke crept dealt dug drank froze hid hung knelt
     lay lent lit rode sought shot shrank slid spun sprang stuck stung
     strode struck swore tore wept wound bent bound bled fled sank
-    stank clung""".split()
+    stank clung leapt shod""".split()
 )
 
 # Participle forms that read as adjectives when attributive
@@ -145,7 +150,7 @@ VERB_BASES = frozenset(
     build buy catch choose deal dig draw drive eat fight lead lend
     lose read ride seek sell shake shoot show shut sink smell spend
     spread steal stick sting strike swear sweep swing throw wind
-    write""".split()
+    write depict curl cool dry whistle complain calm""".split()
 )
 
 
@@ -186,11 +191,15 @@ def _is_verb_ing(low: str) -> bool:
 
 def _is_verbish(low: Optional[str]) -> bool:
     """Loose test used for LEFT context: does this word look like a
-    verb form (so the next word starts an object noun phrase)?"""
+    verb form (so the next word starts an object noun phrase)? -ing
+    forms route through ``_is_verb_ing`` ONLY, so lexicalized -ing
+    nouns that happen to inflect a known base ("the gathering ended")
+    don't read as verbs."""
     if low is None:
         return False
     return (low in IRREGULAR_PAST
-            or low in _INFLECTED_VERB_FORMS and not low.endswith("s")
+            or (low in _INFLECTED_VERB_FORMS
+                and not low.endswith(("s", "ing")))
             or (low.endswith("ed") and low not in ED_ADJECTIVES)
             or _is_verb_ing(low))
 
@@ -202,6 +211,34 @@ def _prev_word(tokens: Sequence[str], i: int) -> Optional[str]:
         if tokens[j] in _SENT_END:
             return None
     return None
+
+
+def _next_word(tokens: Sequence[str], i: int) -> Optional[str]:
+    for j in range(i + 1, len(tokens)):
+        if is_wordlike(tokens[j]):
+            return tokens[j].lower()
+        if tokens[j] in _SENT_END:
+            return None
+    return None
+
+
+# -s adverbs/misc that would otherwise pass the plural-noun surface
+# test below ("Winters are always cool" must not read "cool" as VBP).
+_S_ADVERBS = frozenset(
+    """always sometimes perhaps besides towards upwards downwards
+    backwards forwards afterwards nowadays indoors outdoors overseas
+    alas thus""".split()
+)
+
+
+def _plural_nounish(low: Optional[str]) -> bool:
+    """Loose plural-noun test for the VBP rule: an -s word that isn't a
+    mass/abstract -ss noun, a function word ("across"), or an -s adverb
+    ("always") — leaving "birds", "waves", "sentries"."""
+    return (low is not None and len(low) > 3 and low.endswith("s")
+            and not low.endswith("ss") and not _is_function_word(low)
+            and low not in _S_ADVERBS
+            and low not in _INFLECTED_VERB_FORMS)
 
 
 def _sentence_initial(tokens: Sequence[str], i: int) -> bool:
@@ -245,8 +282,10 @@ def is_maskable(tokens: Sequence[str], i: int) -> bool:
     # proper noun (NNP): capitalized mid-sentence
     if tok[0].isupper() and not _sentence_initial(tokens, i):
         return False
-    # VBG: -ing with a verb stem (NLTK excludes even attributive ones)
-    if low.endswith("ing"):
+    # VBG: -ing with a verb stem (NLTK excludes even attributive ones).
+    # Verb BASES that merely end in -ing ("sing", "bring", "swing")
+    # fall through to the bare-base rules below instead.
+    if low.endswith("ing") and low not in VERB_BASES:
         return not _is_verb_ing(low)
     prev = _prev_word(tokens, i)
     # a verb-homograph right after a determiner is a noun ("the rose")
@@ -264,7 +303,25 @@ def is_maskable(tokens: Sequence[str], i: int) -> bool:
             # too short to be an inflected verb: "red", "bed", "seed"
             return True
         return _attributive(tokens, i)
-    # bare verb base: a verb only as an infinitive/modal complement
+    # bare verb base: a verb as an infinitive/modal complement, as a
+    # present-tense main verb after a plural-noun subject ("Birds sing
+    # at dawn" — VBP), or opening an imperative whose object follows
+    # ("Gather the fallen branches" — VB). Elsewhere the noun reading
+    # wins ("promised rest", "Rain tapped...").
     if low in VERB_BASES:
-        return prev not in MODALS
+        if prev in MODALS:
+            return False
+        if _plural_nounish(prev):
+            return False
+        if (_sentence_initial(tokens, i)
+                and _next_word(tokens, i) in _IMPERATIVE_OBJECTS):
+            return False
+        return True
     return True
+
+
+# What can open an imperative's object: a determiner/possessive or an
+# object pronoun ("Gather the branches", "Pay him with dried figs").
+_IMPERATIVE_OBJECTS = DETERMINERS | frozenset(
+    "them it him her us me you nothing something everything".split()
+)

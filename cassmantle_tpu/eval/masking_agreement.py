@@ -2,12 +2,16 @@
 
 The reference selects mask words by NLTK POS filter + word2vec distance
 from the candidate mean (reference src/utils.py:74-104). This module
-replays that algorithm EXACTLY — including its quirks: the TF-IDF
-weight that is identically 1 on a single sentence, distance 0 for
-out-of-model words, and ``words.index`` first-occurrence index lookup —
-over a hand-annotated gold corpus (data/pos_gold.txt, NLTK-convention
-Penn tags), and compares against this framework's selection
-(engine/masking.select_masks with the vendored POS classifier).
+replays that algorithm — the tag filter, the TF-IDF weight that is
+identically 1 on a single sentence, and ``words.index`` first-occurrence
+index lookup — over a hand-annotated gold corpus (data/pos_gold.txt,
+NLTK-convention Penn tags), and compares against this framework's
+selection (engine/masking.select_masks with the vendored POS
+classifier). Two reference quirks are NOT modeled because they are
+vacuous under the dense embedders used here (hash or MiniLM embed every
+string): word2vec's distance-0 for out-of-model words and its
+mean-over-in-vocab-only; a word2vec-backed run would need an in-vocab
+predicate threaded through ``embed``.
 
 Two numbers come out:
 
@@ -39,10 +43,22 @@ GOLD_PATH = os.path.join(_REPO, "data", "pos_gold.txt")
 
 def load_gold(path: str = GOLD_PATH) -> List[List[Tuple[str, str]]]:
     """[[(token, tag), ...] per prompt]."""
+    return [pairs for _, pairs in load_gold_sections(path)]
+
+
+def load_gold_sections(
+    path: str = GOLD_PATH,
+) -> List[Tuple[str, List[Tuple[str, str]]]]:
+    """[(section, [(token, tag), ...]) per prompt] — sections come from
+    ``# section: NAME`` comment lines (docs/POS_ANNOTATION.md)."""
     prompts = []
+    section = "unsectioned"
     with open(path) as f:
         for line in f:
             line = line.strip()
+            if line.startswith("# section:"):
+                section = line.split(":", 1)[1].strip()
+                continue
             if not line or line.startswith("#"):
                 continue
             pairs = []
@@ -50,7 +66,7 @@ def load_gold(path: str = GOLD_PATH) -> List[List[Tuple[str, str]]]:
                 word, _, tag = item.rpartition("/")
                 assert word and tag, f"malformed gold item {item!r}"
                 pairs.append((word, tag))
-            prompts.append(pairs)
+            prompts.append((section, pairs))
     return prompts
 
 
@@ -73,7 +89,9 @@ def reference_select(
                       dtype=np.float32)
     mean = vecs.mean(axis=0, keepdims=True)
     distances = np.linalg.norm(vecs - mean, axis=1)
-    top = np.argsort(distances, kind="stable")[-num_masked:]
+    # default (introsort) argsort, matching the reference's np.argsort
+    # call — exact-tie ordering follows NumPy's unstable sort in both
+    top = np.argsort(distances)[-num_masked:]
     return sorted({words.index(filtered[i]) for i in top})
 
 
@@ -91,6 +109,33 @@ def tag_maskable(tag: str) -> bool:
     return tag in DESCRIPTIVE_TAGS
 
 
+def surface_class(tok: str) -> str:
+    """Audit bucket for a token, by SURFACE form only (derivable
+    without the classifier, so the per-class error report can be
+    checked against the corpus by hand). Buckets mirror the
+    classifier's decision families (engine/pos.py)."""
+    from cassmantle_tpu.engine.pos import (
+        IRREGULAR_PAST,
+        PARTICIPLE_ADJ,
+        VERB_BASES,
+    )
+
+    low = tok.lower()
+    if low in VERB_BASES:
+        return "bare-verb-base"
+    if low in IRREGULAR_PAST or low in PARTICIPLE_ADJ:
+        return "irregular-past-or-participle"
+    if low.endswith("ing"):
+        return "ing-form"
+    if low.endswith("ed"):
+        return "ed-form"
+    if low.endswith("ly"):
+        return "ly-form"
+    if low.endswith("s") and not low.endswith("ss"):
+        return "s-form"
+    return "other"
+
+
 def evaluate(
     embed: Callable[[Sequence[str]], np.ndarray],
     num_masked: int = 2,
@@ -99,19 +144,38 @@ def evaluate(
     from cassmantle_tpu.engine.pos import is_maskable
     from cassmantle_tpu.utils.text import is_wordlike
 
-    gold = load_gold(path)
+    gold = load_gold_sections(path)
     tag_hits = tag_total = 0
     exact = 0
     jaccards = []
     disagreements = []
-    for tagged in gold:
+    by_class: Dict[str, Dict[str, int]] = {}
+    by_section: Dict[str, Dict[str, int]] = {}
+    tag_errors = []
+    for section, tagged in gold:
         tokens = [w for w, _ in tagged]
+        sec = by_section.setdefault(
+            section, {"prompts": 0, "tag_total": 0, "tag_errors": 0,
+                      "mask_exact": 0})
+        sec["prompts"] += 1
         for i, (tok, tag) in enumerate(tagged):
             if not (is_wordlike(tok) and tok.isalpha()):
                 continue
             tag_total += 1
+            sec["tag_total"] += 1
+            cls = by_class.setdefault(surface_class(tok),
+                                      {"total": 0, "errors": 0})
+            cls["total"] += 1
             if is_maskable(tokens, i) == tag_maskable(tag):
                 tag_hits += 1
+            else:
+                cls["errors"] += 1
+                sec["tag_errors"] += 1
+                tag_errors.append({
+                    "token": tok, "gold_tag": tag,
+                    "class": surface_class(tok), "section": section,
+                    "context": " ".join(tokens[max(0, i - 3): i + 3]),
+                })
         ref = set(reference_select(tagged, embed, num_masked))
         ours = set(framework_select(tokens, embed, num_masked))
         union = ref | ours
@@ -119,9 +183,11 @@ def evaluate(
         jaccards.append(jac)
         if ref == ours:
             exact += 1
+            sec["mask_exact"] += 1
         else:
             disagreements.append({
                 "text": " ".join(tokens),
+                "section": section,
                 "reference": sorted(ref),
                 "framework": sorted(ours),
             })
@@ -130,6 +196,21 @@ def evaluate(
         "tag_accuracy": round(tag_hits / max(1, tag_total), 4),
         "mask_agreement": round(exact / max(1, len(gold)), 4),
         "mean_jaccard": round(float(np.mean(jaccards)), 4),
+        "by_section": {
+            k: {
+                "prompts": v["prompts"],
+                "tag_accuracy": round(
+                    1 - v["tag_errors"] / max(1, v["tag_total"]), 4),
+                "mask_agreement": round(
+                    v["mask_exact"] / max(1, v["prompts"]), 4),
+            }
+            for k, v in by_section.items()
+        },
+        "tag_errors_by_class": {
+            k: {**v, "accuracy": round(1 - v["errors"] / v["total"], 4)}
+            for k, v in sorted(by_class.items())
+        },
+        "tag_errors": tag_errors,
         "disagreements": disagreements,
     }
 
@@ -162,7 +243,9 @@ def main() -> None:
 
     report = evaluate(embed, num_masked=args.num_masked)
     if not args.verbose:
-        report = {**report, "disagreements": len(report["disagreements"])}
+        report = {**report,
+                  "disagreements": len(report["disagreements"]),
+                  "tag_errors": len(report["tag_errors"])}
     print(json.dumps(report, indent=2))
 
 

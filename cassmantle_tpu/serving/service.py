@@ -121,7 +121,10 @@ class InferenceService:
             except QueueFull:
                 log.warning(
                     "prompt queue full; decoding %r in-backend", seed[:40])
-        return await self.backend.generate(seed, is_seed, text=text)
+        if text is not None:
+            return await self.backend.generate(seed, is_seed, text=text)
+        # injected custom backends may not take a ``text`` kwarg
+        return await self.backend.generate(seed, is_seed)
 
     @property
     def content_backend(self):

@@ -19,23 +19,40 @@ from cassmantle_tpu.utils.text import tokenize_words
 
 
 def test_agreement_thresholds():
-    """VERDICT bar: >=80% selection agreement. The classifier sits
-    well above it; the assertions pin a margin so regressions surface
-    before parity decays to the bar."""
+    """Per-section bars (VERDICT r4 #6: the corpus now includes
+    adversarial registers, so one overall number would either hide the
+    gaps or force the bar below meaning). The PRODUCTION register —
+    past-tense narrative, which the pipeline's templates and seeds
+    produce, plus verbatim pipeline output strings — keeps the strict
+    round-3 bar; adversarial sections get regression floors at their
+    measured level so a classifier change that degrades them surfaces."""
     report = evaluate(hash_embed)
-    assert report["prompts"] >= 50
-    assert report["tag_accuracy"] >= 0.97, report
-    assert report["mask_agreement"] >= 0.90, report["disagreements"][:5]
-    assert report["mean_jaccard"] >= 0.93, report
+    assert report["prompts"] >= 150
+    sec = report["by_section"]
+    # production register: strict
+    for name in ("core-past-narrative", "pipeline-outputs"):
+        assert sec[name]["tag_accuracy"] >= 0.98, (name, report)
+        assert sec[name]["mask_agreement"] >= 0.90, (name, report)
+    assert sec["past-narrative-hard"]["tag_accuracy"] >= 0.95, report
+    # adversarial registers: floors just under the measured level
+    # (docs/POS_ANNOTATION.md documents the known gaps behind them)
+    assert sec["adversarial-homographs"]["tag_accuracy"] >= 0.90, report
+    assert sec["present-tense"]["tag_accuracy"] >= 0.84, report
+    assert sec["imperatives"]["tag_accuracy"] >= 0.86, report
+    # whole-corpus floors
+    assert report["tag_accuracy"] >= 0.94, report
+    assert report["mask_agreement"] >= 0.75, report["disagreements"][:5]
+    assert report["mean_jaccard"] >= 0.82, report
 
 
 def test_gold_corpus_well_formed():
     gold = load_gold(GOLD_PATH)
-    assert len(gold) >= 50
+    assert len(gold) >= 150
     for tagged in gold:
         assert len(tagged) >= 8
-        # two sentences per prompt, annotated terminators
-        assert sum(1 for w, t in tagged if w == ".") == 2
+        # prose prompts carry one or two annotated terminators; the
+        # styled image-prompt lines (pipeline-outputs) carry none
+        assert sum(1 for w, t in tagged if w == ".") <= 2
 
 
 def _maskable_words(text):
