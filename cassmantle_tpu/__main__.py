@@ -12,6 +12,9 @@ door; this one wraps every runnable surface:
 - ``clip-report``      CLIP-sim quality gate across presets (tools/clip_report.py)
 - ``build-wordlist``   regenerate the spellcheck lexicon (tools/build_wordlist.py)
 - ``lm-int8-ab``       fp-vs-int8 LM decode A/B (tools/lm_int8_ab.py)
+- ``weights-drill``    fetch -> quantize -> CLIP gate -> LM A/B -> one
+                       LM-decoded game round, fail-fast (the whole
+                       weights-provisioned drill as one verb)
 - ``train-diffusion``  dp×tp×sp UNet fine-tuning loop (synthetic or .npy data)
 - ``train-lm``         LM fine-tuning loop (GPT-2 by default)
 - ``version``
@@ -106,6 +109,145 @@ def cmd_build_wordlist(argv) -> int:
 
 def cmd_lm_int8_ab(argv) -> int:
     return _run_script(os.path.join("tools", "lm_int8_ab.py"), argv)
+
+
+def cmd_weights_drill(argv) -> int:
+    """The weights-provisioned drill, one verb (VERDICT r4 #3):
+    fetch -> quantize -> CLIP quality gate -> LM int8 A/B -> one game
+    round whose prompt text is genuinely LM-decoded (no template
+    fallback). Fail-fast: the first failing leg fails the drill, and
+    the CLIP leg enforces config.QualityGateConfig whenever the report
+    is a real measurement."""
+    p = argparse.ArgumentParser(
+        description="weights-provisioned drill: fetch -> quantize -> "
+                    "clip gate -> lm A/B -> LM-decoded round")
+    p.add_argument("--weights", default=os.path.join(_repo_root(),
+                                                     "weights"))
+    p.add_argument("--seeds", type=int, default=2,
+                   help="image batches per preset for the CLIP leg")
+    p.add_argument("--tokens", type=int, default=64,
+                   help="decode length for the LM int8 A/B leg")
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu"))
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny configs end to end (plumbing smoke on "
+                        "CPU; numbers are not measurements)")
+    for leg in ("fetch", "quantize", "clip", "lm-ab", "round"):
+        p.add_argument(f"--skip-{leg}", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.tiny:
+        # tiny is a plumbing smoke of the measurement legs; it must
+        # never download checkpoints or leave random-init artifacts in
+        # the real weights directory
+        args.skip_fetch = args.skip_quantize = True
+
+    def leg(name: str, fn) -> int:
+        if getattr(args, f"skip_{name.replace('-', '_')}"):
+            print(f"[drill] {name}: skipped")
+            return 0
+        print(f"[drill] {name}: running")
+        rc = fn()
+        print(f"[drill] {name}: {'ok' if rc == 0 else f'FAILED ({rc})'}")
+        return rc
+
+    plat = ["--platform", "cpu"] if args.platform == "cpu" else []
+    tiny = ["--tiny"] if args.tiny else []
+    steps = [
+        ("fetch", lambda: cmd_fetch_weights(
+            ["--out", args.weights])),
+        ("quantize", lambda: cmd_quantize_weights(
+            ["--weights", args.weights] + plat)),
+        ("clip", lambda: cmd_clip_report(
+            ["--weights", args.weights, "--seeds", str(args.seeds)]
+            + plat + tiny)),
+        ("lm-ab", lambda: cmd_lm_int8_ab(
+            ["--weights", args.weights, "--tokens", str(args.tokens)]
+            + plat + tiny)),
+        ("round", lambda: _lm_decoded_round(args)),
+    ]
+    for name, fn in steps:
+        rc = leg(name, fn)
+        if rc != 0:
+            return rc
+    print("[drill] all legs passed")
+    return 0
+
+
+def _lm_decoded_round(args) -> int:
+    """One full game round whose prompt text came from the LM — the
+    seam the virtual-mesh dryrun only ever exercised via the template
+    fallback (VERDICT r4 weak #5). Fails when the decode degenerates
+    into the fallback (pipeline.text_fallbacks increments), so a
+    weights-provisioned host proves LM text flows through masking ->
+    round -> store."""
+    import asyncio
+    import dataclasses
+    import glob
+
+    if not args.tiny:
+        # cheap provisioning check BEFORE any model init: the fail
+        # path must not pay a full-size random-init stack just to say
+        # "needs a provisioned host"
+        has_lm = any(
+            glob.glob(os.path.join(args.weights, pat))
+            for pat in ("gpt2.safetensors", "gpt2-*.safetensors",
+                        "mistral.safetensors", "mistral-*.safetensors"))
+        if not has_lm:
+            print("[drill] round: no LM checkpoint under "
+                  f"{args.weights} — this leg needs a provisioned "
+                  f"host (or --tiny for plumbing)", file=sys.stderr)
+            return 5
+
+    if args.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
+
+    from cassmantle_tpu.config import FrameworkConfig, test_config
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.serving.service import InferenceService
+    from cassmantle_tpu.utils.logging import metrics
+
+    cfg = test_config() if args.tiny else FrameworkConfig()
+    cfg = cfg.replace(game=dataclasses.replace(
+        cfg.game, time_per_prompt=30.0, lock_timeout=120.0))
+    weights_dir = args.weights if os.path.isdir(args.weights) else None
+    svc = InferenceService(cfg, weights_dir=None if args.tiny
+                           else weights_dir)
+    if not args.tiny and not svc.backend.prompt_gen.loaded_real_weights:
+        print("[drill] round: LM weights are random init — this leg "
+              "needs a provisioned host (or --tiny for plumbing)",
+              file=sys.stderr)
+        return 5
+
+    game = Game(cfg, MemoryStore(), svc.content_backend, svc.embed,
+                svc.similarity)
+
+    async def play() -> int:
+        fallbacks0 = metrics.snapshot()["counters"].get(
+            "pipeline.text_fallbacks", 0)
+        await game.startup()
+        prompt = await game.fetch_prompt_json("drill-player")
+        masks = await game.rounds.current_masks()
+        scores = await game.compute_client_scores(
+            "drill-player", {str(masks[0]): "stormy"})
+        await game.shutdown()
+        await svc.stop()
+        fallbacks = metrics.snapshot()["counters"].get(
+            "pipeline.text_fallbacks", 0) - fallbacks0
+        assert prompt and masks and "won" in scores, (prompt, scores)
+        if fallbacks and not args.tiny:
+            print(f"[drill] round: {fallbacks} template fallback(s) — "
+                  f"prompt text did NOT come from the LM",
+                  file=sys.stderr)
+            return 6
+        print(f"[drill] round ok: {len(masks)} masks from "
+              f"{'template (tiny)' if fallbacks else 'LM-decoded'} text, "
+              f"guess scored")
+        return 0
+
+    return asyncio.run(play())
 
 
 def _train_parser(desc: str) -> argparse.ArgumentParser:
@@ -301,6 +443,7 @@ COMMANDS = {
     "clip-report": cmd_clip_report,
     "build-wordlist": cmd_build_wordlist,
     "lm-int8-ab": cmd_lm_int8_ab,
+    "weights-drill": cmd_weights_drill,
     "train-diffusion": cmd_train_diffusion,
     "train-lm": cmd_train_lm,
 }

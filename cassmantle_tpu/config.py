@@ -267,12 +267,41 @@ class GameConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class QualityGateConfig:
+    """CLIP-parity thresholds a fast preset must clear before its
+    throughput counts as a win (BASELINE.md quality gate). Enforced by
+    tools/clip_report.py whenever the report is a real measurement
+    (real_weights=true); advisory on random-init plumbing runs. Keyed
+    by preset name; a preset absent here is reported but not gated.
+
+    Ratios are preset clip_sim_mean / ddim50 anchor clip_sim_mean.
+    DPM-Solver++(2M)@25 and deepcache claim DDIM-50-class quality, so
+    they gate at 0.97; the composed turbo path trades a little more;
+    int8 is a weights-only quantization and must stay ~lossless."""
+
+    parity_vs_ddim50: Tuple[Tuple[str, float], ...] = (
+        ("dpmpp25", 0.97),
+        ("deepcache", 0.97),
+        ("turbo", 0.95),
+        ("int8", 0.98),
+    )
+    # absolute floor for the anchor itself: catches a pipeline bug that
+    # degrades every preset uniformly (ratios would all still pass)
+    ddim50_min_sim: float = 0.18
+
+    def threshold_for(self, preset: str):
+        return dict(self.parity_vs_ddim50).get(preset)
+
+
+@dataclasses.dataclass(frozen=True)
 class FrameworkConfig:
     models: ModelZooConfig = dataclasses.field(default_factory=ModelZooConfig)
     sampler: SamplerConfig = dataclasses.field(default_factory=SamplerConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     game: GameConfig = dataclasses.field(default_factory=GameConfig)
+    quality: QualityGateConfig = dataclasses.field(
+        default_factory=QualityGateConfig)
     seed: int = 0
 
     def replace(self, **kw) -> "FrameworkConfig":

@@ -68,3 +68,59 @@ def test_train_lm_token_file(tmp_path, capsys):
     ])
     assert rc == 0
     assert "[lm] step 0 loss" in capsys.readouterr().out
+
+
+def test_quality_gate_thresholds():
+    """config.QualityGateConfig enforcement (VERDICT r4 #3): the gate
+    annotates per-preset verdicts, fails presets under threshold and a
+    degraded anchor, and passes a clean report."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "clip_report_mod",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "clip_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def report(anchor_sim, parity):
+        return {"presets": {
+            "ddim50": {"clip_sim_mean": anchor_sim},
+            "turbo": {"clip_sim_mean": anchor_sim * parity,
+                      "parity_vs_ddim50": parity},
+        }}
+
+    clean = report(0.30, 0.99)
+    assert mod.apply_quality_gate(clean) == []
+    assert clean["presets"]["turbo"]["gate"]["passed"]
+    assert clean["presets"]["ddim50"]["gate"]["passed"]
+
+    low_parity = report(0.30, 0.90)  # turbo gates at 0.95
+    fails = mod.apply_quality_gate(low_parity)
+    assert len(fails) == 1 and "turbo" in fails[0]
+    assert not low_parity["presets"]["turbo"]["gate"]["passed"]
+
+    dead_anchor = report(0.05, 0.99)  # uniform degradation
+    fails = mod.apply_quality_gate(dead_anchor)
+    assert any("anchor" in f for f in fails)
+
+    # a preset with no configured threshold is reported, never gated
+    ungated = {"presets": {"ddim50": {"clip_sim_mean": 0.3},
+                           "exotic": {"clip_sim_mean": 0.1,
+                                      "parity_vs_ddim50": 0.33}}}
+    assert mod.apply_quality_gate(ungated) == []
+
+
+def test_weights_drill_requires_real_weights_for_round(tmp_path):
+    """The drill's LM-decoded-round leg must refuse to 'pass' on random
+    init at full config — a provisioned-host check, not a plumbing one
+    (exit 5). --tiny remains the plumbing path (covered by the watcher
+    smoke)."""
+    from cassmantle_tpu.__main__ import main
+
+    rc = main(["weights-drill", "--platform", "cpu",
+               "--weights", str(tmp_path / "nope"),
+               "--skip-fetch", "--skip-quantize", "--skip-clip",
+               "--skip-lm-ab"])
+    assert rc == 5

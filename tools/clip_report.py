@@ -87,6 +87,39 @@ def preset_factories(tiny: bool):
     }
 
 
+def apply_quality_gate(report: dict, gate_cfg=None) -> list:
+    """Annotate each gated preset with {threshold, passed} and return
+    the list of human-readable failures (config.QualityGateConfig).
+    Pure on the report dict — unit-tested without pipelines."""
+    if gate_cfg is None:
+        # default thresholds come from the framework config, so a
+        # FrameworkConfig(quality=...) override is the single source
+        from cassmantle_tpu.config import FrameworkConfig
+
+        gate_cfg = FrameworkConfig().quality
+    failures = []
+    anchor = report["presets"].get("ddim50")
+    if anchor:
+        floor = gate_cfg.ddim50_min_sim
+        anchor["gate"] = {"min_sim": floor,
+                          "passed": anchor["clip_sim_mean"] >= floor}
+        if not anchor["gate"]["passed"]:
+            failures.append(
+                f"ddim50 anchor clip_sim_mean "
+                f"{anchor['clip_sim_mean']:.4f} < floor {floor}")
+    for name, entry in report["presets"].items():
+        threshold = gate_cfg.threshold_for(name)
+        if threshold is None or "parity_vs_ddim50" not in entry:
+            continue
+        entry["gate"] = {"threshold": threshold,
+                         "passed": entry["parity_vs_ddim50"] >= threshold}
+        if not entry["gate"]["passed"]:
+            failures.append(
+                f"{name} parity_vs_ddim50 "
+                f"{entry['parity_vs_ddim50']:.4f} < {threshold}")
+    return failures
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     # default resolves against the repo (module-CLI runs from anywhere);
@@ -102,6 +135,9 @@ def main() -> None:
                     help="image batches per preset (n = seeds * 8 prompts)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny configs (plumbing smoke, not a measurement)")
+    ap.add_argument("--enforce", action="store_true",
+                    help="fail the quality gate even on random-init "
+                         "runs (tests the enforcement path)")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -183,11 +219,30 @@ def main() -> None:
                 entry["parity_vs_ddim50"] = float(
                     entry["clip_sim_mean"] / anchor["clip_sim_mean"])
 
+    # Quality-gate enforcement (config.QualityGateConfig): thresholds
+    # are asserted whenever this report is a real measurement — random
+    # init similarity is noise, so plumbing runs report advisory-only
+    # unless --enforce forces the gate (CI of the enforcement path).
+    enforce = report["real_weights"] or args.enforce
+    failures = apply_quality_gate(report)
+    report["gate_enforced"] = bool(enforce)
+    report["gate_failures"] = failures
+
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
     print(f"[clip_report] wrote {args.out} "
           f"(real_weights={report['real_weights']})")
+    if failures:
+        verdict = "FAILED" if enforce else "advisory (random weights)"
+        print(f"[clip_report] quality gate {verdict}:", file=sys.stderr)
+        for f_ in failures:
+            print(f"[clip_report]   {f_}", file=sys.stderr)
+        if enforce:
+            sys.exit(2)
+    elif anchor:
+        print("[clip_report] quality gate passed "
+              f"({'enforced' if enforce else 'advisory'})")
 
 
 if __name__ == "__main__":

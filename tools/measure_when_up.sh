@@ -22,14 +22,20 @@ probe() {
     >/dev/null 2>&1
 }
 
+attempts=0
 while true; do
   if probe; then
-    echo "[watcher] tunnel UP at $(date -u +%H:%M:%S) — measuring"
+    echo "[watcher] tunnel UP at $(date -u +%H:%M:%S) after $attempts failed probes — measuring"
     break
   fi
+  attempts=$((attempts + 1))
   now=$(date +%s)
+  # one line per failed probe: a zero-byte log after an outage round
+  # proved the watcher ran at all only by its exit code (round 4) —
+  # the poll trail itself is the outage evidence
+  echo "[watcher] $(date -u +%H:%M:%SZ) probe $attempts failed ($(((now - start) / 60))/$((DEADLINE_S / 60)) min); tunnel down"
   if [ $((now - start)) -ge "$DEADLINE_S" ]; then
-    echo "[watcher] deadline reached; tunnel still down"
+    echo "[watcher] deadline reached after $attempts failed probes; tunnel down the whole window"
     exit 2
   fi
   sleep "$POLL_S"
@@ -88,7 +94,29 @@ have_ckpt() {
   ls "weights/$1.safetensors" "weights/$1"-*.safetensors >/dev/null 2>&1
 }
 if have_ckpt clip_text && have_ckpt unet && have_ckpt vae; then
-  timeout 7200 python tools/clip_report.py --seeds 2
+  # real_weights=true -> tools/clip_report.py ENFORCES the per-preset
+  # thresholds (config.QualityGateConfig) and exits 2 on a miss; a
+  # failed gate fails the whole watcher run so the fast presets'
+  # throughput numbers can't be quoted without their quality evidence
+  timeout 7200 python tools/clip_report.py --seeds 2 || {
+    rc=$?
+    echo "[watcher] CLIP quality gate FAILED (exit $rc)"
+    exit 3
+  }
+  # LM-decoded-round drill leg: one full game round whose prompt text
+  # genuinely came from the LM (no template fallback) — the seam the
+  # virtual-mesh dryrun can only exercise with random weights. Needs
+  # the LM checkpoint on top of the image stack; a partial provision
+  # (images only) skips rather than failing hours of good measurements
+  if have_ckpt gpt2 || have_ckpt mistral; then
+    timeout 3600 python -m cassmantle_tpu weights-drill \
+      --skip-fetch --skip-quantize --skip-clip --skip-lm-ab || {
+      echo "[watcher] LM-decoded round drill FAILED"
+      exit 4
+    }
+  else
+    echo "[watcher] no LM checkpoint — skipping the LM-decoded round leg"
+  fi
 else
   echo "[watcher] weights/ missing checkpoints — skipping CLIP quality report"
 fi
