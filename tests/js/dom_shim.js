@@ -1,0 +1,158 @@
+/* Minimal DOM shim for driving static/app.js under node (no browser).
+ *
+ * Implements exactly the surface app.js touches — getElementById,
+ * createElement/createTextNode, appendChild, classList
+ * (add/remove/toggle/contains with force semantics), textContent,
+ * innerHTML-clear, dataset, addEventListener/click dispatch,
+ * querySelectorAll for the two selectors the client uses
+ * ("#prompt input", ".privacy-link") — plus browser globals: a
+ * cookie-jar fetch against the real server, a capturable WebSocket,
+ * localStorage, and location. Element ids come from the REAL
+ * static/index.html so a renamed id fails here the way it would fail
+ * in a browser.
+ *
+ * Used by run_app.js; skipped entirely when node is absent
+ * (tests/test_js_runtime.py gates on shutil.which("node")).
+ */
+
+"use strict";
+
+class ClassList {
+  constructor() { this._set = new Set(); }
+  add(...cs) { cs.forEach((c) => this._set.add(c)); }
+  remove(...cs) { cs.forEach((c) => this._set.delete(c)); }
+  contains(c) { return this._set.has(c); }
+  toggle(c, force) {
+    const want = force === undefined ? !this._set.has(c) : !!force;
+    if (want) this._set.add(c); else this._set.delete(c);
+    return want;
+  }
+}
+
+class Element {
+  constructor(tag) {
+    this.tagName = String(tag || "div").toUpperCase();
+    this.children = [];
+    this.classList = new ClassList();
+    this.dataset = {};
+    this.textContent = "";
+    this.value = "";
+    this.listeners = {};
+    this.parent = null;
+  }
+  set className(v) {
+    this.classList = new ClassList();
+    String(v).split(/\s+/).filter(Boolean)
+      .forEach((c) => this.classList.add(c));
+  }
+  get className() { return [...this.classList._set].join(" "); }
+  set innerHTML(v) {
+    if (v === "") this.children = [];
+    else throw new Error("shim supports innerHTML='' only");
+  }
+  appendChild(child) {
+    if (child && child.nodeType !== 3) child.parent = this;
+    this.children.push(child);
+    return child;
+  }
+  addEventListener(type, fn) {
+    (this.listeners[type] = this.listeners[type] || []).push(fn);
+  }
+  dispatch(type, ev) {
+    (this.listeners[type] || []).forEach((fn) => fn({
+      preventDefault() {}, target: this, key: "", ...ev,
+    }));
+  }
+  click() { this.dispatch("click", {}); }
+  *walk() {
+    for (const c of this.children) {
+      if (c && c.nodeType !== 3) { yield c; yield* c.walk(); }
+    }
+  }
+}
+
+function setupDom(base, indexHtml) {
+  const byId = new Map();
+  // ids AND initial classes from the real page, so renames break the
+  // harness like a browser — and "game starts hidden" is really true
+  for (const m of indexHtml.matchAll(/<(\w+)([^>]*)\bid="([^"]+)"([^>]*)>/g)) {
+    const el = new Element(m[1]);
+    const cls = (m[2] + m[4]).match(/class="([^"]*)"/);
+    if (cls) el.className = cls[1];
+    byId.set(m[3], el);
+  }
+  const privacyLink = new Element("a");
+  privacyLink.className = "privacy-link";
+
+  const documentEl = {
+    getElementById: (id) => byId.get(id) || null,
+    createElement: (tag) => new Element(tag),
+    createTextNode: (text) => ({ nodeType: 3, text }),
+    addEventListener: () => {},
+    querySelectorAll: (sel) => {
+      const m = sel.match(/^#([\w-]+)\s+(\w+)$/);
+      if (m) {
+        const root = byId.get(m[1]);
+        if (!root) return [];
+        return [...root.walk()].filter(
+          (e) => e.tagName === m[2].toUpperCase());
+      }
+      if (sel.startsWith(".")) {
+        const cls = sel.slice(1);
+        const all = [privacyLink, ...byId.values()];
+        return all.filter((e) => e.classList.contains(cls));
+      }
+      return [];
+    },
+  };
+
+  // cookie-jar fetch: node's fetch has no browser cookie store, but
+  // the client relies on the aiohttp session cookie riding every call
+  const jar = {};
+  const realFetch = globalThis.fetch.bind(globalThis);
+  const cookieFetch = async (url, opts = {}) => {
+    const full = url.startsWith("http") ? url : base + url;
+    const headers = { ...(opts.headers || {}) };
+    const cookie = Object.entries(jar)
+      .map(([k, v]) => `${k}=${v}`).join("; ");
+    if (cookie) headers.Cookie = cookie;
+    const res = await realFetch(full, { ...opts, headers });
+    const setCookies = res.headers.getSetCookie
+      ? res.headers.getSetCookie() : [];
+    for (const line of setCookies) {
+      const [kv] = line.split(";");
+      const eq = kv.indexOf("=");
+      if (eq > 0) jar[kv.slice(0, eq).trim()] = kv.slice(eq + 1).trim();
+    }
+    return res;
+  };
+
+  const sockets = [];
+  class FakeWebSocket {
+    constructor(url) { this.url = url; sockets.push(this); }
+    send() {}
+    close() {}
+  }
+
+  const store = {};
+  const dom = {
+    byId, sockets, jar, privacyLink,
+    $ : (id) => byId.get(id),
+    fire(type, sel, ev) { byId.get(sel).dispatch(type, ev); },
+  };
+
+  Object.assign(globalThis, {
+    document: documentEl,
+    window: globalThis,
+    location: new URL(base),
+    localStorage: {
+      getItem: (k) => (k in store ? store[k] : null),
+      setItem: (k, v) => { store[k] = String(v); },
+    },
+    WebSocket: FakeWebSocket,
+    fetch: cookieFetch,
+  });
+  return dom;
+}
+
+module.exports = { setupDom, Element };

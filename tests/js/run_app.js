@@ -1,0 +1,118 @@
+/* Drive static/app.js — the REAL client code — against a REAL running
+ * server (the --fake backend), asserting the flows the contract tests
+ * (tests/test_frontend.py) can only grep for: boot, the per-word
+ * spellcheck hold, guess scoring feedback, the win banner, and the
+ * ws-reset refetch. Prints one JSON line of scenario results;
+ * any assertion failure exits nonzero.
+ *
+ * Usage: node run_app.js <base-url> <answers-json>
+ *   answers-json: {"<maskIdx>": "<exact word>", ...} — computed by the
+ *   pytest side from the deterministic fake backend.
+ */
+
+"use strict";
+
+const fs = require("fs");
+const path = require("path");
+const vm = require("vm");
+
+const base = process.argv[2];
+const answers = JSON.parse(process.argv[3] || "{}");
+const STATIC = path.join(__dirname, "..", "..", "static");
+
+const { setupDom } = require("./dom_shim.js");
+const dom = setupDom(base, fs.readFileSync(
+  path.join(STATIC, "index.html"), "utf8"));
+
+vm.runInThisContext(
+  fs.readFileSync(path.join(STATIC, "spell.js"), "utf8"),
+  { filename: "spell.js" });
+globalThis.Spell = window.Spell;
+
+const results = {};
+const assert = (cond, label) => {
+  results[label] = !!cond;
+  if (!cond) {
+    process.stderr.write(`FAIL: ${label}\n` + JSON.stringify(results));
+    process.exit(1);
+  }
+};
+const sleep = (ms) => new Promise((r) => setTimeout(r, ms));
+async function waitFor(fn, label, timeoutMs = 30000) {
+  const t0 = Date.now();
+  while (Date.now() - t0 < timeoutMs) {
+    if (fn()) return;
+    await sleep(50);
+  }
+  assert(false, `timeout: ${label}`);
+}
+
+(async () => {
+  const $ = dom.$;
+
+  vm.runInThisContext(
+    fs.readFileSync(path.join(STATIC, "app.js"), "utf8"),
+    { filename: "app.js" });
+
+  // ---- boot: splash -> game, inputs rendered at mask indices ----
+  await waitFor(() => !$("game").classList.contains("hidden"),
+                "boot: game visible");
+  assert($("splash").classList.contains("hidden"), "boot: splash hidden");
+  const inputs = document.querySelectorAll("#prompt input");
+  assert(inputs.length >= 1, "boot: mask inputs rendered");
+  assert(Object.keys(answers).length >= inputs.length,
+         "boot: answers cover masks");
+
+  // ---- consent flow (first visit: notice shown, ok hides it) ----
+  assert(!$("consent").classList.contains("hidden"), "consent: shown");
+  $("consent-ok").click();
+  assert($("consent").classList.contains("hidden"), "consent: dismissed");
+
+  // ---- spellcheck hold: first submit of a misspelled word is held,
+  // the SAME word resubmitted goes through (per-word escape hatch) ----
+  inputs.forEach((inp) => { inp.value = ""; });
+  inputs[0].value = "lighthosue";
+  $("submit").click();
+  await waitFor(() => $("feedback").textContent.includes("unusual word"),
+                "hold: flagged once");
+  $("submit").click();  // same word again -> sent to the server
+  await waitFor(() => !$("feedback").textContent.includes("unusual word"),
+                "hold: resubmit goes through");
+
+  // ---- scoring feedback for a wrong-but-valid guess ----
+  // (re-query: the scored submit above re-rendered #prompt's inputs)
+  const inputs2 = document.querySelectorAll("#prompt input");
+  inputs2.forEach((inp) => { inp.value = "stormy"; });
+  $("submit").click();
+  await waitFor(() => /close|cold/.test($("feedback").textContent),
+                "score: feedback rendered");
+
+  // ---- win flow: exact answers -> banner ----
+  const inputsNow = document.querySelectorAll("#prompt input");
+  inputsNow.forEach((inp) => { inp.value = answers[inp.dataset.mask]; });
+  $("submit").click();
+  await waitFor(() => !$("win-banner").classList.contains("hidden"),
+                "win: banner shown");
+
+  // ---- ws reset: clock renders, state clears, content refetched ----
+  const ws = dom.sockets[dom.sockets.length - 1];
+  assert(ws && ws.url.endsWith("/clock"), "ws: clock socket opened");
+  ws.onmessage({ data: JSON.stringify(
+    { time: "00:30", conns: 3, reset: false }) });
+  assert($("clock").textContent === "00:30", "ws: clock text");
+  assert($("clock").classList.contains("blink"), "ws: blink under 60s");
+  assert($("player-count").textContent === "3", "ws: player count");
+  ws.onmessage({ data: JSON.stringify(
+    { time: "15:00", conns: 3, reset: true }) });
+  await waitFor(() => $("win-banner").classList.contains("hidden"),
+                "reset: banner cleared");
+  assert(!$("clock").classList.contains("blink"), "reset: blink off");
+  assert($("feedback").textContent === "", "reset: feedback cleared");
+
+  process.stdout.write(JSON.stringify(results));
+  process.exit(0);
+})().catch((e) => {
+  process.stderr.write(String(e.stack || e) + "\n" +
+                       JSON.stringify(results));
+  process.exit(1);
+});

@@ -1,0 +1,32 @@
+/* Execute static/spell.js — the REAL file, in a real JS runtime — over
+ * golden cases, printing {word: {check, suggest}} JSON for the Python
+ * side (tests/test_js_runtime.py) to compare against utils/spell.py.
+ * The lockstep contract between the two implementations is otherwise
+ * only enforced by rule-set text parity (test_spell_rule_parity);
+ * this runs the actual code.
+ *
+ * Usage: node run_spell.js <wordlist.txt>   (cases JSON on stdin)
+ */
+
+"use strict";
+
+const fs = require("fs");
+const path = require("path");
+const vm = require("vm");
+
+const wordlistPath = process.argv[2];
+const words = fs.readFileSync(wordlistPath, "utf8")
+  .split("\n").map((w) => w.trim()).filter(Boolean);
+
+globalThis.window = globalThis;
+const spellSrc = fs.readFileSync(
+  path.join(__dirname, "..", "..", "static", "spell.js"), "utf8");
+vm.runInThisContext(spellSrc, { filename: "spell.js" });
+
+const spell = new window.Spell(words);
+const cases = JSON.parse(fs.readFileSync(0, "utf8"));
+const out = {};
+for (const word of cases) {
+  out[word] = { check: spell.check(word), suggest: spell.suggest(word, 3) };
+}
+process.stdout.write(JSON.stringify(out));

@@ -40,6 +40,9 @@ import os
 import re
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 WORD_RE = re.compile(r"[A-Za-z]{2,15}")
 VOWELS = set("aeiouy")
 CONS_RUN = re.compile(r"[bcdfghjklmnpqrstvwxz]{5,}")
@@ -70,6 +73,9 @@ shimmer shiver silken silver slate smolder snowy solace sorrow spark
 sparkle spire starlit storm stormy stream summit sunset thistle thorn
 thunder tide timber topaz tranquil twilight velvet verdant violet
 wander wandering whisper wildflower willow wisp wistful zephyr
+bramble furl unfurl eddy knoll dell glen fen heath crag vale copse
+thicket bracken gorse sedge tarn scree brook rivulet hillock
+outcrop updraft gloaming murk dapple dappled
 """.split()
 
 TEXT_EXTS = (".py", ".md", ".rst", ".txt")
@@ -81,6 +87,8 @@ READ_CAP = 120_000
 DEFAULT_ROOTS = (
     "/usr/share/doc",
     "/opt/venv/lib/python3.12/site-packages",
+    "/usr/lib/python3",
+    "/usr/lib/python3.12",
 )
 
 # default output resolves against the repo, not the cwd: the server
@@ -138,22 +146,77 @@ def mine(roots, progress_every: int = 10_000):
     return df, caps, prose_df
 
 
-def select(df, caps, min_df: int):
+def _shape_ok(w: str) -> bool:
+    if len(w) < 2 or len(w) > 17:
+        return False
+    if len(w) == 2 and w not in TWO_LETTER:
+        return False
+    if not (set(w) & VOWELS):
+        return False
+    return not (CONS_RUN.search(w) or REPEAT_RUN.search(w))
+
+
+def select(df, caps, min_df: int, prose_df=None):
+    """Inclusion: full-corpus df >= min_df, OR prose df >= 2 — a word
+    seen in two independent NON-code documents (READMEs, docs,
+    licenses) is edited English even when the whole-corpus count misses
+    the bar; code-file sightings are much weaker per-occurrence
+    evidence (identifiers), so they keep the higher threshold."""
+    prose_df = prose_df or {}
     out = []
     for w, c in df.items():
-        if c < min_df:
+        if c < min_df and prose_df.get(w, 0) < 2:
             continue
-        if len(w) == 2 and w not in TWO_LETTER:
-            continue
-        if not (set(w) & VOWELS):
-            continue
-        if CONS_RUN.search(w) or REPEAT_RUN.search(w):
+        if not _shape_ok(w):
             continue
         # proper nouns: predominantly Capitalized in the corpus
         if caps.get(w, 0) > 3 * c:
             continue
         out.append(w)
     out.extend(CURATED_LITERARY)
+    return out
+
+
+def _affix_forms(w: str):
+    """Regular English inflections/derivations of ``w``: plural,
+    verbal -ed/-ing (e-drop, y->ie, consonant doubling — shared with
+    the POS classifier's morphology), comparative/superlative, -ly,
+    and un-/re- prefixes."""
+    from cassmantle_tpu.engine.pos import _inflections
+
+    forms = set(_inflections(w))
+    if w.endswith(("s", "x", "z", "ch", "sh")):
+        forms.add(w + "es")
+    elif w.endswith("y") and len(w) > 2 and w[-2] not in "aeiou":
+        forms.update((w[:-1] + "ies", w[:-1] + "ily",
+                      w[:-1] + "ier", w[:-1] + "iest"))
+    else:
+        forms.add(w + "s")
+    if w.endswith("e"):
+        forms.update((w + "r", w + "st", w[:-1] + "y"))
+    else:
+        forms.update((w + "er", w + "est"))
+    forms.update((w + "ly", "un" + w, "re" + w))
+    return forms
+
+
+def expand_inflections(accepted, df):
+    """Affix expansion at build time, gated by corpus EVIDENCE: a
+    regular inflection of an accepted word joins the lexicon when the
+    corpus saw it at all (df >= 1), even under the min-df bar. This is
+    the role hunspell's affix flags play in the reference's 49,569-entry
+    en_US.dic (data/en_US.dic affix classes, expanded by typo.js) —
+    derived here from morphology + at-least-one sighting instead of
+    per-word flag curation, so rare-but-valid forms ("zephyrs",
+    "shimmering") don't hold correct guesses hostage."""
+    base = set(accepted)
+    out = set()
+    for w in base:
+        for form in _affix_forms(w):
+            if form in base or form in out:
+                continue
+            if df.get(form, 0) >= 1 and _shape_ok(form):
+                out.add(form)
     return out
 
 
@@ -167,7 +230,7 @@ def main() -> None:
     args = ap.parse_args()
 
     df, caps, prose_df = mine(args.roots)
-    words = set(select(df, caps, args.min_df))
+    words = set(select(df, caps, args.min_df, prose_df))
     mined = len(words)
 
     if not args.no_merge_existing and os.path.exists(args.out):
@@ -179,6 +242,11 @@ def main() -> None:
             w = line.strip().lower()
             if w and curated_re.fullmatch(w):
                 words.add(w)
+
+    expanded = expand_inflections(words, df)
+    words |= expanded
+    print(f"[build_wordlist] affix expansion added {len(expanded)} "
+          f"corpus-seen inflections", file=sys.stderr)
 
     # Rank by PROSE frequency first (code identifiers must not outrank
     # story-English), full-corpus frequency as the tie-break, then

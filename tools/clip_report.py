@@ -126,8 +126,12 @@ def main() -> None:
     # an explicit --weights keeps its shell meaning
     ap.add_argument("--weights",
                     default=os.path.join(REPO_ROOT, "weights"))
-    ap.add_argument("--out",
-                    default=os.path.join(REPO_ROOT, "CLIP_REPORT.json"))
+    ap.add_argument("--out", default=None,
+                    help="report path; defaults to CLIP_REPORT.json, or "
+                         "CLIP_REPORT.tiny.json under --tiny so a "
+                         "plumbing smoke can never overwrite hardware "
+                         "evidence (same split as bench.py's cpu-smoke "
+                         "suite file)")
     ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
     ap.add_argument("--presets",
                     default="ddim50,dpmpp25,deepcache,turbo,int8")
@@ -139,6 +143,10 @@ def main() -> None:
                     help="fail the quality gate even on random-init "
                          "runs (tests the enforcement path)")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = os.path.join(
+            REPO_ROOT,
+            "CLIP_REPORT.tiny.json" if args.tiny else "CLIP_REPORT.json")
 
     if args.platform == "cpu":
         from cassmantle_tpu.utils.xla_flags import pin_cpu_platform

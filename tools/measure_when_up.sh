@@ -100,8 +100,15 @@ if have_ckpt clip_text && have_ckpt unet && have_ckpt vae; then
   # throughput numbers can't be quoted without their quality evidence
   timeout 7200 python tools/clip_report.py --seeds 2 || {
     rc=$?
-    echo "[watcher] CLIP quality gate FAILED (exit $rc)"
-    exit 3
+    # exit 2 is clip_report's explicit gate verdict; anything else
+    # (timeout 124, crash) is infra — report it as such, never as a
+    # quality miss
+    if [ "$rc" -eq 2 ]; then
+      echo "[watcher] CLIP quality gate FAILED (threshold miss)"
+      exit 3
+    fi
+    echo "[watcher] CLIP report errored (exit $rc) — infra, not a gate verdict"
+    exit 5
   }
   # LM-decoded-round drill leg: one full game round whose prompt text
   # genuinely came from the LM (no template fallback) — the seam the
