@@ -60,6 +60,10 @@ class MultiHeadAttention(nn.Module):
     # be independent or real weights can't load faithfully. None -> same
     # as use_bias.
     out_bias: Optional[bool] = None
+    # Fuse q/k/v (self-attn) or k/v (cross-attn) into one projection
+    # dot — full-forward sites only (UNet); incompatible with the
+    # kv-cache decode path, which updates k/v separately.
+    fused_qkv: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -80,12 +84,32 @@ class MultiHeadAttention(nn.Module):
         out_dim = self.out_dim or features
         ctx = x if context is None else context
 
-        dense = lambda name: nn.Dense(  # noqa: E731
-            inner, use_bias=self.use_bias, dtype=self.dtype, name=name
+        dense = lambda name, mult=1: nn.Dense(  # noqa: E731
+            mult * inner, use_bias=self.use_bias, dtype=self.dtype,
+            name=name
         )
-        q = dense("q")(x)
-        k = dense("k")(ctx)
-        v = dense("v")(ctx)
+        if self.fused_qkv:
+            # One projection dot instead of three: the input activation
+            # streams from HBM once (the q/k/v kernels read the same x),
+            # and the MXU sees one (M, C)x(C, 3C) matmul whose wider N
+            # pads the 128-lane tile boundary once, not three times —
+            # the optimization the UNet cost table indicates
+            # (docs/PERF_NOTES.md): projection dots are ~17% of UNet
+            # FLOPs across 32 attention sites. Checkpoint layout is
+            # unchanged — the converters concatenate the published
+            # to_q/to_k/to_v tensors at load (weights.py dense_fused).
+            assert kv_cache is None and not return_kv, (
+                "fused_qkv is a full-forward optimization; decode "
+                "caching uses the separate-projection layout")
+            if context is None:
+                q, k, v = jnp.split(dense("qkv", 3)(x), 3, axis=-1)
+            else:
+                q = dense("q")(x)
+                k, v = jnp.split(dense("kv", 2)(ctx), 2, axis=-1)
+        else:
+            q = dense("q")(x)
+            k = dense("k")(ctx)
+            v = dense("v")(ctx)
 
         split = lambda t: t.reshape(  # noqa: E731
             t.shape[:-1] + (self.num_heads, head_dim)

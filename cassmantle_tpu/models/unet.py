@@ -71,15 +71,18 @@ class BasicTransformerBlock(nn.Module):
     def __call__(self, x, context):
         h = LayerNorm32(name="ln1")(x)
         # bias-free q/k/v but biased out-projection: the published UNet
-        # layout (manifests unet_sd15/unet_sdxl: to_out.0 has a bias)
+        # layout (manifests unet_sd15/unet_sdxl: to_out.0 has a bias).
+        # fused_qkv: one projection matmul per site instead of three
+        # (converters concatenate to_q/to_k/to_v at load) — the UNet
+        # only ever runs full forwards, never cached decode.
         x = x + MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype, use_bias=False,
-            out_bias=True, name="self_attn",
+            out_bias=True, fused_qkv=True, name="self_attn",
         )(h)
         h = LayerNorm32(name="ln2")(x)
         x = x + MultiHeadAttention(
             num_heads=self.num_heads, dtype=self.dtype, use_bias=False,
-            out_bias=True, name="cross_attn",
+            out_bias=True, fused_qkv=True, name="cross_attn",
         )(h, context=context)
         h = LayerNorm32(name="ln3")(x)
         x = x + GEGLU(

@@ -98,6 +98,18 @@ class Converter:
         if self.has(f"{src}.bias"):
             self.put(f"{dst}/bias", self.take(f"{src}.bias"))
 
+    def dense_fused(self, srcs, dst: str) -> None:
+        """Concatenate several published projections into ONE Dense
+        (kernel axis 1 = output features): the load-time half of the
+        fused-QKV optimization (layers.MultiHeadAttention fused_qkv) —
+        checkpoints keep their authentic separate to_q/to_k/to_v
+        tensors; the in-memory tree holds them as one matmul."""
+        kernels = [_t(self.take(f"{s}.weight")) for s in srcs]
+        self.put(f"{dst}/kernel", np.concatenate(kernels, axis=1))
+        if self.has(f"{srcs[0]}.bias"):
+            self.put(f"{dst}/bias", np.concatenate(
+                [self.take(f"{s}.bias") for s in srcs], axis=0))
+
     def conv(self, src: str, dst: str) -> None:
         self.put(f"{dst}/kernel", _conv(self.take(f"{src}.weight")))
         if self.has(f"{src}.bias"):
@@ -354,14 +366,13 @@ def _convert_spatial_transformer(c: Converter, src: str, dst: str,
         tsrc = f"{src}.transformer_blocks.{k}"
         tdst = f"{dst}/block_{k}"
         c.norm(f"{tsrc}.norm1", f"{tdst}/ln1")
-        c.dense(f"{tsrc}.attn1.to_q", f"{tdst}/self_attn/q")
-        c.dense(f"{tsrc}.attn1.to_k", f"{tdst}/self_attn/k")
-        c.dense(f"{tsrc}.attn1.to_v", f"{tdst}/self_attn/v")
+        c.dense_fused((f"{tsrc}.attn1.to_q", f"{tsrc}.attn1.to_k",
+                       f"{tsrc}.attn1.to_v"), f"{tdst}/self_attn/qkv")
         c.dense(f"{tsrc}.attn1.to_out.0", f"{tdst}/self_attn/out")
         c.norm(f"{tsrc}.norm2", f"{tdst}/ln2")
         c.dense(f"{tsrc}.attn2.to_q", f"{tdst}/cross_attn/q")
-        c.dense(f"{tsrc}.attn2.to_k", f"{tdst}/cross_attn/k")
-        c.dense(f"{tsrc}.attn2.to_v", f"{tdst}/cross_attn/v")
+        c.dense_fused((f"{tsrc}.attn2.to_k", f"{tsrc}.attn2.to_v"),
+                      f"{tdst}/cross_attn/kv")
         c.dense(f"{tsrc}.attn2.to_out.0", f"{tdst}/cross_attn/out")
         c.norm(f"{tsrc}.norm3", f"{tdst}/ln3")
         c.dense(f"{tsrc}.ff.net.0.proj", f"{tdst}/ff/proj")

@@ -26,8 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Conv (H, W, in, out), Embed (vocab, dim).
 TP_RULES: List[Tuple[str, P]] = [
     # attention projections
-    (r".*/(self_attn|cross_attn|attn)/(q|k|v)/kernel$", P(None, "tp")),
-    (r".*/(self_attn|cross_attn|attn)/(q|k|v)/bias$", P("tp")),
+    (r".*/(self_attn|cross_attn|attn)/(q|k|v|qkv|kv)/kernel$", P(None, "tp")),
+    (r".*/(self_attn|cross_attn|attn)/(q|k|v|qkv|kv)/bias$", P("tp")),
     (r".*/(self_attn|cross_attn|attn)/out/kernel$", P("tp", None)),
     # MLP / GEGLU / SwiGLU (Mistral gate+up shard columns, down rows)
     (r".*/(mlp|ff)/(fc1|proj|gate|up)/kernel$", P(None, "tp")),

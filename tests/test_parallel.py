@@ -91,9 +91,9 @@ def test_tp_params_actually_sharded(cfg):
     params = init_params(model, 0, lat, t, ctx)
     mesh = make_mesh(MeshConfig(dp=2, tp=4, sp=1))
     sharded = shard_params(params, mesh)
-    kernel = sharded["params"]["down_0_attn_0"]["block_0"]["self_attn"]["q"][
-        "kernel"
-    ]
+    kernel = sharded["params"]["down_0_attn_0"]["block_0"]["self_attn"][
+        "qkv"
+    ]["kernel"]
     spec = kernel.sharding.spec
     assert tuple(spec) == (None, "tp"), spec
     # conv kernels replicated

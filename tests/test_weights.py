@@ -322,6 +322,25 @@ def fabricate_unet(params, levels):
     for path, leaf in _flat(params).items():
         assert path.startswith("params/")
         rel = path[len("params/"):]
+        # fused qkv/kv kernels (layers.MultiHeadAttention fused_qkv)
+        # fabricate back into the PUBLISHED separate to_q/to_k/to_v
+        # tensors — the checkpoint format never changed, only the
+        # in-memory tree; dense_fused re-concatenates at load
+        fused = re.match(r"(.*)/(self_attn/qkv|cross_attn/kv)/kernel$",
+                         rel)
+        if fused:
+            outer, which = fused.group(1), fused.group(2)
+            module = which.split("/")[0]
+            anchor, _ = _unet_reverse_name(
+                f"{outer}/{module}/out/kernel", levels)
+            base = anchor[: -len(".to_out.0")]
+            names = (("to_q", "to_k", "to_v")
+                     if which.endswith("qkv") else ("to_k", "to_v"))
+            for n, part in zip(names,
+                               np.split(np.asarray(leaf), len(names),
+                                        axis=1)):
+                out[f"{base}.{n}.weight"] = _torch_dense(part)
+            continue
         name, leaf_name = _unet_reverse_name(rel, levels)
         out[f"{name}.{_LEAF_MAP[leaf_name]}"] = _to_torch_value(
             leaf_name, leaf, name)

@@ -42,6 +42,70 @@ def timeit(fn, *args, reps=10):
         return _timeit("", fn, *args, reps=reps)
 
 
+def cost_table(fn, *args, top: int = 10):
+    """Analytic per-op cost table from the jaxpr: FLOPs for every
+    dot/conv (shape-derived — backend-independent, so it is valid even
+    when compiled on CPU), grouped by (primitive, operand shapes),
+    sorted by total FLOPs. The HARDWARE complement is the optimized-HLO
+    dump (--dump-hlo) plus PROFILE_UNET.txt timings: this table says
+    where the FLOPs are; the dump says what XLA fused around them."""
+    import collections
+    import math
+
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    groups = collections.defaultdict(lambda: [0, 0.0])  # count, flops
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    visit(sub.jaxpr)
+                elif isinstance(sub, (list, tuple)):
+                    for s in sub:
+                        if hasattr(s, "jaxpr"):
+                            visit(s.jaxpr)
+            name = eqn.primitive.name
+            shapes = tuple(tuple(getattr(v.aval, "shape", ()))
+                           for v in eqn.invars)
+            flops = 0.0
+            if name == "dot_general":
+                dims = eqn.params["dimension_numbers"]
+                (lc, _), (lb, _) = dims
+                a = eqn.invars[0].aval.shape
+                b_shape = eqn.invars[1].aval.shape
+                out = eqn.outvars[0].aval.shape
+                k = math.prod(a[i] for i in lc) or 1
+                flops = 2.0 * math.prod(out) * k
+            elif name == "conv_general_dilated":
+                out = eqn.outvars[0].aval.shape
+                rhs = eqn.invars[1].aval.shape
+                dn = eqn.params["dimension_numbers"]
+                # per output element: 2 * C_in * prod(kernel spatial)
+                rhs_spec = dn.rhs_spec  # (out_c, in_c, *spatial)
+                cin = rhs[rhs_spec[1]]
+                spatial = [rhs[i] for i in rhs_spec[2:]]
+                flops = 2.0 * math.prod(out) * cin * math.prod(spatial)
+            else:
+                continue
+            key = (name, shapes)
+            groups[key][0] += 1
+            groups[key][1] += flops
+
+    visit(jaxpr.jaxpr)
+    rows = sorted(groups.items(), key=lambda kv: -kv[1][1])
+    total = sum(v[1] for v in groups.values())
+    out_rows = []
+    for (name, shapes), (count, flops) in rows[:top]:
+        out_rows.append({
+            "op": name,
+            "shapes": "x".join(str(list(s)) for s in shapes[:2]),
+            "count": count,
+            "gflops": round(flops / 1e9, 2),
+            "pct": round(100 * flops / total, 1) if total else 0.0,
+        })
+    return out_rows, total
+
+
 def main():
     import argparse
 
@@ -50,7 +114,15 @@ def main():
     ap.add_argument("batch", nargs="?", type=int, default=8)
     ap.add_argument("--dump-hlo", action="store_true",
                     help="write the backend-optimized HLO to UNET_HLO.txt")
+    ap.add_argument("--cost-table", action="store_true",
+                    help="print the top-op analytic FLOP table "
+                         "(shape-derived; valid on any backend) and exit")
+    ap.add_argument("--platform", default="auto", choices=("auto", "cpu"))
     opts = ap.parse_args()  # rejects unknown/typo'd flags
+    if opts.platform == "cpu":
+        from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
+
+        pin_cpu_platform(virtual_devices=False)
     enable_compile_cache()
     batch = opts.batch
     cfg = FrameworkConfig()
@@ -71,6 +143,20 @@ def main():
         cast_to="bfloat16")
 
     step = jax.jit(lambda p, l, t, c: model.apply(p, l, t, c))
+
+    if opts.cost_table:
+        rows, total = cost_table(
+            lambda p, l, t, c: model.apply(p, l, t, c),
+            params, lat, ts, ctx)
+        print(f"UNet forward, batch={batch}: "
+              f"{total / 1e12:.3f} analytic TFLOPs (dot/conv)")
+        print(f"{'op':22s} {'operand shapes':46s} "
+              f"{'count':>5s} {'GFLOP':>9s} {'%':>5s}")
+        for r in rows:
+            print(f"{r['op']:22s} {r['shapes']:46s} "
+                  f"{r['count']:5d} {r['gflops']:9.1f} {r['pct']:5.1f}")
+        return
+
     lowered = step.lower(params, lat, ts, ctx)
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
