@@ -315,6 +315,37 @@ def bench_gpt2(weights_dir: str) -> dict:
     }
 
 
+def bench_gpt2_b4(weights_dir: str) -> dict:
+    """Batched-decode A/B vs the `gpt2` entry: 4 prompts through ONE
+    decode_ids_batch dispatch (the prompt-queue serving path,
+    serving/pipeline.py BATCH_BUCKETS) — aggregate tokens/sec should
+    scale well past the single-prompt number because the per-step
+    matmuls go from M=1 to M=4 on the same weights stream."""
+    jax = _setup_jax()
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    gen = PromptGenerator(FrameworkConfig(), weights_dir=weights_dir)
+    seeds = ["The lighthouse keeper walked down the winding stair",
+             "A caravan crossed the silver dunes at dawn",
+             "The night train rattled between sleeping cities",
+             "An orchard bloomed under two pale moons"]
+    gen.decode_ids_batch(seeds, max_new_tokens=96)  # warmup
+    tps = 0.0
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _, gen_len = gen.decode_ids_batch(seeds, max_new_tokens=96)
+        n = int(jax.block_until_ready(gen_len).sum())
+        tps = max(tps, n / (time.perf_counter() - t0))
+    return {
+        "metric": "gpt2_greedy_batch4_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "batch": len(seeds),
+    }
+
+
 def _bench_sdxl_with(config_factory, metric: str,
                      weights_dir: str) -> dict:
     """Shared SDXL harness (one timing methodology for both SDXL
@@ -491,6 +522,7 @@ SUITE = {
     "sdxl_turbo": bench_sdxl_turbo,
     "scorer": bench_scorer,
     "gpt2": bench_gpt2,
+    "gpt2_b4": bench_gpt2_b4,
     "e2e": bench_e2e_round,
     "soak": bench_soak,
 }
