@@ -288,62 +288,55 @@ def bench_scorer(weights_dir: str) -> dict:
     }
 
 
-def bench_gpt2(weights_dir: str) -> dict:
-    """BASELINE ladder #2: GPT-2-small greedy decode, tokens/sec.
-
-    Counts tokens actually generated (greedy_decode reports gen_len and
-    stops at EOS), not the requested maximum."""
+def _bench_gpt2_with(seeds, metric: str, weights_dir: str) -> dict:
+    """Shared GPT-2 decode harness (one timing methodology for the
+    single-prompt and batched entries): warmup compile, 5 best-of reps
+    through decode_ids_batch (decode_ids is its B=1 case), aggregate
+    tokens ACTUALLY generated per second (gen_len stops at EOS)."""
     jax = _setup_jax()
     from cassmantle_tpu.config import FrameworkConfig
     from cassmantle_tpu.serving.pipeline import PromptGenerator
 
     gen = PromptGenerator(FrameworkConfig(), weights_dir=weights_dir)
-    seed_text = "The lighthouse keeper walked down the winding stair"
-    gen.decode_ids(seed_text, max_new_tokens=96)  # warmup
-
-    tps = 0.0
-    for _ in range(5):
-        t0 = time.perf_counter()
-        _, gen_len = gen.decode_ids(seed_text, max_new_tokens=96)
-        n = int(jax.block_until_ready(gen_len)[0])
-        tps = max(tps, n / (time.perf_counter() - t0))
-    return {
-        "metric": "gpt2_greedy_tokens_per_sec",
-        "value": round(tps, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": None,
-    }
-
-
-def bench_gpt2_b4(weights_dir: str) -> dict:
-    """Batched-decode A/B vs the `gpt2` entry: 4 prompts through ONE
-    decode_ids_batch dispatch (the prompt-queue serving path,
-    serving/pipeline.py BATCH_BUCKETS) — aggregate tokens/sec should
-    scale well past the single-prompt number because the per-step
-    matmuls go from M=1 to M=4 on the same weights stream."""
-    jax = _setup_jax()
-    from cassmantle_tpu.config import FrameworkConfig
-    from cassmantle_tpu.serving.pipeline import PromptGenerator
-
-    gen = PromptGenerator(FrameworkConfig(), weights_dir=weights_dir)
-    seeds = ["The lighthouse keeper walked down the winding stair",
-             "A caravan crossed the silver dunes at dawn",
-             "The night train rattled between sleeping cities",
-             "An orchard bloomed under two pale moons"]
     gen.decode_ids_batch(seeds, max_new_tokens=96)  # warmup
+
     tps = 0.0
     for _ in range(5):
         t0 = time.perf_counter()
         _, gen_len = gen.decode_ids_batch(seeds, max_new_tokens=96)
         n = int(jax.block_until_ready(gen_len).sum())
         tps = max(tps, n / (time.perf_counter() - t0))
-    return {
-        "metric": "gpt2_greedy_batch4_tokens_per_sec",
+    res = {
+        "metric": metric,
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": None,
-        "batch": len(seeds),
     }
+    if len(seeds) > 1:
+        res["batch"] = len(seeds)
+    return res
+
+
+def bench_gpt2(weights_dir: str) -> dict:
+    """BASELINE ladder #2: GPT-2-small greedy decode, tokens/sec."""
+    return _bench_gpt2_with(
+        ["The lighthouse keeper walked down the winding stair"],
+        "gpt2_greedy_tokens_per_sec", weights_dir)
+
+
+def bench_gpt2_b4(weights_dir: str) -> dict:
+    """Batched-decode A/B vs the `gpt2` entry: 4 prompts through ONE
+    decode_ids_batch dispatch (the prompt-queue serving path,
+    serving/pipeline.py BATCH_BUCKETS; all four seeds share the
+    32-token prompt bucket) — aggregate tokens/sec should scale well
+    past the single-prompt number because the per-step matmuls go from
+    M=1 to M=4 on the same weights stream."""
+    return _bench_gpt2_with(
+        ["The lighthouse keeper walked down the winding stair",
+         "A caravan crossed the silver dunes at dawn",
+         "The night train rattled between sleeping cities",
+         "An orchard bloomed under two pale moons"],
+        "gpt2_greedy_batch4_tokens_per_sec", weights_dir)
 
 
 def _bench_sdxl_with(config_factory, metric: str,

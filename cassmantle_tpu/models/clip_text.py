@@ -37,7 +37,8 @@ class ClipBlock(nn.Module):
     def __call__(self, x, mask):
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
         h = MultiHeadAttention(
-            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+            num_heads=self.cfg.num_heads, dtype=self.dtype,
+            fused_qkv=True, name="attn"
         )(h, mask=mask)
         x = x + h
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)

@@ -52,7 +52,8 @@ class ClipVisionBlock(nn.Module):
     def __call__(self, x):
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
         x = x + MultiHeadAttention(
-            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+            num_heads=self.cfg.num_heads, dtype=self.dtype,
+            fused_qkv=True, name="attn"
         )(h)
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
         x = x + TransformerMLP(

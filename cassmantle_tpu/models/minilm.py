@@ -31,7 +31,8 @@ class BertBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask):
         a = MultiHeadAttention(
-            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+            num_heads=self.cfg.num_heads, dtype=self.dtype,
+            fused_qkv=True, name="attn"
         )(x, mask=mask)
         x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, name="ln1")(x + a)
         # published BERT uses the EXACT (erf) gelu, not the tanh approx

@@ -187,9 +187,9 @@ def convert_clip_text(tensors: Tensors, num_layers: int) -> dict:
         src = f"{p}encoder.layers.{i}"
         dst = f"block_{i}"
         c.norm(f"{src}.layer_norm1", f"{dst}/ln1")
-        c.dense(f"{src}.self_attn.q_proj", f"{dst}/attn/q")
-        c.dense(f"{src}.self_attn.k_proj", f"{dst}/attn/k")
-        c.dense(f"{src}.self_attn.v_proj", f"{dst}/attn/v")
+        c.dense_fused((f"{src}.self_attn.q_proj",
+                       f"{src}.self_attn.k_proj",
+                       f"{src}.self_attn.v_proj"), f"{dst}/attn/qkv")
         c.dense(f"{src}.self_attn.out_proj", f"{dst}/attn/out")
         c.norm(f"{src}.layer_norm2", f"{dst}/ln2")
         c.dense(f"{src}.mlp.fc1", f"{dst}/mlp/fc1")
@@ -224,9 +224,9 @@ def convert_clip_vision(tensors: Tensors, num_layers: int) -> dict:
         src = f"{p}encoder.layers.{i}"
         dst = f"block_{i}"
         c.norm(f"{src}.layer_norm1", f"{dst}/ln1")
-        c.dense(f"{src}.self_attn.q_proj", f"{dst}/attn/q")
-        c.dense(f"{src}.self_attn.k_proj", f"{dst}/attn/k")
-        c.dense(f"{src}.self_attn.v_proj", f"{dst}/attn/v")
+        c.dense_fused((f"{src}.self_attn.q_proj",
+                       f"{src}.self_attn.k_proj",
+                       f"{src}.self_attn.v_proj"), f"{dst}/attn/qkv")
         c.dense(f"{src}.self_attn.out_proj", f"{dst}/attn/out")
         c.norm(f"{src}.layer_norm2", f"{dst}/ln2")
         c.dense(f"{src}.mlp.fc1", f"{dst}/mlp/fc1")
@@ -333,9 +333,9 @@ def convert_minilm(tensors: Tensors, num_layers: int) -> dict:
     for i in range(num_layers):
         src = f"encoder.layer.{i}"
         dst = f"block_{i}"
-        c.dense(f"{src}.attention.self.query", f"{dst}/attn/q")
-        c.dense(f"{src}.attention.self.key", f"{dst}/attn/k")
-        c.dense(f"{src}.attention.self.value", f"{dst}/attn/v")
+        c.dense_fused((f"{src}.attention.self.query",
+                       f"{src}.attention.self.key",
+                       f"{src}.attention.self.value"), f"{dst}/attn/qkv")
         c.dense(f"{src}.attention.output.dense", f"{dst}/attn/out")
         c.norm(f"{src}.attention.output.LayerNorm", f"{dst}/ln1")
         c.dense(f"{src}.intermediate.dense", f"{dst}/mlp/fc1")

@@ -41,7 +41,7 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float,
     way — but ~2x the attention FLOPs/energy of the load-balanced
     zigzag layout, where each device holds two symmetric sequence
     slices). ``ring_attention(causal=True)`` therefore dispatches to
-    the zigzag schedule whenever S divides 2n; this contiguous
+    the zigzag schedule whenever 2n divides S; this contiguous
     formulation remains for schedule="contiguous" (the fallback for
     S % 2n != 0 and the oracle the zigzag tests compare against)."""
     n = jax.lax.psum(1, axis_name)
@@ -281,7 +281,7 @@ def ring_attention(
     ``causal=True`` applies the LM triangular mask on global positions.
 
     ``schedule`` (causal only): ``"auto"`` — the default — routes to the
-    load-balanced zigzag ring whenever ``S % 2n == 0``, which computes
+    load-balanced zigzag ring whenever ``S % (2n) == 0``, which computes
     two fully-live blocks per device per step instead of half-masked
     ones (~2x fewer attention FLOPs on the critical path);
     ``"contiguous"`` forces the plain contiguous-shard schedule (the

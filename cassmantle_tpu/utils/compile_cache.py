@@ -41,7 +41,7 @@ def enable_compile_cache() -> None:
 # (the digest below only sees the config repr) — a stale cached init
 # tree would otherwise load with missing/extra leaves and fail at apply.
 # v2: UNet attention out-projections gained their published bias.
-_PARAM_SCHEMA_VERSION = 3  # v3: fused qkv/kv in the UNet
+_PARAM_SCHEMA_VERSION = 4  # v4: fused qkv in UNet + CLIP/MiniLM
 
 
 def param_cache_path(name: str, cfg) -> str:

@@ -68,6 +68,17 @@ def _torch_conv(flax_kernel):
     )
 
 
+def _fabricate_fused_qkv(out, attn, src_fmt, names):
+    """Split a fused qkv Dense (layers.MultiHeadAttention fused_qkv)
+    back into the published per-projection tensors; src_fmt's '{}' is
+    filled with each published projection name."""
+    ks = np.split(np.asarray(attn["qkv"]["kernel"]), len(names), axis=1)
+    bs = np.split(np.asarray(attn["qkv"]["bias"]), len(names), axis=0)
+    for n, kk, bb in zip(names, ks, bs):
+        out[src_fmt.format(n) + ".weight"] = _torch_dense(kk)
+        out[src_fmt.format(n) + ".bias"] = bb
+
+
 def fabricate_clip(params, num_layers):
     p = params["params"]
     out = {
@@ -87,12 +98,13 @@ def fabricate_clip(params, num_layers):
         out[f"{src}.layer_norm1.bias"] = np.asarray(b["ln1"]["bias"])
         out[f"{src}.layer_norm2.weight"] = np.asarray(b["ln2"]["scale"])
         out[f"{src}.layer_norm2.bias"] = np.asarray(b["ln2"]["bias"])
-        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
-                             ("v", "v_proj"), ("out", "out_proj")):
-            out[f"{src}.self_attn.{theirs}.weight"] = _torch_dense(
-                b["attn"][ours]["kernel"])
-            out[f"{src}.self_attn.{theirs}.bias"] = np.asarray(
-                b["attn"][ours]["bias"])
+        _fabricate_fused_qkv(out, b["attn"],
+                             src + ".self_attn.{}",
+                             ("q_proj", "k_proj", "v_proj"))
+        out[f"{src}.self_attn.out_proj.weight"] = _torch_dense(
+            b["attn"]["out"]["kernel"])
+        out[f"{src}.self_attn.out_proj.bias"] = np.asarray(
+            b["attn"]["out"]["bias"])
         for fc in ("fc1", "fc2"):
             out[f"{src}.mlp.{fc}.weight"] = _torch_dense(
                 b["mlp"][fc]["kernel"])
@@ -179,11 +191,9 @@ def fabricate_minilm(params, num_layers):
     for i in range(num_layers):
         b = p[f"block_{i}"]
         src = f"encoder.layer.{i}"
-        for ours, theirs in (("q", "query"), ("k", "key"), ("v", "value")):
-            out[f"{src}.attention.self.{theirs}.weight"] = _torch_dense(
-                b["attn"][ours]["kernel"])
-            out[f"{src}.attention.self.{theirs}.bias"] = np.asarray(
-                b["attn"][ours]["bias"])
+        _fabricate_fused_qkv(out, b["attn"],
+                             src + ".attention.self.{}",
+                             ("query", "key", "value"))
         out[f"{src}.attention.output.dense.weight"] = _torch_dense(
             b["attn"]["out"]["kernel"])
         out[f"{src}.attention.output.dense.bias"] = np.asarray(
@@ -456,12 +466,13 @@ def fabricate_clip_vision(params, num_layers):
         out[f"{src}.layer_norm1.bias"] = np.asarray(b["ln1"]["bias"])
         out[f"{src}.layer_norm2.weight"] = np.asarray(b["ln2"]["scale"])
         out[f"{src}.layer_norm2.bias"] = np.asarray(b["ln2"]["bias"])
-        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
-                             ("v", "v_proj"), ("out", "out_proj")):
-            out[f"{src}.self_attn.{theirs}.weight"] = _torch_dense(
-                b["attn"][ours]["kernel"])
-            out[f"{src}.self_attn.{theirs}.bias"] = np.asarray(
-                b["attn"][ours]["bias"])
+        _fabricate_fused_qkv(out, b["attn"],
+                             src + ".self_attn.{}",
+                             ("q_proj", "k_proj", "v_proj"))
+        out[f"{src}.self_attn.out_proj.weight"] = _torch_dense(
+            b["attn"]["out"]["kernel"])
+        out[f"{src}.self_attn.out_proj.bias"] = np.asarray(
+            b["attn"]["out"]["bias"])
         for fc in ("fc1", "fc2"):
             out[f"{src}.mlp.{fc}.weight"] = _torch_dense(
                 b["mlp"][fc]["kernel"])
