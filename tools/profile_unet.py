@@ -55,15 +55,21 @@ def cost_table(fn, *args, top: int = 10):
     jaxpr = jax.make_jaxpr(fn)(*args)
     groups = collections.defaultdict(lambda: [0, 0.0])  # count, flops
 
-    def visit(jx):
+    def visit(jx, mult: float = 1.0):
         for eqn in jx.eqns:
+            # a scan body executes `length` times: its ops cost
+            # length x (the full 50-step denoise loop would otherwise
+            # count as one step)
+            inner = mult
+            if eqn.primitive.name == "scan":
+                inner = mult * float(eqn.params.get("length", 1))
             for sub in eqn.params.values():
                 if hasattr(sub, "jaxpr"):
-                    visit(sub.jaxpr)
+                    visit(sub.jaxpr, inner)
                 elif isinstance(sub, (list, tuple)):
                     for s in sub:
                         if hasattr(s, "jaxpr"):
-                            visit(s.jaxpr)
+                            visit(s.jaxpr, inner)
             name = eqn.primitive.name
             shapes = tuple(tuple(getattr(v.aval, "shape", ()))
                            for v in eqn.invars)
@@ -88,8 +94,8 @@ def cost_table(fn, *args, top: int = 10):
             else:
                 continue
             key = (name, shapes)
-            groups[key][0] += 1
-            groups[key][1] += flops
+            groups[key][0] += mult
+            groups[key][1] += flops * mult
 
     visit(jaxpr.jaxpr)
     rows = sorted(groups.items(), key=lambda kv: -kv[1][1])
@@ -99,7 +105,7 @@ def cost_table(fn, *args, top: int = 10):
         out_rows.append({
             "op": name,
             "shapes": "x".join(str(list(s)) for s in shapes[:2]),
-            "count": count,
+            "count": int(count),
             "gflops": round(flops / 1e9, 2),
             "pct": round(100 * flops / total, 1) if total else 0.0,
         })
@@ -117,6 +123,11 @@ def main():
     ap.add_argument("--cost-table", action="store_true",
                     help="print the top-op analytic FLOP table "
                          "(shape-derived; valid on any backend) and exit")
+    ap.add_argument("--full-pipeline", action="store_true",
+                    help="with --cost-table: trace the WHOLE north-star "
+                         "graph (CLIP encode + N-step CFG denoise scan, "
+                         "scan body costs multiplied by its trip count, "
+                         "+ VAE decode) instead of one UNet forward")
     ap.add_argument("--platform", default="auto", choices=("auto", "cpu"))
     opts = ap.parse_args()  # rejects unknown/typo'd flags
     if opts.platform == "cpu":
@@ -145,11 +156,29 @@ def main():
     step = jax.jit(lambda p, l, t, c: model.apply(p, l, t, c))
 
     if opts.cost_table:
-        rows, total = cost_table(
-            lambda p, l, t, c: model.apply(p, l, t, c),
-            params, lat, ts, ctx)
-        print(f"UNet forward, batch={batch}: "
-              f"{total / 1e12:.3f} analytic TFLOPs (dot/conv)")
+        if opts.full_pipeline:
+            from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+            pipe = Text2ImagePipeline(cfg)
+            ids = jnp.zeros((batch, pipe.pad_len), jnp.int32)
+            rows, total = cost_table(
+                pipe._sample_impl, pipe._params, ids, ids,
+                jax.random.PRNGKey(0))
+            label = (f"full pipeline (CLIP + "
+                     f"{cfg.sampler.num_steps}-step CFG scan + VAE), "
+                     f"batch={batch}")
+            per_img = total / batch
+            extra = (f"  = {per_img / 1e12:.2f} TF/image "
+                     f"(UNet-only ceiling math assumed "
+                     f"{0.78 * 2 * cfg.sampler.num_steps:.1f})")
+        else:
+            rows, total = cost_table(
+                lambda p, l, t, c: model.apply(p, l, t, c),
+                params, lat, ts, ctx)
+            label = f"UNet forward, batch={batch}"
+            extra = ""
+        print(f"{label}: {total / 1e12:.3f} analytic TFLOPs "
+              f"(dot/conv){extra}")
         print(f"{'op':22s} {'operand shapes':46s} "
               f"{'count':>5s} {'GFLOP':>9s} {'%':>5s}")
         for r in rows:
