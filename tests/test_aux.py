@@ -133,3 +133,37 @@ def test_checkpoint_restores_across_mesh_shapes(tmp_path):
     assert rw.sharding.is_equivalent_to(batch_sharding(mesh_b), rw.ndim)
     np.testing.assert_allclose(np.asarray(rw), np.asarray(w))
     ckpt.close()
+
+
+def test_cost_table_scan_aware():
+    """tools/profile_unet.cost_table must multiply scan-body op costs
+    by the trip count (a 50-step denoise scan is 50x its body, not 1x)
+    and keep non-scan costs unscaled."""
+    import importlib.util
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    spec = importlib.util.spec_from_file_location(
+        "profile_unet_mod",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "profile_unet.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    w = jnp.ones((8, 8), jnp.float32)
+
+    def once(x):
+        return x @ w
+
+    def scanned(x):
+        def body(carry, _):
+            return carry @ w, None
+        out, _ = jax.lax.scan(body, x, None, length=7)
+        return out
+
+    rows1, total1 = mod.cost_table(once, jnp.ones((4, 8)))
+    rows7, total7 = mod.cost_table(scanned, jnp.ones((4, 8)))
+    assert total7 == 7 * total1, (total1, total7)
+    assert rows7[0]["count"] == 7 and rows1[0]["count"] == 1
