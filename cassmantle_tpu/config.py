@@ -60,6 +60,26 @@ class UNetConfig:
     # SDXL micro-conditioning (added time-embedding channels); 0 disables.
     addition_embed_dim: int = 0
     dtype: str = "bfloat16"
+    # Fused GroupNorm+SiLU+conv3x3 Pallas path for the ResBlock hot loop
+    # (ops/fused_conv.py): the normalized/activated tensor stays in VMEM
+    # instead of round-tripping HBM before every 3x3 conv (~45% of UNet
+    # FLOPs are these convs — docs/PERF_NOTES.md). Param tree, checkpoint
+    # layout, and outputs are unchanged (parity-pinned,
+    # tests/test_fused_conv.py); A/B measured by the `sd15_fusedconv`
+    # bench entry. CASSMANTLE_NO_FUSED_CONV=1 is the runtime kill switch.
+    fused_conv: bool = False
+    # With fused_conv: round conv channel dims up to this multiple so
+    # MXU tiles fill (SD1.5's 320/960 levels are 2.5/7.5 lanes-tiles
+    # wide; 128 trades ~3.4% UNet FLOPs for full tile occupancy —
+    # docs/PERF_NOTES.md). 0 disables padding.
+    conv_pad_to: int = 0
+
+    def arch(self) -> "UNetConfig":
+        """This config with execution-strategy flags cleared — the
+        ARCHITECTURE identity (param tree + numerics), used for param
+        cache keys and ``share_params_with`` compatibility: fused_conv /
+        conv_pad_to change how convs execute, never what the tree is."""
+        return dataclasses.replace(self, fused_conv=False, conv_pad_to=0)
 
     @staticmethod
     def sdxl() -> "UNetConfig":
@@ -348,6 +368,22 @@ def turbo_serving_config() -> FrameworkConfig:
     return FrameworkConfig(
         sampler=SamplerConfig(kind="dpmpp_2m", num_steps=24, deepcache=True)
     )
+
+
+def fusedconv_serving_config() -> FrameworkConfig:
+    """The fixed DDIM-50 north-star config with the conv-side Pallas
+    path on: fused GroupNorm+SiLU+conv3x3 in every UNet ResBlock plus
+    128-lane channel padding at the non-aligned 320/960 levels
+    (UNetConfig.fused_conv / conv_pad_to; ops/fused_conv.py). Same
+    trajectory and param tree as the plain config — this is the ON arm
+    of the `sd15_fusedconv` bench A/B, and it composes with the
+    workload-level presets (deepcache/dpmpp/int8) because it changes
+    how ResBlock convs execute, not what they compute."""
+
+    base = FrameworkConfig()
+    return base.replace(models=dataclasses.replace(
+        base.models, unet=dataclasses.replace(
+            base.models.unet, fused_conv=True, conv_pad_to=128)))
 
 
 def deepcache_serving_config() -> FrameworkConfig:

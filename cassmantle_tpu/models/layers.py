@@ -235,7 +235,13 @@ class _GroupNormCore(nn.Module):
     epsilon: float
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, return_affine: bool = False):
+        """Normalize ``x`` — or, with ``return_affine``, return the
+        per-(batch, channel) fp32 affine ``(a, b)`` with
+        ``out = x * a + b`` instead of applying it. The affine form
+        feeds the fused GroupNorm+SiLU+conv3x3 Pallas path
+        (ops/fused_conv.py): the sensitive fp32 statistics stay here,
+        the cheap FMA moves into the kernel."""
         c = x.shape[-1]
         g = self.num_groups
         scale = self.param("scale", nn.initializers.ones, (c,))
@@ -264,6 +270,8 @@ class _GroupNormCore(nn.Module):
         mean_c = jnp.repeat(mean, c // g, axis=-1)
         a = inv_c * scale.astype(jnp.float32)[None, :]
         b = bias.astype(jnp.float32)[None, :] - mean_c * a
+        if return_affine:
+            return a, b                                      # (B, C) fp32
         shape = (x.shape[0],) + (1,) * len(spatial) + (c,)
         a = a.reshape(shape).astype(x.dtype)
         b = b.reshape(shape).astype(x.dtype)
@@ -279,7 +287,31 @@ class GroupNorm32(nn.Module):
     epsilon: float = 1e-5
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, return_affine: bool = False):
         return _GroupNormCore(
             num_groups=self.num_groups, epsilon=self.epsilon, name="norm"
-        )(x)
+        )(x, return_affine=return_affine)
+
+
+class Conv3x3Params(nn.Module):
+    """Parameter twin of ``nn.Conv(features, (3, 3))`` that DECLARES the
+    kernel/bias without running the convolution.
+
+    The fused GroupNorm+SiLU+conv path (ops/fused_conv.py) computes the
+    conv inside a Pallas kernel, but the param tree must stay identical
+    to the unfused ``nn.Conv`` layout — same names ("kernel"/"bias"),
+    same HWIO shape, same initializers, same RNG fold path — so
+    checkpoints (models/weights.py Converter.conv), the init cache, and
+    the fused/unfused A/B all share one tree. Returns the raw params;
+    dtype casting happens at the use site like ``nn.Conv(dtype=...)``.
+    """
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, in_features, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return kernel, bias

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from cassmantle_tpu.config import UNetConfig
 from cassmantle_tpu.models.layers import (
     GEGLU,
+    Conv3x3Params,
     GroupNorm32,
     LayerNorm32,
     MultiHeadAttention,
@@ -40,22 +41,53 @@ from cassmantle_tpu.models.layers import (
 
 
 class ResBlock(nn.Module):
+    """GN/SiLU/conv3x3 x2 + time injection + skip.
+
+    ``fused_conv`` routes both norm+act+conv sequences through the
+    Pallas fused kernel (ops/fused_conv.py): GroupNorm statistics still
+    reduce in fp32 here (``return_affine``), but the normalize, SiLU,
+    and 3x3 conv run as one kernel so the activated tensor never
+    round-trips HBM. The param tree is IDENTICAL either way
+    (Conv3x3Params declares nn.Conv's exact kernel/bias layout), so
+    checkpoints, the init cache, and the A/B share one tree;
+    ``conv_pad_to`` additionally pads channel dims to MXU-friendly
+    multiples inside the fused op (zero-fill, output sliced back).
+    """
+
     out_channels: int
     dtype: jnp.dtype
+    fused_conv: bool = False
+    conv_pad_to: int = 0
+
+    def _gn_silu_conv(self, x, norm_name: str, conv_name: str):
+        from cassmantle_tpu.ops.fused_conv import gn_silu_conv3x3
+
+        a, b = GroupNorm32(name=norm_name)(x, return_affine=True)
+        kernel, bias = Conv3x3Params(
+            self.out_channels, name=conv_name)(x.shape[-1])
+        return gn_silu_conv3x3(
+            x, a, b, kernel.astype(self.dtype), bias.astype(self.dtype),
+            pad_to=self.conv_pad_to)
 
     @nn.compact
     def __call__(self, x, temb):
-        h = GroupNorm32(name="norm1")(x)
-        h = nn.silu(h)
-        h = nn.Conv(self.out_channels, (3, 3), padding=1,
-                    dtype=self.dtype, name="conv1")(h)
+        if self.fused_conv:
+            h = self._gn_silu_conv(x, "norm1", "conv1")
+        else:
+            h = GroupNorm32(name="norm1")(x)
+            h = nn.silu(h)
+            h = nn.Conv(self.out_channels, (3, 3), padding=1,
+                        dtype=self.dtype, name="conv1")(h)
         t = nn.Dense(self.out_channels, dtype=self.dtype,
                      name="time_proj")(nn.silu(temb))
         h = h + t[:, None, None, :]
-        h = GroupNorm32(name="norm2")(h)
-        h = nn.silu(h)
-        h = nn.Conv(self.out_channels, (3, 3), padding=1,
-                    dtype=self.dtype, name="conv2")(h)
+        if self.fused_conv:
+            h = self._gn_silu_conv(h, "norm2", "conv2")
+        else:
+            h = GroupNorm32(name="norm2")(h)
+            h = nn.silu(h)
+            h = nn.Conv(self.out_channels, (3, 3), padding=1,
+                        dtype=self.dtype, name="conv2")(h)
         if x.shape[-1] != self.out_channels:
             x = nn.Conv(self.out_channels, (1, 1),
                         dtype=self.dtype, name="skip")(x)
@@ -171,13 +203,17 @@ class UNet(nn.Module):
         x = nn.Conv(cfg.base_channels, (3, 3), padding=1,
                     dtype=dtype, name="conv_in")(latents)
 
+        def res_block(ch: int, name: str) -> ResBlock:
+            return ResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                            conv_pad_to=cfg.conv_pad_to, name=name)
+
         # -- down ----------------------------------------------------------
         skips = [x]
         down_levels = 1 if shallow_only else levels
         for lvl in range(down_levels):
             ch = cfg.base_channels * cfg.channel_mults[lvl]
             for blk in range(cfg.blocks_per_level):
-                x = ResBlock(ch, dtype, name=f"down_{lvl}_res_{blk}")(x, temb)
+                x = res_block(ch, f"down_{lvl}_res_{blk}")(x, temb)
                 if cfg.attention_levels[lvl] and cfg.transformer_depth[lvl]:
                     x = SpatialTransformer(
                         num_heads=self._heads(ch),
@@ -198,12 +234,12 @@ class UNet(nn.Module):
                 [d for lvl, d in enumerate(cfg.transformer_depth)
                  if cfg.attention_levels[lvl]] or [1]
             )
-            x = ResBlock(mid_ch, dtype, name="mid_res_0")(x, temb)
+            x = res_block(mid_ch, "mid_res_0")(x, temb)
             x = SpatialTransformer(
                 num_heads=self._heads(mid_ch), depth=mid_depth,
                 context_dim=cfg.context_dim, dtype=dtype, name="mid_attn",
             )(x, context)
-            x = ResBlock(mid_ch, dtype, name="mid_res_1")(x, temb)
+            x = res_block(mid_ch, "mid_res_1")(x, temb)
 
         # -- up ------------------------------------------------------------
         deep_out = None
@@ -217,7 +253,7 @@ class UNet(nn.Module):
             for blk in range(cfg.blocks_per_level + 1):
                 skip = skips.pop()
                 x = jnp.concatenate([x, skip], axis=-1)
-                x = ResBlock(ch, dtype, name=f"up_{lvl}_res_{blk}")(x, temb)
+                x = res_block(ch, f"up_{lvl}_res_{blk}")(x, temb)
                 if cfg.attention_levels[lvl] and cfg.transformer_depth[lvl]:
                     x = SpatialTransformer(
                         num_heads=self._heads(ch),

@@ -34,9 +34,39 @@ NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 BINARY = os.path.join(NATIVE_DIR, "build", "mantlestore")
 
 
+def _binary_runs() -> bool:
+    """True when the existing binary actually executes on THIS host. A
+    binary built on a newer base image can be present but dead on
+    arrival (GLIBC/GLIBCXX version mismatch): the dynamic loader refuses
+    it at exec and it dies instantly with the complaint on stderr. A
+    healthy mantlestore, by contrast, prints its "listening" line and
+    serves until killed — so probe by spawning on port 0 (kernel picks
+    an ephemeral port; never collides with a live server) and watching
+    stderr briefly for either outcome."""
+    import select
+
+    try:
+        proc = subprocess.Popen([BINARY, "0"], stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE)
+    except Exception:
+        return False
+    try:
+        ready, _, _ = select.select([proc.stderr], [], [], 10.0)
+        if not ready:  # neither died nor spoke: treat as unusable
+            return False
+        return b"listening" in proc.stderr.readline()
+    except Exception:
+        return False
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 def ensure_built() -> Optional[str]:
-    """Build the server if needed; returns binary path or None."""
-    if os.path.exists(BINARY):
+    """Build the server if needed; returns binary path or None. A
+    present-but-unrunnable binary (toolchain mismatch with the build
+    host) rebuilds from source like a missing one."""
+    if os.path.exists(BINARY) and _binary_runs():
         return BINARY
     try:
         subprocess.run(

@@ -30,6 +30,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; take
+# whichever the installed version exports.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 # 1024-blocks measured ~2x faster than 512 at the UNet's level-0 site
 # (S=4096, d=40, bh=64) on v5e: fewer grid programs amortize the per-
 # program MXU setup over more work. (1024, 40)-bf16 q/k/v tiles plus two
@@ -137,7 +142,7 @@ def _flash_bhsd(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
                                block_k=block_k, kv_len=kv_len)
     # Only the k-block axis carries state (online-softmax scratch); the
     # batch*heads and q-block axes are embarrassingly parallel.
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
     flops = 2 * 2 * bh * sq * sk * d  # QK^T + PV

@@ -17,6 +17,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from cassmantle_tpu.parallel.mesh import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -69,7 +71,9 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float,
     b, s_l, h, d = q.shape
     # initial carries are constants -> mark them device-varying over the
     # ring axis so the scan carry type stays consistent
-    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")  # noqa: E731
+    from cassmantle_tpu.parallel.mesh import pcast_varying
+
+    vary = lambda x: pcast_varying(x, axis_name)  # noqa: E731
     m0 = vary(jnp.full((b, h, s_l, 1), _NEG_INF, dtype=jnp.float32))
     l0 = vary(jnp.zeros((b, h, s_l, 1), dtype=jnp.float32))
     acc0 = vary(jnp.zeros((b, h, s_l, d), dtype=jnp.float32))
@@ -235,7 +239,7 @@ def zigzag_sharded_attention(
         _zigzag_local, axis_name=axis_name, scale=float(scale), n=n
     )
     spec = P(batch_axis, axis_name, None, None)
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)
 
@@ -303,6 +307,6 @@ def ring_attention(
         causal=causal,
     )
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)

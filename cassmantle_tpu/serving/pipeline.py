@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import threading
 from functools import partial
 from typing import List, Optional, Sequence
 
@@ -98,9 +99,11 @@ def share_compatible(models_a, models_b) -> bool:
     — the pipeline then derives/loads its own UNet). The single
     definition of the ``share_params_with`` contract: the pipeline's
     assert and callers picking anchors (tools/clip_report.py) both use
-    this."""
+    this. UNet configs compare by ``arch()``: the fused-conv execution
+    flags (fused_conv/conv_pad_to) change how convs run, never the
+    param tree, so a fused A/B arm shares the donor's weights."""
     return (models_a.clip_text == models_b.clip_text
-            and models_a.unet == models_b.unet
+            and models_a.unet.arch() == models_b.unet.arch()
             and models_a.vae == models_b.vae
             and models_a.param_dtype == models_b.param_dtype)
 
@@ -247,13 +250,15 @@ class Text2ImagePipeline:
                 cast_to=m.param_dtype, transform=transform)
             if loaded is not None:
                 return loaded, True
+            # cache key on arch(): the fused-conv flags don't change the
+            # tree, so both A/B arms reuse one cached init
             return init_params_cached(
                 self.unet, 2,
                 jnp.zeros((1, lat_hw, lat_hw, 4), jnp.float32),
                 jnp.zeros((1,), jnp.int32),
                 jnp.zeros((1, self.pad_len, m.unet.context_dim),
                           jnp.float32),
-                cache_path=param_cache_path("unet", m.unet),
+                cache_path=param_cache_path("unet", m.unet.arch()),
                 cast_to=m.param_dtype, transform=transform), False
 
         if share_params_with is not None:
@@ -314,6 +319,10 @@ class Text2ImagePipeline:
                 and loaded_vae is not None
             )
         self.unet_apply = wrap_unet_apply(self.unet.apply)
+        from cassmantle_tpu.ops.fused_conv import describe as fc_describe
+
+        if fc_describe(m.unet):
+            log.info("%s", fc_describe(m.unet))
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
         self.sample_latents = make_sampler(
@@ -326,6 +335,13 @@ class Text2ImagePipeline:
         self._params = {"clip": self.clip_params, "unet": self.unet_params,
                         "vae": self.vae_params}
         self._sample, self.dp = dp_sharded_sampler(self._sample_impl, mesh)
+        # One in-flight device batch per pipeline: concurrent round
+        # buffering calls generate() from multiple executor threads, and
+        # the device executes serially regardless — serializing dispatch
+        # here costs nothing and removes a whole deadlock class
+        # (concurrent executions of one compiled computation have
+        # deadlocked the CPU backend under some jaxlib builds).
+        self._dispatch_lock = threading.Lock()
 
     def _sample_impl(self, params, ids, uncond_ids, rng):
         with annotate("clip_encode"):
@@ -356,7 +372,7 @@ class Text2ImagePipeline:
         uncond = jnp.asarray(self._tokenize(
             [self.cfg.sampler.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
-        with metrics.timer("pipeline.t2i_s"):
+        with metrics.timer("pipeline.t2i_s"), self._dispatch_lock:
             images = self._sample(self._params, ids, uncond, rng)
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.images", n)
@@ -441,7 +457,7 @@ class Text2ImagePipeline:
         uncond = jnp.asarray(self._tokenize(
             [self.cfg.sampler.negative_prompt] * len(prompts)))
         params = dict(self._params, vae_enc=self.enc_params)
-        with metrics.timer("pipeline.i2i_s"):
+        with metrics.timer("pipeline.i2i_s"), self._dispatch_lock:
             out = self._i2i_fns[k](
                 params, ids, uncond, imgf, jax.random.PRNGKey(seed)
             )
@@ -469,6 +485,10 @@ class PromptGenerator:
         enable_compile_cache()
         self.cfg = cfg
         self._decode_calls = 0  # auto-advancing sampling key (decode_ids)
+        # one in-flight decode per generator (see Text2ImagePipeline's
+        # dispatch lock; the prompt queue usually serializes decodes, but
+        # direct generate() callers can race it)
+        self._dispatch_lock = threading.Lock()
         if cfg.models.mistral is not None:
             m = cfg.models.mistral
             self.model = MistralLM(m)
@@ -658,23 +678,26 @@ class PromptGenerator:
                 toks = rows[src]
                 ids[row, : len(toks)] = np.asarray(toks) % m.vocab_size
                 lens[row] = max(1, len(toks))
-            tokens, gen_len = greedy_decode(
-                (self._prefill, self._step),
-                self.params,
-                jnp.asarray(ids),
-                jnp.asarray(lens),
-                jax.random.PRNGKey(seed),
-                max_new,
-                # an out-of-vocab eos (byte-fallback tokenizer vs a smaller
-                # model vocab) can never be emitted: pass vocab_size as an
-                # unreachable sentinel so early-stop is cleanly disabled —
-                # a modulo here would ALIAS a real token as a phantom
-                # terminator and silently truncate generations
-                (self.tokenizer.eos_id
-                 if self.tokenizer.eos_id < m.vocab_size else m.vocab_size),
-                self.cfg.sampler.text_temperature,
-                self.cfg.sampler.text_top_k,
-            )
+            with self._dispatch_lock:
+                tokens, gen_len = greedy_decode(
+                    (self._prefill, self._step),
+                    self.params,
+                    jnp.asarray(ids),
+                    jnp.asarray(lens),
+                    jax.random.PRNGKey(seed),
+                    max_new,
+                    # an out-of-vocab eos (byte-fallback tokenizer vs a
+                    # smaller model vocab) can never be emitted: pass
+                    # vocab_size as an unreachable sentinel so early-stop
+                    # is cleanly disabled — a modulo here would ALIAS a
+                    # real token as a phantom terminator and silently
+                    # truncate generations
+                    (self.tokenizer.eos_id
+                     if self.tokenizer.eos_id < m.vocab_size
+                     else m.vocab_size),
+                    self.cfg.sampler.text_temperature,
+                    self.cfg.sampler.text_top_k,
+                )
             out_tokens[idxs] = np.asarray(tokens[:n])
             out_len[idxs] = np.asarray(gen_len[:n])
         return jnp.asarray(out_tokens), jnp.asarray(out_len)

@@ -144,13 +144,16 @@ class SDXLPipeline:
         from cassmantle_tpu.serving.pipeline import int8_unet_tools
 
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
+        # cache key on arch(): the fused-conv execution flags
+        # (UNetConfig.fused_conv / conv_pad_to) don't change the tree,
+        # so A/B arms share one cached init (see serving/pipeline.py)
         self.unet_params = (
             maybe_load(weights_dir, "unet_xl.safetensors",
                        lambda t: convert_unet(t, m.unet), "unet_xl",
                        cast_to=m.param_dtype, transform=unet_transform)
             or init_params_cached(
                 self.unet, 2, lat, t0, ctx, add,
-                cache_path=param_cache_path("unet_xl", m.unet),
+                cache_path=param_cache_path("unet_xl", m.unet.arch()),
                 cast_to=m.param_dtype, transform=unet_transform)
         )
         self.vae_params = (
@@ -166,6 +169,10 @@ class SDXLPipeline:
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
         self.unet_apply = wrap_unet_apply(self.unet.apply)
+        from cassmantle_tpu.ops.fused_conv import describe as fc_describe
+
+        if fc_describe(m.unet):
+            log.info("%s", fc_describe(m.unet))
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -180,6 +187,12 @@ class SDXLPipeline:
         from cassmantle_tpu.serving.pipeline import dp_sharded_sampler
 
         self._sample, self.dp = dp_sharded_sampler(self._sample_impl, mesh)
+        # one in-flight device batch per pipeline (see Text2ImagePipeline:
+        # concurrent executions of one compiled computation have
+        # deadlocked the CPU backend under some jaxlib builds)
+        import threading
+
+        self._dispatch_lock = threading.Lock()
 
     # -- conditioning ------------------------------------------------------
 
@@ -252,7 +265,7 @@ class SDXLPipeline:
         uncond = jnp.asarray(self._tokenize(
             [self.cfg.sampler.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
-        with metrics.timer("pipeline.sdxl_s"):
+        with metrics.timer("pipeline.sdxl_s"), self._dispatch_lock:
             images = self._sample(self._params, ids, uncond, rng)
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.sdxl_images", n)

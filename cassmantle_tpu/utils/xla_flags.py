@@ -12,10 +12,54 @@ import os
 # On few-core hosts the virtual CPU devices' programs serialize, and XLA's
 # default 40 s collective termination timeout kills the process while
 # straggler devices are still computing. Harmless on real-TPU paths.
+# OPTIONAL: these tuning flags are newer than some deployed jaxlib builds,
+# and XLA aborts the process on any unknown flag name — so they only land
+# after the probe below finds them registered in the installed binary.
 COLLECTIVE_TIMEOUT_FLAGS = (
     "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
     "--xla_cpu_collective_call_terminate_timeout_seconds=3600",
 )
+
+# Probe cache shared with child processes (test subprocesses, bench entry
+# children): the scan of the jaxlib binary runs once per process tree.
+_PROBE_ENV = "CASSMANTLE_XLA_FLAG_SUPPORT"
+
+
+def _supported_optional_flags(flags) -> list:
+    """Filter ``flags`` to the ones the installed jaxlib registers.
+
+    XLA treats an unknown flag in XLA_FLAGS as FATAL (the whole process
+    aborts at first backend init), so version-dependent tuning flags must
+    be verified before they enter the env. There is no Python API listing
+    registered flags; the reliable signal is the flag-name string compiled
+    into the jaxlib extension binary. On any probe failure the optional
+    flags are DROPPED — a missing timeout flag costs at worst a slow-host
+    collective timeout, while an unknown flag costs the entire process.
+    """
+    names = [f.split("=")[0].lstrip("-") for f in flags]
+    cached = os.environ.get(_PROBE_ENV)
+    if cached is None:
+        supported = set()
+        try:
+            import glob
+
+            import jaxlib
+
+            libdir = os.path.dirname(jaxlib.__file__)
+            paths = (glob.glob(os.path.join(libdir, "xla_extension*"))
+                     or glob.glob(os.path.join(libdir, "**", "xla_extension*"),
+                                  recursive=True))
+            if paths:
+                with open(paths[0], "rb") as fh:
+                    blob = fh.read()
+                supported = {n for n in names if n.encode() in blob}
+        except Exception:
+            supported = set()
+        os.environ[_PROBE_ENV] = ",".join(sorted(supported))
+        cached = os.environ[_PROBE_ENV]
+    ok = set(cached.split(","))
+    return [f for f, n in zip(flags, names) if n in ok]
+
 
 def virtual_device_flag(count: int) -> str:
     return f"--xla_force_host_platform_device_count={count}"
@@ -48,12 +92,11 @@ def pin_cpu_platform(
       discovery still initializes every allowed platform, which blocks
       forever when the tunnel behind a plugin is down.
     """
+    timeout_flags = _supported_optional_flags(COLLECTIVE_TIMEOUT_FLAGS)
     if virtual_devices:
-        append_xla_flags(
-            virtual_device_flag(device_count), *COLLECTIVE_TIMEOUT_FLAGS
-        )
+        append_xla_flags(virtual_device_flag(device_count), *timeout_flags)
     else:
-        append_xla_flags(*COLLECTIVE_TIMEOUT_FLAGS)
+        append_xla_flags(*timeout_flags)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 

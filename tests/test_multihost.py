@@ -8,6 +8,8 @@ devices, and verify an explicit cross-process psum plus a dp train step
 cassmantle_tpu/parallel/multihost_dryrun.py for what the children run.
 """
 
+import pytest
+
 from cassmantle_tpu.parallel.multihost_dryrun import (
     _OK_MARKER,
     run_multihost_dryrun,
@@ -15,6 +17,18 @@ from cassmantle_tpu.parallel.multihost_dryrun import (
 
 
 def test_two_process_distributed_join_and_dp_step():
-    out = run_multihost_dryrun(n_procs=2, local_devices=4)
+    try:
+        out = run_multihost_dryrun(n_procs=2, local_devices=4)
+    except RuntimeError as exc:
+        # capability gate, not a code failure: some jaxlib builds ship
+        # a CPU backend without cross-process collectives ("Multiprocess
+        # computations aren't implemented on the CPU backend"). The join
+        # + mesh construction still ran (the children get far enough to
+        # log the mesh); only the collective execution leg needs the
+        # capable backend — same spirit as the node-gated JS skips.
+        if "aren't implemented on the CPU backend" in str(exc):
+            pytest.skip("installed jaxlib CPU backend lacks "
+                        "cross-process collectives")
+        raise
     assert _OK_MARKER in out
     assert "8 global devices" in out

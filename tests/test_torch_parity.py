@@ -282,3 +282,80 @@ def test_mistral_matches_transformers():
     cfg = dataclasses.replace(MistralConfig.tiny(), sliding_window=64)
     params = to_jax(convert_mistral(sd_np(hf), 2))
     assert_close(MistralLM(cfg).apply(params, jnp.asarray(ids)), logits)
+
+
+def test_clip_similarity_harness_matches_transformers():
+    """The FULL eval/clip_parity.py metric path — text pooling, text
+    projection, image preprocessing, vision tower + visual projection,
+    both normalizations, dot product — against torch CLIPModel with the
+    same random weights (VERDICT r5 'Next round' #3: prove the
+    CLIP-gate metric implementation now, calibrate with real weights
+    later). Images are fed at the vision tower's native size so both
+    sides see the same pixels."""
+    from transformers import CLIPConfig as HFConfig, CLIPModel
+
+    from cassmantle_tpu.eval.clip_parity import ClipSimilarityHarness
+    from cassmantle_tpu.models.clip_vision import (
+        CLIP_IMAGE_MEAN,
+        CLIP_IMAGE_STD,
+        ClipVisionConfig,
+    )
+    from cassmantle_tpu.models.weights import (
+        convert_clip_text_projection,
+    )
+
+    torch.manual_seed(0)
+    hf = CLIPModel(HFConfig(
+        projection_dim=24,
+        text_config=dict(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=16, eos_token_id=98,
+            projection_dim=24),
+        vision_config=dict(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, image_size=32, patch_size=8,
+            projection_dim=24))).eval()
+    sd = sd_np(hf)
+
+    harness = ClipSimilarityHarness(
+        text_cfg=ClipTextConfig(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=4, max_positions=16),
+        vision_cfg=ClipVisionConfig(
+            image_size=32, patch_size=8, hidden_size=32,
+            intermediate_size=64, num_layers=2, num_heads=4,
+            projection_dim=24),
+        pad_len=16)
+    # same random weights on both sides: override the harness's
+    # random-init params with the converted torch tree
+    params = {
+        "text": to_jax(convert_clip_text(sd, 2)),
+        "vision": to_jax(convert_clip_vision(sd, 2)),
+        "proj": jnp.asarray(convert_clip_text_projection(sd)),
+    }
+
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 98, (3, 9)).astype(np.int32)
+    ids[:, -1] = 98  # EOT position for both poolings
+    images = rng.integers(0, 256, (3, 32, 32, 3)).astype(np.uint8)
+
+    ours = np.asarray(harness._jit_sim(
+        params, jnp.asarray(ids), jnp.asarray(images)))
+
+    # torch side: identical preprocessing (images are already at the
+    # tower's size, so resize is identity), then the public
+    # get_*_features path
+    pix = images.astype(np.float32) / 255.0
+    pix = (pix - np.asarray(CLIP_IMAGE_MEAN)) / np.asarray(CLIP_IMAGE_STD)
+    pix = np.transpose(pix, (0, 3, 1, 2))
+    with torch.no_grad():
+        temb = hf.get_text_features(torch.tensor(ids.astype(np.int64)))
+        vemb = hf.get_image_features(torch.tensor(pix))
+    temb = temb.numpy()
+    temb = temb / (np.linalg.norm(temb, axis=-1, keepdims=True) + 1e-8)
+    vemb = vemb.numpy()
+    vemb = vemb / np.linalg.norm(vemb, axis=-1, keepdims=True)
+    ref = (temb * vemb).sum(-1)
+
+    np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=1e-3)

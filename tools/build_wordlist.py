@@ -78,6 +78,29 @@ thicket bracken gorse sedge tarn scree brook rivulet hillock
 outcrop updraft gloaming murk dapple dappled
 """.split()
 
+# Doc-corpus boilerplate that dominates raw document frequency (the
+# mining roots are /usr/share/doc + site-packages, so license/README
+# vocabulary tops every df count) but is near-useless as a
+# spell-suggestion winner in a STORY game: both spellcheckers rank
+# suggestions by list position, so "use" beating "fuse"/"muse" or
+# "org" beating "fog" on a tie resolves typos toward tech vocabulary
+# (VERDICT r5 weak #4). Membership is untouched — these words stay
+# checkable — but they rank BELOW story vocabulary (demoted to the
+# tail tier at write-out). English function words ("the", "and") are
+# NOT here: they head the list legitimately and never collide with
+# content-word typos of length >= 3.
+DOC_STOPWORDS = frozenset("""
+    org use software documentation copyright license licensed licenses
+    version versions code source notice conditions warranty copies
+    copy permission permissions http https www html url urls api apis
+    config configuration module modules package packages library
+    libraries install installed installation file files directory
+    docs documented implied merchantability noninfringement sublicense
+    redistribute redistribution disclaimer liability damages
+    contributors derivative kind express limited obtained furnished
+    python foundation stichting mathematisch centrum amsterdam
+""".split())
+
 TEXT_EXTS = (".py", ".md", ".rst", ".txt")
 SKIP_DIRS = {"__pycache__", "nvidia", "node_modules", ".git"}
 # per-file read cap: license/notice blobs repeat after this anyway, and
@@ -251,8 +274,11 @@ def main() -> None:
     # Rank by PROSE frequency first (code identifiers must not outrank
     # story-English), full-corpus frequency as the tie-break, then
     # alphabetical for determinism; words the miner never counted
-    # (curated seeds, merged hand-picked entries) land at their tier end
-    final = sorted(words, key=lambda w: (-prose_df.get(w, 0),
+    # (curated seeds, merged hand-picked entries) land at their tier
+    # end. DOC_STOPWORDS lead the key: doc-corpus boilerplate demotes
+    # to the tail tier so suggestion ties resolve toward game words.
+    final = sorted(words, key=lambda w: (w in DOC_STOPWORDS,
+                                         -prose_df.get(w, 0),
                                          -df.get(w, 0), w))
     with open(args.out, "w", encoding="utf-8") as f:
         f.write("\n".join(final) + "\n")

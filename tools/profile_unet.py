@@ -129,6 +129,12 @@ def main():
                          "scan body costs multiplied by its trip count, "
                          "+ VAE decode) instead of one UNet forward")
     ap.add_argument("--platform", default="auto", choices=("auto", "cpu"))
+    ap.add_argument("--sdxl", action="store_true",
+                    help="with --cost-table: analyze the SDXL-base "
+                         "geometry at 1024 instead of SD1.5-512 — the "
+                         "SDXL ceiling accounting (VERDICT r5 weak #7). "
+                         "Shape-only (jax.eval_shape params), so it "
+                         "runs on any backend without the 2.6B init")
     opts = ap.parse_args()  # rejects unknown/typo'd flags
     if opts.platform == "cpu":
         from cassmantle_tpu.utils.xla_flags import pin_cpu_platform
@@ -136,6 +142,46 @@ def main():
         pin_cpu_platform(virtual_devices=False)
     enable_compile_cache()
     batch = opts.batch
+    if opts.sdxl:
+        # Analytic-only path: abstract params via eval_shape (make_jaxpr
+        # traces abstractly, so ShapeDtypeStructs suffice) — no init of
+        # the 2.6B-param tree, runs in seconds on CPU.
+        assert opts.cost_table, "--sdxl is a --cost-table mode"
+        from cassmantle_tpu.config import sdxl_config
+
+        xcfg = sdxl_config()
+        ucfg = xcfg.models.unet
+        model = UNet(ucfg)
+        lat_hw = xcfg.sampler.image_size // 8  # 128 at 1024
+        lat = jax.ShapeDtypeStruct((batch, lat_hw, lat_hw, 4),
+                                   jnp.bfloat16)
+        ts = jax.ShapeDtypeStruct((batch,), jnp.int32)
+        ctx = jax.ShapeDtypeStruct((batch, 77, ucfg.context_dim),
+                                   jnp.bfloat16)
+        add = jax.ShapeDtypeStruct((batch, ucfg.addition_embed_dim),
+                                   jnp.bfloat16)
+        params = jax.eval_shape(
+            model.init, jax.random.PRNGKey(0),
+            jax.ShapeDtypeStruct((1, lat_hw, lat_hw, 4), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1, 77, ucfg.context_dim), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, ucfg.addition_embed_dim),
+                                 jnp.bfloat16))
+        rows, total = cost_table(
+            lambda p, l, t, c, a: model.apply(p, l, t, c, a),
+            params, lat, ts, ctx, add)
+        steps = xcfg.sampler.num_steps
+        per_img = total / batch * 2 * steps  # CFG doubles the forwards
+        print(f"SDXL-base UNet forward, batch={batch}, "
+              f"{xcfg.sampler.image_size}px: {total / 1e12 / batch:.3f} "
+              f"analytic TFLOPs/forward (dot/conv)  -> "
+              f"{per_img / 1e12:.1f} TF/image at {steps}-step CFG")
+        print(f"{'op':22s} {'operand shapes':46s} "
+              f"{'count':>5s} {'GFLOP':>9s} {'%':>5s}")
+        for r in rows:
+            print(f"{r['op']:22s} {r['shapes']:46s} "
+                  f"{r['count']:5d} {r['gflops']:9.1f} {r['pct']:5.1f}")
+        return
     cfg = FrameworkConfig()
     ucfg = cfg.models.unet
     model = UNet(ucfg)
