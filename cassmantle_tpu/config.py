@@ -266,6 +266,21 @@ class ServingConfig:
     score_batch_sizes: Tuple[int, ...] = (8, 64, 256, 1024, 2048)
     max_queue_delay_ms: float = 25.0
     max_pending: int = 4096
+    # -- supervision (serving/queue.py, serving/supervisor.py) ------------
+    # Per-request deadline: a submitted item whose batch never resolves
+    # (wedged XLA call) fails its future instead of hanging the caller.
+    # None disables. Sized to survive a legitimate cold-cache first
+    # compile (minutes) — it bounds hangs, it is NOT a latency SLO;
+    # latency-sensitive callers pass a tighter submit(deadline_s=...).
+    submit_deadline_s: Optional[float] = 300.0
+    # Dispatch watchdog: a handler exceeding this has wedged the dispatch
+    # thread — the batch fails, the thread is disowned + replaced, the
+    # supervisor flips degraded. Generous: first-dispatch XLA compiles
+    # legitimately take minutes on cold caches. None disables.
+    dispatch_hang_s: Optional[float] = 300.0
+    # Tightened admission bound while the supervisor reports degraded —
+    # a sick device gets a short queue, not max_pending of doomed work.
+    degraded_max_pending: int = 256
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,6 +299,10 @@ class GameConfig:
     max_retries: int = 5             # server.py:19
     rate_limit_default: float = 3.0  # req/s per IP, main.py:19
     rate_limit_api: float = 2.0      # main.py:48 etc.
+    # Round-reserve ring (engine/reserve.py): archived rounds rotated in
+    # while generation is dark, so degraded rounds stay FRESH puzzles
+    # instead of replaying one. 0 disables (pure reference replay).
+    reserve_capacity: int = 8
 
 
 @dataclasses.dataclass(frozen=True)

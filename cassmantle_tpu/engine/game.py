@@ -18,10 +18,12 @@ import numpy as np
 
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.engine.masking import EmbedFn
+from cassmantle_tpu.engine.reserve import RoundReserve
 from cassmantle_tpu.engine.rounds import ContentBackend, RoundManager
 from cassmantle_tpu.engine.scoring import GuessScorer, SimilarityFn, score_to_blur
 from cassmantle_tpu.engine.sessions import SessionManager
 from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.serving.supervisor import ServingSupervisor
 from cassmantle_tpu.utils.logging import metrics
 from cassmantle_tpu.utils.text import format_clock
 
@@ -48,10 +50,19 @@ class Game:
         embed: EmbedFn,
         similarity: SimilarityFn,
         blur_fn: Optional[BlurFn] = None,
+        supervisor: Optional[ServingSupervisor] = None,
     ) -> None:
         game_cfg = cfg.game
         self.cfg = cfg
         self.store = store
+        # the degradation control plane: production shares one supervisor
+        # between the InferenceService and the engine (server/app.py
+        # build_game); standalone/fake games get their own
+        self.supervisor = supervisor or ServingSupervisor()
+        self.reserve = (
+            RoundReserve(store, capacity=game_cfg.reserve_capacity)
+            if game_cfg.reserve_capacity > 0 else None
+        )
         self.sessions = SessionManager(
             store, game_cfg.min_score, game_cfg.time_per_prompt
         )
@@ -68,6 +79,8 @@ class Game:
             lock_timeout=game_cfg.lock_timeout,
             acquire_timeout=game_cfg.acquire_timeout,
             on_promote=self._reset_sessions,
+            reserve=self.reserve,
+            breaker=self.supervisor.content_breaker,
         )
         self.blur_fn = blur_fn or _pil_blur
         # blur bucket -> base64 JPEG, all for one round image identified

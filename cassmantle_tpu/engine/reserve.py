@@ -1,0 +1,123 @@
+"""Store-backed round reserve: fresh puzzles while the device path is dark.
+
+The reference's only degradation mode is the silent replay: when generation
+fails, promotion is a no-op and the *same* puzzle loops until the backend
+heals (reference backend.py:211-215). The reserve upgrades that floor —
+every successfully generated round is archived into a capped ring in the
+state store, and when the content breaker is open the round manager
+promotes the least-recently-played archived round instead of replaying the
+current one. Players keep getting a *different* puzzle every cycle even
+with the TPU wedged.
+
+Each slot's (text, prompt state, image) is ONE pickled hash field, so a
+slot is written atomically per the store contract (single-command hashes on
+both MemoryStore and the single-threaded native store) — a crash mid-
+archive can never leave a slot pairing one round's prompt with another
+round's image, the consistency invariant promotion defends. A small
+prompt-only index hash keeps slot *selection* cheap (no JPEG transfer to
+choose a slot); the blob's own prompt is authoritative at pickup.
+
+Living in the store (not process memory) keeps the two store properties
+the engine is built on: reserve rounds survive worker restarts, and in a
+multi-worker fleet every worker draws from (and play-stamps) one shared
+rotation instead of N private ones.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional, Tuple
+
+from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("reserve")
+
+ROUNDS_KEY = "reserve:rounds"    # slot -> pickle((text, prompt_json, jpeg))
+INDEX_KEY = "reserve:prompt"     # slot -> prompt_json (selection only)
+META_KEY = "reserve:meta"        # counters + per-slot seq/played stamps
+
+
+def _field(name) -> str:
+    return name.decode() if isinstance(name, bytes) else str(name)
+
+
+class RoundReserve:
+    """Capped ring of archived rounds with least-recently-played pickup.
+
+    ``archive`` runs on every successful generation; ``pick`` runs under
+    the promotion lock when the buffer is empty. Play stamps are set at
+    archive time too (an archived round is about to be the live round),
+    so the rotation orders by least-recently-*on-screen*, not merely
+    least-recently-picked-from-reserve.
+    """
+
+    def __init__(self, store: StateStore, capacity: int = 8) -> None:
+        assert capacity > 0, "reserve capacity must be positive"
+        self.store = store
+        self.capacity = capacity
+
+    async def archive(self, text: str, prompt_state_json: str,
+                      image_bytes: bytes) -> None:
+        """Append one generated round; overwrites the oldest past capacity.
+        Consecutive duplicates (a restarted story landing on the same seed)
+        are skipped so the ring never wastes two slots on one puzzle."""
+        archived = int(await self.store.hget(META_KEY, "archived") or 0)
+        if archived > 0:
+            last_slot = str((archived - 1) % self.capacity)
+            last = await self.store.hget(ROUNDS_KEY, last_slot)
+            if last is not None and pickle.loads(last)[0] == text:
+                return
+        seq = await self.store.hincrby(META_KEY, "archived", 1)
+        slot = str((seq - 1) % self.capacity)
+        # the payload is one atomic field; the index is written after, so
+        # a crash between the two leaves a stale index entry at worst —
+        # pick() re-verifies against the blob before serving
+        await self.store.hset(
+            ROUNDS_KEY, slot,
+            pickle.dumps((text, prompt_state_json, image_bytes)))
+        await self.store.hset(INDEX_KEY, slot, prompt_state_json)
+        await self.store.hset(META_KEY, f"seq:{slot}", seq)
+        # archived == about to be played: stamp now so degraded pickup
+        # starts from the round the players saw longest ago
+        stamp = await self.store.hincrby(META_KEY, "plays", 1)
+        await self.store.hset(META_KEY, f"played:{slot}", stamp)
+        metrics.inc("reserve.archived")
+        metrics.gauge("reserve.size", await self.size())
+
+    async def size(self) -> int:
+        return len(await self.store.hgetall(ROUNDS_KEY))
+
+    async def pick(self, exclude: Optional[bytes] = None,
+                   ) -> Optional[Tuple[str, bytes, bytes]]:
+        """Least-recently-played (text, prompt_state_json, image) — or
+        None if the reserve is empty / only holds the excluded round.
+        ``exclude`` is the current round's prompt-state bytes, so degraded
+        promotion never re-serves the puzzle already on screen."""
+        index = {_field(k): v
+                 for k, v in (await self.store.hgetall(INDEX_KEY)).items()}
+        meta = {_field(k): v
+                for k, v in (await self.store.hgetall(META_KEY)).items()}
+        candidates = [
+            (int(meta.get(f"played:{slot}", b"0") or 0), slot)
+            for slot, prompt_json in index.items()
+            if exclude is None or prompt_json != exclude
+        ]
+        candidates.sort()
+        for _, slot in candidates:
+            blob = await self.store.hget(ROUNDS_KEY, slot)
+            if blob is None:
+                continue
+            text, prompt_json, image = pickle.loads(blob)
+            prompt_bytes = prompt_json.encode() \
+                if isinstance(prompt_json, str) else prompt_json
+            # the blob is authoritative: a stale index entry (crash
+            # between blob and index writes) must not sneak the
+            # on-screen round back in
+            if exclude is not None and prompt_bytes == exclude:
+                continue
+            stamp = await self.store.hincrby(META_KEY, "plays", 1)
+            await self.store.hset(META_KEY, f"played:{slot}", stamp)
+            metrics.inc("reserve.picks")
+            return text, prompt_bytes, image
+        return None

@@ -13,11 +13,18 @@ Keeps the reference's control loop shape (SURVEY.md §3.2):
   continuing from the previous one, then a fresh seed starts a new story
   (backend.py:137-150);
 - all generation/promotion runs under store locks with skip-don't-crash
-  semantics: if generation fails, the old round silently replays
-  (backend.py:211-215 — promotion is a no-op when the buffer is empty).
+  semantics: if generation fails, the round still rotates — the reference
+  silently replays the same round (backend.py:211-215 — promotion is a
+  no-op when the buffer is empty); here an empty buffer first falls back
+  to the store-backed round reserve (engine/reserve.py), so a dark device
+  serves *different* archived puzzles each cycle, and only an empty
+  reserve degrades all the way to the reference's replay.
 
 Generation itself is behind the :class:`ContentBackend` protocol — the TPU
-serving pipeline in production, a deterministic fake in tests.
+serving pipeline in production, a deterministic fake in tests — optionally
+guarded by a circuit breaker (utils/circuit.py): repeated failures trip it,
+open-state rounds skip the backend dial (and its retry backoff) entirely,
+and a half-open probe re-admits generation when the device heals.
 """
 
 from __future__ import annotations
@@ -31,7 +38,9 @@ from typing import Callable, Dict, Optional, Sequence
 import numpy as np
 
 from cassmantle_tpu.engine.masking import EmbedFn, build_prompt_state
+from cassmantle_tpu.engine.reserve import RoundReserve
 from cassmantle_tpu.engine.store import LockTimeout, StateStore
+from cassmantle_tpu.utils.circuit import CircuitBreaker, CircuitOpen
 from cassmantle_tpu.utils.codec import decode_jpeg, encode_jpeg
 from cassmantle_tpu.utils.logging import get_logger, metrics
 from cassmantle_tpu.utils.retry import linear_backoff, retry_async
@@ -79,6 +88,8 @@ class RoundManager:
         retry_backoff_s: float = 2.0,
         rng: Optional[random.Random] = None,
         on_promote: Optional[Callable[[], object]] = None,
+        reserve: Optional[RoundReserve] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ) -> None:
         self.store = store
         self.backend = backend
@@ -96,6 +107,12 @@ class RoundManager:
         # async callback run after each promotion (the game layer resets
         # sessions there, mirroring server.py:168).
         self.on_promote = on_promote
+        # supervision seam (ISSUE 2): archive every generated round into
+        # the reserve ring; fail generation fast while the breaker is
+        # open so a dark device costs nothing per round and promotion
+        # rotates reserve content instead of replaying.
+        self.reserve = reserve
+        self.breaker = breaker
         self._timer_task: Optional[asyncio.Task] = None
         self._buffer_task: Optional[asyncio.Task] = None
 
@@ -121,19 +138,38 @@ class RoundManager:
                 return False, prev.decode()
         return True, self.select_seed()
 
+    async def _attempt_generate(self, seed: str, is_seed: bool) -> RoundContent:
+        """One guarded backend call: fail fast while the breaker is open
+        (no device dial, no backoff burn), and record every attempt's
+        outcome so repeated failures trip it."""
+        if self.breaker is not None and not self.breaker.allow():
+            raise CircuitOpen(self.breaker.name)
+        try:
+            content = await self.backend.generate(seed, is_seed)
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            self.breaker.record_success()
+        return content
+
     async def _generate(self, seed: str, is_seed: bool) -> RoundContent:
         """Generation with regeneration-retry (reference retries failed API
         calls ≤5x, utils.py:43-61; here failed device generations retry the
         same way before the round falls back to a replay). Callers hold
         startup/buffer locks, so total retry time is deadline-bounded
         below the lock timeout — the lock can't lapse mid-retry and let a
-        second worker interleave writes into the same slot."""
+        second worker interleave writes into the same slot. A breaker
+        rejection aborts the retry loop outright: backing off against an
+        open breaker is pure wasted lock time."""
         return await retry_async(
-            lambda: self.backend.generate(seed, is_seed),
+            lambda: self._attempt_generate(seed, is_seed),
             max_retries=self.max_retries,
             backoff=linear_backoff(self.retry_backoff_s),
             name="generate",
             deadline_s=0.8 * self.lock_timeout,
+            give_up_on=(CircuitOpen,),
         )
 
     # -- content helpers --------------------------------------------------
@@ -141,11 +177,22 @@ class RoundManager:
         prompt_state = build_prompt_state(
             content.prompt_text, self.embed, self.num_masked
         )
+        state_json = json.dumps(prompt_state)
+        jpeg = encode_jpeg(content.image)
         await self.store.hset(PROMPT_KEY, "seed", content.prompt_text)
-        await self.store.hset(PROMPT_KEY, slot, json.dumps(prompt_state))
-        await self.store.hset(IMAGE_KEY, slot, encode_jpeg(content.image))
+        await self.store.hset(PROMPT_KEY, slot, state_json)
+        await self.store.hset(IMAGE_KEY, slot, jpeg)
         if slot == "current":
             await self._bump_image_version()
+        if self.reserve is not None:
+            # archive exactly the bytes a promotion writes; a reserve
+            # hiccup must never fail the generation that just succeeded
+            try:
+                await self.reserve.archive(
+                    content.prompt_text, state_json, jpeg)
+            except Exception:
+                log.exception("reserve archive failed")
+                metrics.inc("reserve.archive_failures")
 
     async def _bump_image_version(self) -> None:
         """Monotonic counter, bumped AFTER every current-image write (so
@@ -245,6 +292,11 @@ class RoundManager:
                 prompt_next = await self.store.hget(PROMPT_KEY, "next")
                 image_next = await self.store.hget(IMAGE_KEY, "next")
                 if prompt_next is None or image_next is None:
+                    # generation is dark (breaker open / buffer failed):
+                    # rotate a reserve round so players get a FRESH
+                    # puzzle; replay only when the reserve is empty too
+                    if await self._promote_from_reserve():
+                        return
                     log.warning("no buffered content; replaying round")
                     metrics.inc("rounds.replays")
                     return
@@ -285,6 +337,39 @@ class RoundManager:
             # round update (backend.py:236-238); the old round replays
             log.exception("promotion failed; old round will replay")
             metrics.inc("rounds.promote_failures")
+
+    async def _promote_from_reserve(self) -> bool:
+        """Degraded promotion (runs under the promotion lock): pull the
+        least-recently-played archived round that isn't the one on
+        screen and make it current. Same rollback discipline as the
+        normal promotion — the served (prompt, image) pair stays
+        consistent or unchanged."""
+        if self.reserve is None:
+            return False
+        prompt_prev = await self.store.hget(PROMPT_KEY, "current")
+        picked = await self.reserve.pick(exclude=prompt_prev)
+        if picked is None:
+            return False
+        text, prompt_state, image = picked
+        image_prev = await self.store.hget(IMAGE_KEY, "current")
+        try:
+            await self.store.hset(PROMPT_KEY, "current", prompt_state)
+            await self.store.hset(IMAGE_KEY, "current", image)
+        except Exception:
+            log.exception("reserve promotion write failed; rolling back")
+            if prompt_prev is not None and image_prev is not None:
+                await self.store.hset(PROMPT_KEY, "current", prompt_prev)
+                await self.store.hset(IMAGE_KEY, "current", image_prev)
+                await self._bump_image_version()
+            raise
+        await self._bump_image_version()
+        # the reserve round becomes the story-so-far: when the backend
+        # heals, the next episode continues from what players last saw
+        await self.store.hset(PROMPT_KEY, "seed", text)
+        metrics.inc("rounds.reserve_promotions")
+        log.warning("generation dark; promoted reserve round "
+                    "(fresh-content degraded mode)")
+        return True
 
     # -- clock ------------------------------------------------------------
     async def start_countdown(self) -> None:

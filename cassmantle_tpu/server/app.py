@@ -11,6 +11,10 @@ Route-for-route parity with the reference (SURVEY.md §1 L4, §3.3-3.5):
                             (main.py:113-120)
 - ``WS   /clock``          1 Hz {time, reset, conns} push (main.py:55-79)
 - ``GET  /metrics``        counters/timings (new; SURVEY.md §5.5)
+- ``GET  /healthz``        liveness: process + store + device (new)
+- ``GET  /readyz``         readiness: supervisor verdict — breakers,
+                           dispatch watchdog, device health fused; 503 +
+                           Retry-After while degraded (new; ISSUE 2)
 - ``POST /debug/trace``    on-demand jax.profiler capture (new; §5.1;
                             loopback only)
 - static mounts ``/static`` and ``/data`` (main.py:25-27)
@@ -43,7 +47,6 @@ DATA_DIR = os.path.join(_ROOT, "data")
 MEDIA_DIR = os.path.join(_ROOT, "media")
 
 _GAME = web.AppKey("game", Game)
-_HEALTH = web.AppKey("health", object)
 _TRACE_STATE = web.AppKey("trace_state", dict)
 
 
@@ -84,7 +87,9 @@ def make_ratelimit_middleware(cfg: FrameworkConfig):
             rate = cfg.game.rate_limit_default
         if not limiter.allow(_client_ip(request), request.path, rate):
             metrics.inc("http.rate_limited")
-            raise web.HTTPTooManyRequests(text="rate limit exceeded")
+            raise web.HTTPTooManyRequests(
+                text="rate limit exceeded",
+                headers={"Retry-After": "1"})
         return await handler(request)
 
     return ratelimit
@@ -131,6 +136,15 @@ async def handle_fetch_contents(request: web.Request) -> web.Response:
 
 async def handle_compute_score(request: web.Request) -> web.Response:
     game = request.app[_GAME]
+    supervisor = game.supervisor
+    if supervisor.shed_scores():
+        # the scorer is provably dark (breaker open): shed with an
+        # honest 503 + Retry-After instead of serving floor scores that
+        # read as "every guess is wrong"
+        metrics.inc("http.score_shed")
+        raise web.HTTPServiceUnavailable(
+            text="scoring degraded; retry shortly",
+            headers={"Retry-After": str(int(supervisor.retry_after_s()))})
     session = _session_id(request) or str(uuid.uuid4())
     await game.ensure_client(session)
     try:
@@ -184,33 +198,55 @@ async def handle_metrics(request: web.Request) -> web.Response:
     return web.json_response(metrics.snapshot())
 
 
+async def _probe_store(game: Game) -> bool:
+    try:
+        await asyncio.wait_for(game.store.exists("healthz"), timeout=2.0)
+        return True
+    except Exception:
+        return False
+
+
 async def handle_healthz(request: web.Request) -> web.Response:
-    """Liveness: process up + store reachable + device responsive. Both
+    """LIVENESS: process up + store reachable + device responsive. Both
     probes carry deadlines (a wedged store connection or chip reports
-    unhealthy instead of hanging the endpoint) and run concurrently."""
+    unhealthy instead of hanging the endpoint) and run concurrently.
+    Carries the supervisor block for operators, but only store/device
+    drive the status code — a degraded-but-serving worker must not be
+    restarted by a liveness probe (that's `/readyz`'s job to report)."""
     game = request.app[_GAME]
-    health = request.app.get(_HEALTH)
-
-    async def store_probe() -> bool:
-        try:
-            await asyncio.wait_for(game.store.exists("healthz"), timeout=2.0)
-            return True
-        except Exception:
-            return False
-
-    async def device_probe() -> bool:
-        if health is None:
-            return True  # fake backend: no device to probe
-        loop = asyncio.get_running_loop()
-        ok, _ = await loop.run_in_executor(None, health.check)
-        return ok
-
-    store_ok, device_ok = await asyncio.gather(store_probe(), device_probe())
-    ok = store_ok and device_ok
+    store_ok, device_ok = await asyncio.gather(
+        _probe_store(game), game.supervisor.probe_device())
+    ok = store_ok and device_ok is not False
     return web.json_response(
-        {"ok": ok, "store": store_ok, "device": device_ok},
+        {
+            "ok": ok,
+            "store": store_ok,
+            "device": device_ok is not False,
+            "supervisor": game.supervisor.status(device_ok=device_ok),
+        },
         status=200 if ok else 503,
     )
+
+
+async def handle_readyz(request: web.Request) -> web.Response:
+    """READINESS: can this worker produce fresh content and real scores
+    right now? Fuses breaker states, the dispatch watchdog, and the
+    device probe (ServingSupervisor.status). Degraded -> 503 +
+    Retry-After so load balancers drain the worker while the game keeps
+    serving reserve rounds to players already on it."""
+    game = request.app[_GAME]
+    store_ok, device_ok = await asyncio.gather(
+        _probe_store(game), game.supervisor.probe_device())
+    status = game.supervisor.status(device_ok=device_ok)
+    status["store"] = store_ok
+    ready = bool(status["ready"]) and store_ok
+    status["ready"] = ready
+    if ready:
+        return web.json_response(status)
+    status["state"] = "degraded"
+    retry_after = str(int(game.supervisor.retry_after_s()))
+    return web.json_response(
+        status, status=503, headers={"Retry-After": retry_after})
 
 
 async def handle_debug_trace(request: web.Request) -> web.Response:
@@ -248,7 +284,7 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
     try:
         import jax
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         # start/stop in an executor: the first profiler call can trigger
         # jax backend init, which must never block the serving event loop
         await loop.run_in_executor(
@@ -329,7 +365,9 @@ def create_app(game: Game, cfg: FrameworkConfig,
     if device_health:
         from cassmantle_tpu.utils.health import DeviceHealth
 
-        app[_HEALTH] = DeviceHealth()
+        # the supervisor owns the prober and fuses its verdict into
+        # /healthz and /readyz (supervisor.probe_device)
+        game.supervisor.device_health = DeviceHealth()
     app.router.add_get("/", handle_root)
     app.router.add_get("/init", handle_init)
     app.router.add_get("/client/status", handle_status)
@@ -338,6 +376,7 @@ def create_app(game: Game, cfg: FrameworkConfig,
     app.router.add_get("/clock", handle_clock)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/healthz", handle_healthz)
+    app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/wordlist", handle_wordlist)
     app.router.add_post("/debug/trace", handle_debug_trace)
     if os.path.isdir(STATIC_DIR):
@@ -372,7 +411,12 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
     Redis); default is the in-process MemoryStore.
     """
     from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
 
+    # ONE supervisor per worker: the engine's content breaker and the
+    # inference service's score breaker + queue watchdogs must fuse into
+    # the same /readyz verdict
+    supervisor = ServingSupervisor()
     if store_addr:
         import re
 
@@ -397,15 +441,17 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
         )
 
         return Game(cfg, store, FakeContentBackend(image_size=256),
-                    hash_embed, hash_similarity)
+                    hash_embed, hash_similarity, supervisor=supervisor)
     from cassmantle_tpu.serving.service import InferenceService
 
-    service = InferenceService(cfg, weights_dir=weights_dir)
+    service = InferenceService(cfg, weights_dir=weights_dir,
+                               supervisor=supervisor)
     return Game(
         cfg, store, service.content_backend,
         embed=service.embed,
         similarity=service.similarity,
         blur_fn=service.blur,
+        supervisor=supervisor,
     )
 
 

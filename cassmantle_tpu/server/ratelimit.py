@@ -31,17 +31,39 @@ class TokenBucket:
 
 
 class RateLimiter:
-    """Buckets keyed by (ip, class); stale buckets evicted lazily."""
+    """Buckets keyed by (ip, class); stale buckets evicted on overflow.
 
-    def __init__(self, max_entries: int = 10000) -> None:
+    Eviction is targeted, never a flush: clearing the whole table when
+    full would reset EVERY active client's bucket to a full burst at
+    once — a synchronized admission spike exactly when the table is
+    busiest. Instead, overflow drops buckets idle longer than
+    ``stale_s``, then (if still full) the longest-idle tail, so active
+    clients keep their spent tokens.
+    """
+
+    def __init__(self, max_entries: int = 10000,
+                 stale_s: float = 60.0) -> None:
         self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
         self.max_entries = max_entries
+        self.stale_s = stale_s
+
+    def _evict(self) -> None:
+        now = time.monotonic()
+        stale = [k for k, b in self._buckets.items()
+                 if now - b.updated > self.stale_s]
+        for k in stale:
+            del self._buckets[k]
+        if len(self._buckets) >= self.max_entries:
+            # still full of active clients: shed the longest-idle tenth
+            by_idle = sorted(self._buckets, key=lambda k: self._buckets[k].updated)
+            for k in by_idle[:max(1, self.max_entries // 10)]:
+                del self._buckets[k]
 
     def allow(self, ip: str, route_class: str, rate: float) -> bool:
         key = (ip, route_class)
         bucket = self._buckets.get(key)
         if bucket is None:
             if len(self._buckets) >= self.max_entries:
-                self._buckets.clear()  # crude flush; per-IP state is cheap
+                self._evict()
             bucket = self._buckets[key] = TokenBucket(rate)
         return bucket.allow()

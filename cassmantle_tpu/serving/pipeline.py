@@ -816,7 +816,7 @@ class TPUContentBackend(ContentBackend):
 
     async def generate(self, seed: str, is_seed: bool,
                        text: Optional[str] = None) -> RoundContent:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None, self.generate_sync, seed, is_seed, text
         )

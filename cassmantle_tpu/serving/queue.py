@@ -10,14 +10,31 @@ in flight per step) while the event loop stays free (SURVEY.md §7 stage 6,
 hard part (d)). Bucketed batch sizes keep shapes static: a batch of 37
 guesses pads to the 64 bucket, reusing the compiled graph.
 
-Backpressure: a bounded queue; when full, ``submit`` fails fast and the
-caller degrades (skip-don't-crash, reference error semantics §5.3).
+Failure containment (the supervisor subsystem, ISSUE 2):
+
+- **Backpressure**: a bounded queue; when full, ``submit`` fails fast and
+  the caller degrades (skip-don't-crash, reference error semantics §5.3).
+  While the supervisor reports degraded, the bound tightens to
+  ``degraded_max_pending`` — a sick device gets a short queue, not a
+  4096-deep pile of doomed work.
+- **Per-request deadlines**: ``submit`` fails its future with
+  :class:`DeadlineExceeded` when the deadline passes, whether the item is
+  still queued or stuck inside a hung handler — awaiting callers never
+  hang on a wedged XLA call.
+- **Dispatch watchdog**: a handler that exceeds ``hang_timeout_s`` has
+  wedged the dispatch thread (device calls hang rather than raise —
+  utils/health.py). The batch's futures fail with
+  :class:`DispatchTimeout`, the supervisor is flipped degraded, and the
+  wedged thread is *disowned* (daemon) and replaced so later batches
+  still dispatch.
 """
 
 from __future__ import annotations
 
 import asyncio
-from concurrent.futures import ThreadPoolExecutor
+import concurrent.futures
+import queue as _thread_queue
+import threading
 from typing import Callable, Generic, List, Optional, Sequence, TypeVar
 
 from cassmantle_tpu.utils.logging import get_logger, metrics
@@ -27,14 +44,105 @@ R = TypeVar("R")
 
 log = get_logger("queue")
 
-# One dispatch thread per process: device work serializes here.
-_dispatch_executor = ThreadPoolExecutor(
-    max_workers=1, thread_name_prefix="cassmantle-dispatch"
-)
-
 
 class QueueFull(Exception):
     pass
+
+
+class QueueStopped(QueueFull):
+    """The queue shut down with this item still pending."""
+
+
+class DeadlineExceeded(Exception):
+    """A submitted item missed its per-request deadline."""
+
+
+class DispatchTimeout(Exception):
+    """The batch handler wedged the dispatch thread past the watchdog."""
+
+
+class _HandlerWedged(Exception):
+    """Internal watchdog signal: the RUNNING handler overran its hang
+    deadline (distinct from a handler-raised TimeoutError, which must
+    propagate per-item like any other handler exception)."""
+
+
+class _DispatchWorker:
+    """One DAEMON dispatch thread per process: device work serializes
+    here. Daemon because a wedged XLA call cannot be cancelled, only
+    disowned — ``replace()`` retires the stuck thread (it exits if its
+    call ever returns), re-queues any jobs it hadn't started, and starts
+    a fresh thread, without ever pinning process exit."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._jobs: Optional[_thread_queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _loop(jobs: "_thread_queue.Queue") -> None:
+        while True:
+            job = jobs.get()
+            if job is None:  # retired by replace()
+                return
+            fn, args, cf, started = job
+            if not cf.set_running_or_notify_cancel():
+                continue
+            started.set()
+            try:
+                result = fn(*args)
+            except BaseException as exc:  # noqa: BLE001 — carried to waiter
+                cf.set_exception(exc)
+            else:
+                cf.set_result(result)
+
+    def _ensure(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._jobs = _thread_queue.Queue()
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._jobs,),
+                daemon=True, name="cassmantle-dispatch",
+            )
+            self._thread.start()
+
+    def submit(self, fn: Callable, *args):
+        """Returns (future, started_event). ``started`` distinguishes a
+        handler that is actually RUNNING from one merely queued behind
+        another queue's dispatch — the watchdog must only declare a wedge
+        for the former."""
+        with self._lock:
+            self._ensure()
+            cf: concurrent.futures.Future = concurrent.futures.Future()
+            started = threading.Event()
+            self._jobs.put((fn, args, cf, started))
+            return cf, started
+
+    def replace(self) -> None:
+        """Disown a wedged thread and start a fresh one. Jobs the old
+        thread had not started move to the new thread; the in-flight call
+        keeps its (already-failed) future and its eventual result is
+        dropped."""
+        with self._lock:
+            old_jobs = self._jobs
+            self._jobs = _thread_queue.Queue()
+            if old_jobs is not None:
+                while True:
+                    try:
+                        job = old_jobs.get_nowait()
+                    except _thread_queue.Empty:
+                        break
+                    if job is not None:
+                        self._jobs.put(job)
+                old_jobs.put(None)  # retire the old thread when it unwedges
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._jobs,),
+                daemon=True, name="cassmantle-dispatch",
+            )
+            self._thread.start()
+            metrics.inc("dispatch.thread_replacements")
+
+
+_dispatcher = _DispatchWorker()
 
 
 class BatchingQueue(Generic[T, R]):
@@ -42,6 +150,12 @@ class BatchingQueue(Generic[T, R]):
 
     ``handler(items) -> results`` runs on the dispatch thread and must
     return one result per item (it pads internally to its bucket shapes).
+
+    ``default_deadline_s`` bounds each submission end to end;
+    ``hang_timeout_s`` arms the dispatch watchdog; ``supervisor`` (a
+    :class:`~cassmantle_tpu.serving.supervisor.ServingSupervisor`)
+    receives overrun notifications and drives the degraded admission
+    bound ``degraded_max_pending``.
     """
 
     def __init__(
@@ -51,11 +165,22 @@ class BatchingQueue(Generic[T, R]):
         max_delay_ms: float = 25.0,
         max_pending: int = 4096,
         name: str = "queue",
+        default_deadline_s: Optional[float] = None,
+        hang_timeout_s: Optional[float] = None,
+        supervisor=None,
+        degraded_max_pending: Optional[int] = None,
     ) -> None:
         self.handler = handler
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1000.0
         self.name = name
+        self.default_deadline_s = default_deadline_s
+        self.hang_timeout_s = hang_timeout_s
+        self.supervisor = supervisor
+        self.degraded_max_pending = (
+            degraded_max_pending if degraded_max_pending is not None
+            else max(1, max_pending // 8)
+        )
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_pending)
         self._task: Optional[asyncio.Task] = None
 
@@ -71,10 +196,35 @@ class BatchingQueue(Generic[T, R]):
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # fail anything still queued: a pending future left to dangle
+        # hangs its awaiting caller forever (ISSUE 2 satellite)
+        stopped = 0
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not fut.done():
+                fut.set_exception(QueueStopped(self.name))
+            stopped += 1
+        if stopped:
+            metrics.inc(f"{self.name}.stopped_pending", stopped)
 
-    async def submit(self, item: T) -> R:
+    def _expire(self, fut: asyncio.Future) -> None:
+        if not fut.done():
+            metrics.inc(f"{self.name}.deadline_expired")
+            fut.set_exception(DeadlineExceeded(self.name))
+
+    async def submit(self, item: T, *,
+                     deadline_s: Optional[float] = None) -> R:
         self.start()
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
+        if self.supervisor is not None and self.supervisor.degraded and \
+                self._queue.qsize() >= self.degraded_max_pending:
+            # degraded: admit only a short queue — deep backlogs behind a
+            # sick device are all going to miss their deadlines anyway
+            metrics.inc(f"{self.name}.rejected_degraded")
+            raise QueueFull(f"{self.name} (degraded)")
         fut: asyncio.Future = loop.create_future()
         try:
             self._queue.put_nowait((item, fut))
@@ -82,39 +232,57 @@ class BatchingQueue(Generic[T, R]):
             metrics.inc(f"{self.name}.rejected")
             raise QueueFull(self.name)
         metrics.gauge(f"{self.name}.depth", self._queue.qsize())
+        deadline_s = (deadline_s if deadline_s is not None
+                      else self.default_deadline_s)
+        if deadline_s is not None:
+            handle = loop.call_later(deadline_s, self._expire, fut)
+            fut.add_done_callback(lambda _f: handle.cancel())
         return await fut
 
     async def _collect(self) -> List:
-        """One entry (blocking) + everything arriving within the window."""
-        first = await self._queue.get()
-        batch = [first]
-        loop = asyncio.get_event_loop()
-        deadline = loop.time() + self.max_delay_s
-        while len(batch) < self.max_batch:
-            timeout = deadline - loop.time()
-            if timeout <= 0:
-                break
-            try:
-                batch.append(
-                    await asyncio.wait_for(self._queue.get(), timeout)
-                )
-            except asyncio.TimeoutError:
-                break
+        """One entry (blocking) + everything arriving within the window.
+        Cancellation-safe: items already popped off the queue when the
+        collector is cancelled (queue stopping mid-window) have their
+        futures failed here — stop()'s drain can no longer see them."""
+        batch: List = []
+        try:
+            batch.append(await self._queue.get())
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.max_delay_s
+            while len(batch) < self.max_batch:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self._queue.get(), timeout)
+                    )
+                except asyncio.TimeoutError:
+                    break
+        except asyncio.CancelledError:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(QueueStopped(self.name))
+            raise
         return batch
 
     async def _run(self) -> None:
-        loop = asyncio.get_event_loop()
         while True:
             batch = await self._collect()
+            # deadline-expired entries are already failed; don't spend a
+            # device dispatch on items nobody is waiting for
+            batch = [(item, fut) for item, fut in batch if not fut.done()]
+            if not batch:
+                continue
             items = [item for item, _ in batch]
             futures = [fut for _, fut in batch]
             metrics.inc(f"{self.name}.batches")
             metrics.inc(f"{self.name}.items", len(items))
+            dispatch, started = _dispatcher.submit(self.handler, items)
+            wrapped = asyncio.wrap_future(dispatch)
             try:
                 with metrics.timer(f"{self.name}.batch_s"):
-                    results = await loop.run_in_executor(
-                        _dispatch_executor, self.handler, items
-                    )
+                    results = await self._await_dispatch(wrapped, started)
                 if len(results) != len(items):
                     raise RuntimeError(
                         f"handler returned {len(results)} results for "
@@ -123,9 +291,60 @@ class BatchingQueue(Generic[T, R]):
                 for fut, res in zip(futures, results):
                     if not fut.done():
                         fut.set_result(res)
+            except asyncio.CancelledError:
+                # queue stopping mid-batch: the in-flight futures must
+                # fail, not dangle (their handler result is dropped)
+                self._disown(wrapped)
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(QueueStopped(self.name))
+                raise
+            except _HandlerWedged:
+                # OUR handler is running and wedged (hung XLA call): fail
+                # the batch, flip the supervisor degraded, and hand
+                # future batches a fresh dispatch thread
+                log.error(
+                    "%s handler exceeded %.1fs hang deadline; replacing "
+                    "dispatch thread", self.name, self.hang_timeout_s)
+                metrics.inc(f"{self.name}.dispatch_hangs")
+                if self.supervisor is not None:
+                    self.supervisor.note_dispatch_overrun(self.name)
+                _dispatcher.replace()
+                self._disown(wrapped)
+                exc = DispatchTimeout(
+                    f"{self.name} dispatch exceeded {self.hang_timeout_s}s")
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(exc)
             except Exception as exc:  # noqa: BLE001 — propagate per-item
                 log.exception("%s batch failed", self.name)
                 metrics.inc(f"{self.name}.failures")
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(exc)
+
+    async def _await_dispatch(self, wrapped: asyncio.Future,
+                              started: "threading.Event"):
+        """Await the dispatched batch, raising _HandlerWedged only when
+        THIS handler has been running past the hang deadline. Time spent
+        merely queued behind another queue's dispatch on the shared
+        thread never counts: that dispatch's own watchdog replaces the
+        wedged thread, and replace() moves unstarted jobs (this one) onto
+        the fresh thread."""
+        if self.hang_timeout_s is None:
+            return await wrapped
+        while True:
+            done, _ = await asyncio.wait({wrapped},
+                                         timeout=self.hang_timeout_s)
+            if done:
+                return wrapped.result()   # re-raises handler exceptions
+            if started.is_set():
+                raise _HandlerWedged()
+
+    @staticmethod
+    def _disown(wrapped: asyncio.Future) -> None:
+        """Abandon a dispatch future we will never await again; mark its
+        eventual exception retrieved so asyncio doesn't log it."""
+        if not wrapped.done():
+            wrapped.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception())

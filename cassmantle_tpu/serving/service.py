@@ -16,7 +16,13 @@ from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.ops.blur import device_blur
 from cassmantle_tpu.ops.scorer import EmbeddingScorer
 from cassmantle_tpu.serving.pipeline import TPUContentBackend
-from cassmantle_tpu.serving.queue import BatchingQueue, QueueFull
+from cassmantle_tpu.serving.queue import (
+    BatchingQueue,
+    DeadlineExceeded,
+    DispatchTimeout,
+    QueueFull,
+)
+from cassmantle_tpu.serving.supervisor import ServingSupervisor
 from cassmantle_tpu.utils.logging import get_logger
 
 log = get_logger("service")
@@ -41,10 +47,14 @@ class InferenceService:
     def __init__(self, cfg: FrameworkConfig,
                  weights_dir: Optional[str] = None,
                  mesh=None,
-                 backend: Optional[TPUContentBackend] = None) -> None:
+                 backend: Optional[TPUContentBackend] = None,
+                 supervisor: Optional[ServingSupervisor] = None) -> None:
         if mesh is None:
             mesh = default_serving_mesh(cfg)
         self.cfg = cfg
+        # shared with the Game in production (build_game) so breaker
+        # trips here and in the engine fuse into one /readyz signal
+        self.supervisor = supervisor or ServingSupervisor()
         self.scorer = EmbeddingScorer(
             cfg.models.minilm,
             weights_dir=weights_dir,
@@ -58,6 +68,10 @@ class InferenceService:
             max_delay_ms=cfg.serving.max_queue_delay_ms,
             max_pending=cfg.serving.max_pending,
             name="score",
+            default_deadline_s=cfg.serving.submit_deadline_s,
+            hang_timeout_s=cfg.serving.dispatch_hang_s,
+            supervisor=self.supervisor,
+            degraded_max_pending=cfg.serving.degraded_max_pending,
         )
         # Concurrent round generations (double-buffering overlapping a
         # live promotion, or several Game instances sharing one service)
@@ -72,6 +86,10 @@ class InferenceService:
             max_delay_ms=cfg.serving.max_queue_delay_ms,
             max_pending=cfg.serving.max_pending,
             name="prompt",
+            default_deadline_s=cfg.serving.submit_deadline_s,
+            hang_timeout_s=cfg.serving.dispatch_hang_s,
+            supervisor=self.supervisor,
+            degraded_max_pending=cfg.serving.degraded_max_pending,
         )
 
     # handlers run on the dispatch thread
@@ -88,20 +106,38 @@ class InferenceService:
     async def similarity(self, pairs) -> np.ndarray:
         """SimilarityFn: each pair rides the continuous-batching queue, so
         concurrent guesses from many players coalesce into one device
-        batch."""
+        batch. The score breaker wraps the dispatch: while open, guesses
+        degrade to floor scores instantly (no queue, no device dial) and
+        the HTTP layer sheds with 503 + Retry-After; deadline/watchdog
+        failures count toward tripping it."""
         import asyncio
 
         pairs = list(pairs)
+        breaker = self.supervisor.score_breaker
+        if not breaker.allow():
+            log.warning("score breaker open; floor scores for %d pairs",
+                        len(pairs))
+            return np.zeros((len(pairs),), dtype=np.float32)
         try:
             results = await asyncio.gather(
                 *(self.score_queue.submit(p) for p in pairs)
             )
         except QueueFull:
             # overload: degrade to the min score rather than failing the
-            # request (skip-don't-crash)
+            # request (skip-don't-crash). Backpressure is load, not a
+            # device failure — it doesn't count against the breaker.
             log.warning("score queue full; returning zeros for %d pairs",
                         len(pairs))
             return np.zeros((len(pairs),), dtype=np.float32)
+        except (DeadlineExceeded, DispatchTimeout) as exc:
+            breaker.record_failure()
+            log.warning("score dispatch failed (%s); floor scores for %d "
+                        "pairs", type(exc).__name__, len(pairs))
+            return np.zeros((len(pairs),), dtype=np.float32)
+        except Exception:
+            breaker.record_failure()
+            raise
+        breaker.record_success()
         return np.asarray(results, dtype=np.float32)
 
     @staticmethod
@@ -118,9 +154,13 @@ class InferenceService:
         if hasattr(self.backend, "prompt_gen"):
             try:
                 text = await self.prompt_queue.submit(seed)
-            except QueueFull:
+            except (QueueFull, DeadlineExceeded, DispatchTimeout) as exc:
+                # any queue-path failure (backpressure, missed deadline,
+                # wedged dispatch) degrades to the in-backend decode —
+                # the fallback exists precisely for a sick queue path
                 log.warning(
-                    "prompt queue full; decoding %r in-backend", seed[:40])
+                    "prompt queue failed (%s); decoding %r in-backend",
+                    type(exc).__name__, seed[:40])
         if text is not None:
             return await self.backend.generate(seed, is_seed, text=text)
         # injected custom backends may not take a ``text`` kwarg

@@ -1,0 +1,143 @@
+"""Serving supervisor: one readiness signal for the whole device path.
+
+Fuses the three independent degradation detectors into the state the
+operator (and the load balancer) actually needs:
+
+- the **content breaker** around ``ContentBackend.generate`` (a dark TPU
+  stops costing retry backoff and flips the engine onto the round
+  reserve, engine/rounds.py);
+- the **score breaker** around the guess-scorer dispatch
+  (serving/service.py degrades to floor scores, the API sheds with 503);
+- the **dispatch watchdog** in serving/queue.py (a hung handler — a
+  wedged XLA call that blocks the dispatch thread — trips
+  ``note_dispatch_overrun`` when a batch overruns its hang deadline);
+- optionally ``utils.health.DeviceHealth`` (the jitted liveness probe).
+
+``/readyz`` (server/app.py) serves ``status()`` with a 503 + Retry-After
+while degraded: readiness is "can this worker produce fresh content and
+real scores right now", distinct from `/healthz` liveness ("is the
+process/store/device up at all") — a degraded worker still serves the
+game from the store and must NOT be killed by a liveness probe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from cassmantle_tpu.utils.circuit import OPEN, CircuitBreaker
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("supervisor")
+
+
+class ServingSupervisor:
+    def __init__(
+        self,
+        *,
+        content_breaker: Optional[CircuitBreaker] = None,
+        score_breaker: Optional[CircuitBreaker] = None,
+        device_health=None,
+        degraded_cooldown_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.clock = clock
+        self.content_breaker = content_breaker or CircuitBreaker(
+            "content", clock=clock)
+        self.score_breaker = score_breaker or CircuitBreaker(
+            "score", clock=clock)
+        # set by server/app.py when real-device serving wires DeviceHealth
+        self.device_health = device_health
+        self.degraded_cooldown_s = degraded_cooldown_s
+        self._lock = threading.Lock()
+        self._degraded_until = 0.0
+        self._overruns = 0
+
+    # -- watchdog ---------------------------------------------------------
+    def note_dispatch_overrun(self, queue_name: str) -> None:
+        """A batch handler blew through its hang deadline: the dispatch
+        thread was wedged (and has been replaced). Hold the worker in
+        degraded state for a cooldown — one overrun means in-flight
+        device work is unreliable right now, not just that one batch."""
+        with self._lock:
+            self._overruns += 1
+            self._degraded_until = max(
+                self._degraded_until,
+                self.clock() + self.degraded_cooldown_s,
+            )
+        metrics.inc("supervisor.dispatch_overruns")
+        log.error("dispatch overrun on %r: degraded for %.0fs",
+                  queue_name, self.degraded_cooldown_s)
+
+    @property
+    def watchdog_degraded(self) -> bool:
+        with self._lock:
+            return self.clock() < self._degraded_until
+
+    # -- device -----------------------------------------------------------
+    async def probe_device(self) -> Optional[bool]:
+        """DeviceHealth verdict for status(); None = nothing to probe
+        (fake backend). Runs off the event loop — the probe blocks up to
+        its timeout when the device is wedged."""
+        if self.device_health is None:
+            return None
+        loop = asyncio.get_running_loop()
+        ok, _ = await loop.run_in_executor(None, self.device_health.check)
+        return ok
+
+    # -- fused signal -----------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        """True while ANY detector is unhappy: open/half-open breaker or
+        a recent dispatch overrun. Queues tighten rejection thresholds on
+        this; `/readyz` flips 503."""
+        return (
+            self.watchdog_degraded
+            or self.content_breaker.state != "closed"
+            or self.score_breaker.state != "closed"
+        )
+
+    def shed_scores(self) -> bool:
+        """Should the API refuse scoring work outright (503) instead of
+        returning floor scores? Only when the breaker KNOWS the scorer is
+        dark — half-open still lets the probe traffic through."""
+        return self.score_breaker.state == OPEN
+
+    def retry_after_s(self) -> float:
+        """Seconds a shed client should wait: the longest of the open
+        breakers' cooldown remainders and the watchdog window (floor 1)."""
+        with self._lock:
+            watchdog = max(0.0, self._degraded_until - self.clock())
+        return max(
+            1.0,
+            watchdog,
+            self.content_breaker.seconds_until_half_open(),
+            self.score_breaker.seconds_until_half_open(),
+        )
+
+    def status(self, device_ok: Optional[bool] = None) -> Dict[str, object]:
+        """The `/readyz` body. ``device_ok`` is the (executor-run)
+        DeviceHealth verdict when the caller has one; None = no device to
+        probe (fake backend)."""
+        degraded = self.degraded
+        ready = not degraded and device_ok is not False
+        with self._lock:
+            watchdog = {
+                "degraded": self.clock() < self._degraded_until,
+                "overruns": self._overruns,
+                "degraded_for_s": max(
+                    0.0, self._degraded_until - self.clock()),
+            }
+        metrics.gauge("supervisor.degraded", 0.0 if ready else 1.0)
+        return {
+            "ready": ready,
+            "state": "ok" if ready else "degraded",
+            "breakers": {
+                b.name: b.snapshot()
+                for b in (self.content_breaker, self.score_breaker)
+            },
+            "watchdog": watchdog,
+            "device": device_ok,
+        }

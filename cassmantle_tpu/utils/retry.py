@@ -36,6 +36,7 @@ async def retry_async(
     sleep: Callable[[float], Awaitable[None]] = asyncio.sleep,
     name: str = "op",
     deadline_s: Optional[float] = None,
+    give_up_on: Tuple[Type[BaseException], ...] = (),
 ) -> T:
     """Run ``op`` with up to ``max_retries`` attempts; re-raises the last
     failure (callers keep skip-don't-crash semantics at their level).
@@ -44,14 +45,20 @@ async def retry_async(
     elapsed + the next backoff would pass it. Callers that retry while
     holding an expiring lock set this below the lock timeout, so the lock
     cannot lapse mid-retry and admit a second worker (a started attempt
-    can still overrun — an in-flight device call is not preemptible)."""
+    can still overrun — an in-flight device call is not preemptible).
+
+    ``give_up_on`` exceptions abort immediately with no further attempts —
+    e.g. a CircuitOpen fast-fail, where backing off and re-dialing an
+    open breaker would just burn the caller's lock budget."""
     backoff = backoff or linear_backoff()
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     start = loop.time()
     last: Optional[BaseException] = None
     for attempt in range(max_retries):
         try:
             return await op()
+        except give_up_on:
+            raise
         except retry_on as exc:  # noqa: PERF203
             last = exc
             metrics.inc(f"retry.{name}.failures")

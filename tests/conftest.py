@@ -65,7 +65,7 @@ FAST_MODULES = frozenset({
     "test_native_store", "test_ops", "test_pipeline",
     "test_pipeline_parallel", "test_samplers", "test_scoring",
     "test_server", "test_spell", "test_store",
-    "test_utils", "test_weights",
+    "test_supervisor", "test_utils", "test_weights",
     # deliberately NOT fast (stay in the default tier): test_mistral and
     # test_torch_parity — heavyweight parity suites whose coverage the
     # fast smoke doesn't need twice (test_weights pins the converters)

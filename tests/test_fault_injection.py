@@ -212,6 +212,147 @@ async def test_retry_deadline_bounds_lock_hold_time():
     assert time.monotonic() - t0 < 2.0     # not 50 x backoff
 
 
+class CountingDeadBackend(ContentBackend):
+    """DeadBackend that counts how often it is actually dialed."""
+
+    def __init__(self) -> None:
+        self.calls = 0
+
+    async def generate(self, seed, is_seed):
+        self.calls += 1
+        raise RuntimeError("device lost")
+
+
+def arm_fast_breaker(game, threshold=2, reset_s=0.1):
+    """Swap in a breaker that trips after ``threshold`` failures and
+    half-opens after ``reset_s`` — wired into BOTH the supervisor (the
+    /readyz signal) and the round manager (the generation guard), like
+    production wiring in Game.__init__."""
+    from cassmantle_tpu.utils.circuit import CircuitBreaker
+
+    breaker = CircuitBreaker("content", failure_threshold=threshold,
+                             window_s=60.0, reset_timeout_s=reset_s)
+    game.supervisor.content_breaker = breaker
+    game.rounds.breaker = breaker
+    return breaker
+
+
+@pytest.mark.asyncio
+async def test_breaker_trips_reserve_rotates_then_recovers():
+    """The ISSUE 2 acceptance path end to end: backend dies after N good
+    rounds -> breaker trips within one window -> consecutive degraded
+    promotions serve DIFFERENT reserve rounds on the normal clock (no
+    identical back-to-back prompts, no backend dials) -> backend heals ->
+    one half-open probe restores fresh generation and readiness."""
+    backend = FlakyBackend(failures=0)
+    game = make_game(backend, retries=2)
+    breaker = arm_fast_breaker(game, threshold=2, reset_s=0.1)
+    game.rounds.rng = random.Random(42)   # deterministic seed/story line
+
+    await game.rounds.startup()           # archives round 1
+    for _ in range(2):                    # archive rounds 2 and 3
+        await game.rounds.buffer_contents()
+        await game.rounds.rollover()
+    assert await game.reserve.size() == 3
+    assert not game.supervisor.degraded
+
+    # -- backend goes dark: one buffer attempt (2 retried failures) trips
+    dead = CountingDeadBackend()
+    game.rounds.backend = dead
+    await game.rounds.buffer_contents()   # swallowed; breaker trips
+    assert breaker.state == "open"
+    assert game.supervisor.degraded      # what /readyz surfaces as 503
+    dials_after_trip = dead.calls
+
+    # -- degraded rounds: reserve rotation, not replay, not backend dials
+    served = []
+    for _ in range(3):
+        await game.rounds.buffer_contents()     # fast-fail (breaker open)
+        await game.rounds.rollover()            # promotes from reserve
+        prompt = await game.rounds.fetch_current_prompt()
+        served.append(tuple(prompt["tokens"]))
+        assert await game.rounds.remaining() > 0    # clock keeps running
+    assert dead.calls == dials_after_trip    # open breaker = no dials
+    for a, b in zip(served, served[1:]):
+        assert a != b, "degraded promotions must rotate, not replay"
+
+    # -- backend heals: one half-open probe restores full service
+    game.rounds.backend = FlakyBackend(failures=0)
+    await asyncio.sleep(0.15)             # past reset_timeout_s
+    assert breaker.state == "half_open"
+    await game.rounds.buffer_contents()   # the probe: succeeds, closes
+    assert breaker.state == "closed"
+    assert not game.supervisor.degraded   # /readyz OK again
+    before = await game.rounds.fetch_current_prompt()
+    await game.rounds.rollover()          # freshly generated round serves
+    after = await game.rounds.fetch_current_prompt()
+    assert after["tokens"] != before["tokens"]
+
+
+@pytest.mark.asyncio
+async def test_reserve_empty_falls_back_to_reference_replay():
+    """Dead backend from the very first buffer + nothing archived beyond
+    the current round: degradation bottoms out at the reference's replay
+    semantics (same round again), never a crash."""
+    game = make_game(FlakyBackend(failures=0), retries=1)
+    await game.rounds.startup()           # only round ever generated
+    game.rounds.backend = DeadBackend()
+    before = await game.rounds.fetch_current_prompt()
+    await game.rounds.buffer_contents()
+    await game.rounds.rollover()          # reserve only holds the current
+    after = await game.rounds.fetch_current_prompt()
+    assert after["tokens"] == before["tokens"]
+
+
+@pytest.mark.asyncio
+async def test_open_breaker_skips_retry_backoff():
+    """With the breaker open, _generate fails fast (CircuitOpen aborts
+    the retry loop) instead of burning max_retries x backoff inside the
+    buffer lock."""
+    import time as _time
+
+    from cassmantle_tpu.utils.circuit import CircuitOpen
+
+    game = make_game(FlakyBackend(failures=0), retries=50)
+    game.rounds.retry_backoff_s = 0.2
+    breaker = arm_fast_breaker(game, threshold=1, reset_s=60.0)
+    game.rounds.backend = DeadBackend()
+    breaker.record_failure()                        # trip it
+    assert breaker.state == "open"
+    t0 = _time.monotonic()
+    with pytest.raises(CircuitOpen):
+        await game.rounds._generate("seed", True)
+    assert _time.monotonic() - t0 < 0.1             # not 50 x 0.2 s backoff
+
+
+@pytest.mark.asyncio
+async def test_hung_scorer_dispatch_fails_at_deadline_not_forever():
+    """Inject a wedged scorer handler (the hang-not-raise failure
+    utils/health.py documents): pending submits fail at their deadline,
+    the watchdog degrades the supervisor, and a fresh dispatch thread
+    serves the next batch."""
+    import threading
+
+    from cassmantle_tpu.serving.queue import BatchingQueue, DeadlineExceeded
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    release = threading.Event()
+
+    def wedged_scorer(items):
+        if "wedge" in items:
+            release.wait(timeout=10.0)
+        return [0.0 for _ in items]
+
+    sup = ServingSupervisor(degraded_cooldown_s=30.0)
+    q = BatchingQueue(wedged_scorer, max_batch=4, max_delay_ms=1,
+                      default_deadline_s=0.2, hang_timeout_s=2.0,
+                      supervisor=sup, name="faultscore")
+    with pytest.raises(DeadlineExceeded):
+        await q.submit("wedge")
+    release.set()                       # unwedge the disowned call
+    await q.stop()
+
+
 @pytest.mark.asyncio
 async def test_chaos_rounds_with_random_faults():
     """Chaos drive: several fast rounds with a backend failing ~40% of

@@ -1,9 +1,17 @@
 import asyncio
+import threading
+import time
 
 import numpy as np
 import pytest
 
-from cassmantle_tpu.serving.queue import BatchingQueue, QueueFull
+from cassmantle_tpu.serving.queue import (
+    BatchingQueue,
+    DeadlineExceeded,
+    DispatchTimeout,
+    QueueFull,
+    QueueStopped,
+)
 
 
 @pytest.mark.asyncio
@@ -80,6 +88,145 @@ async def test_latency_bounded_by_delay_window():
     await q.submit("x")
     elapsed = loop.time() - t0
     assert elapsed < 1.0  # window + dispatch, far under a second
+    await q.stop()
+
+
+@pytest.mark.asyncio
+async def test_stop_fails_pending_futures():
+    """Shutdown with queued items must fail their futures, not leave the
+    awaiting callers hanging forever (ISSUE 2 satellite)."""
+    q = BatchingQueue(lambda items: items, max_batch=1, max_delay_ms=1,
+                      max_pending=8, name="stoptest")
+    # park items in the queue with no collector running
+    loop = asyncio.get_running_loop()
+    futs = [loop.create_future() for _ in range(3)]
+    for i, fut in enumerate(futs):
+        q._queue.put_nowait((i, fut))
+    await q.stop()
+    for fut in futs:
+        assert fut.done()
+        with pytest.raises(QueueStopped):
+            fut.result()
+    # QueueStopped degrades like backpressure at existing call sites
+    assert issubclass(QueueStopped, QueueFull)
+
+
+@pytest.mark.asyncio
+async def test_stop_mid_collect_window_fails_popped_items():
+    """stop() must also fail items the collector already popped off the
+    queue (waiting out the coalescing window) — they are invisible to
+    the queue drain and would otherwise dangle forever."""
+    q = BatchingQueue(lambda items: items, max_batch=64,
+                      max_delay_ms=10_000, name="midstop")
+    fut = asyncio.ensure_future(q.submit("x"))
+    await asyncio.sleep(0.05)       # collector popped "x", awaits window
+    await q.stop()
+    with pytest.raises(QueueStopped):
+        await fut
+
+
+@pytest.mark.asyncio
+async def test_watchdog_ignores_queue_wait_behind_other_dispatch():
+    """Time queued on the shared dispatch thread behind ANOTHER queue's
+    legitimate slow handler must not count toward this queue's hang
+    deadline — only a handler actually running can be declared wedged."""
+    slow_started = threading.Event()
+
+    def slow_but_legit(items):
+        slow_started.set()
+        time.sleep(0.6)
+        return items
+
+    qa = BatchingQueue(slow_but_legit, max_delay_ms=1, name="slowq")
+    qb = BatchingQueue(lambda items: items, max_delay_ms=1,
+                      hang_timeout_s=0.2, name="fastq")
+    ta = asyncio.ensure_future(qa.submit("a"))
+    await asyncio.to_thread(slow_started.wait, 2.0)   # slowq occupies it
+    # qb's batch waits ~0.6s queued (> its 0.2s hang deadline) and must
+    # still succeed rather than raise DispatchTimeout
+    assert await qb.submit("b") == "b"
+    assert await ta == "a"
+    await qa.stop()
+    await qb.stop()
+
+
+@pytest.mark.asyncio
+async def test_submit_deadline_fails_future_under_hung_handler():
+    """A wedged handler (hung XLA call) must not hang submitters: the
+    per-request deadline fails the future on time (acceptance criterion:
+    'fails pending submit futures at their deadline instead of hanging
+    the test')."""
+    release = threading.Event()
+
+    def hung_handler(items):
+        release.wait(timeout=10.0)
+        return items
+
+    q = BatchingQueue(hung_handler, max_batch=4, max_delay_ms=1,
+                      hang_timeout_s=2.0, name="hungtest")
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        await q.submit("x", deadline_s=0.2)
+    assert time.monotonic() - t0 < 1.5
+    release.set()          # unwedge the dispatch thread for later tests
+    await q.stop()
+
+
+@pytest.mark.asyncio
+async def test_watchdog_replaces_wedged_dispatch_thread():
+    """The hang watchdog fails the wedged batch with DispatchTimeout,
+    flips the supervisor degraded, and later batches dispatch on a FRESH
+    thread — the wedge doesn't serialize the rest of serving behind it."""
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    release = threading.Event()
+    calls = []
+
+    def handler(items):
+        calls.append(list(items))
+        if items == ["wedge"]:
+            release.wait(timeout=10.0)
+        return items
+
+    sup = ServingSupervisor(degraded_cooldown_s=30.0)
+    q = BatchingQueue(handler, max_batch=1, max_delay_ms=1,
+                      hang_timeout_s=0.3, supervisor=sup, name="wdtest")
+    assert not sup.watchdog_degraded
+    with pytest.raises(DispatchTimeout):
+        await q.submit("wedge")
+    assert sup.watchdog_degraded
+    # the replacement thread serves the next batch while the old one is
+    # still wedged
+    assert await q.submit("after") == "after"
+    release.set()
+    await q.stop()
+
+
+@pytest.mark.asyncio
+async def test_degraded_supervisor_tightens_admission():
+    """While degraded, the queue admits only degraded_max_pending items
+    (shed early: deep backlogs behind a sick device are doomed work)."""
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    sup = ServingSupervisor(degraded_cooldown_s=60.0)
+    q = BatchingQueue(lambda items: items, max_pending=64,
+                      degraded_max_pending=2, supervisor=sup,
+                      name="degradetest")
+    q.start()
+    await q.stop()
+    q._task = object()      # park the collector so items pile up
+    loop = asyncio.get_running_loop()
+    q._queue.put_nowait((0, loop.create_future()))
+    q._queue.put_nowait((1, loop.create_future()))
+    # healthy: plenty of room under max_pending
+    fut = asyncio.ensure_future(q.submit(2))
+    await asyncio.sleep(0)
+    assert not fut.done()
+    sup.note_dispatch_overrun("degradetest")
+    with pytest.raises(QueueFull):
+        await q.submit(3)
+    fut.cancel()
+    q._task = None
     await q.stop()
 
 

@@ -199,9 +199,101 @@ async def test_healthz_endpoint():
         res = await client.get("/healthz")
         data = await res.json()
         assert res.status == 200
-        assert data == {"ok": True, "store": True, "device": True}
+        assert data["ok"] is True and data["store"] is True \
+            and data["device"] is True
+        # the supervisor block rides along for operators (ISSUE 2)
+        sup = data["supervisor"]
+        assert sup["state"] == "ok"
+        assert set(sup["breakers"]) == {"content", "score"}
     finally:
         await client.close()
+
+
+@pytest.mark.asyncio
+async def test_readyz_ok_then_degraded_then_recovered():
+    """/readyz is the supervisor verdict: 200 while healthy; 503 +
+    Retry-After with breaker detail while the content breaker is open;
+    200 again once the breaker closes (recovery)."""
+    client, game = await make_client(make_cfg())
+    try:
+        res = await client.get("/readyz")
+        data = await res.json()
+        assert res.status == 200
+        assert data["ready"] is True and data["store"] is True
+
+        breaker = game.supervisor.content_breaker
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        res = await client.get("/readyz")
+        data = await res.json()
+        assert res.status == 503
+        assert data["ready"] is False and data["state"] == "degraded"
+        assert data["breakers"]["content"]["state"] == "open"
+        assert int(res.headers["Retry-After"]) >= 1
+
+        breaker.record_success()
+        res = await client.get("/readyz")
+        assert res.status == 200
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_compute_score_sheds_when_score_breaker_open():
+    """An open score breaker sheds /compute_score with 503 + Retry-After
+    (honest degradation) instead of floor scores that read as 'every
+    guess is wrong'."""
+    client, game = await make_client(make_cfg())
+    try:
+        await client.get("/init")
+        breaker = game.supervisor.score_breaker
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        res = await client.post("/compute_score",
+                                json={"inputs": {"0": "word"}})
+        assert res.status == 503
+        assert int(res.headers["Retry-After"]) >= 1
+        breaker.record_success()
+        res = await client.post("/compute_score",
+                                json={"inputs": {"0": "word"}})
+        assert res.status == 200
+    finally:
+        await client.close()
+
+
+def test_rate_limiter_eviction_preserves_active_buckets():
+    """Overflow eviction is targeted: a busy client's half-spent bucket
+    survives a table overflow — the old clear() reset EVERY bucket and
+    admitted a synchronized burst (ISSUE 2 satellite)."""
+    from cassmantle_tpu.server.ratelimit import RateLimiter
+
+    limiter = RateLimiter(max_entries=100, stale_s=1000.0)
+    # the active client spends its whole burst at rate 1 -> next call
+    # would be denied unless its bucket gets (wrongly) reset
+    assert limiter.allow("active-ip", "/api", rate=1.0)
+    assert not limiter.allow("active-ip", "/api", rate=1.0)
+    for i in range(200):                      # force repeated overflow
+        limiter.allow(f"ip-{i}", "/api", rate=1.0)
+        # the active client keeps hitting, so it is never the idle tail
+        limiter.allow("active-ip", "/api", rate=1.0)
+    assert len(limiter._buckets) <= 101       # capped, not unbounded
+    # the active client's spent bucket must NOT have been flushed back
+    # to a full burst by eviction
+    assert not limiter.allow("active-ip", "/api", rate=1.0)
+
+
+def test_rate_limiter_evicts_stale_first():
+    import time as _time
+
+    from cassmantle_tpu.server.ratelimit import RateLimiter
+
+    limiter = RateLimiter(max_entries=10, stale_s=0.01)
+    for i in range(10):
+        limiter.allow(f"old-{i}", "/", rate=1.0)
+    _time.sleep(0.02)                         # all 10 go stale
+    limiter.allow("fresh", "/", rate=1.0)     # overflow -> stale purge
+    assert ("fresh", "/") in limiter._buckets
+    assert all(not k[0].startswith("old-") for k in limiter._buckets)
 
 
 def test_device_health_probe():

@@ -1,0 +1,226 @@
+"""Supervision subsystem units (ISSUE 2): circuit breaker state machine,
+round reserve rotation, and the ServingSupervisor fusion — all on
+injectable clocks, no sleeps."""
+
+import pytest
+
+from cassmantle_tpu.engine.reserve import RoundReserve
+from cassmantle_tpu.engine.store import MemoryStore
+from cassmantle_tpu.serving.supervisor import ServingSupervisor
+from cassmantle_tpu.utils.circuit import CircuitBreaker, CircuitOpen
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def make_breaker(clock, threshold=3, window=10.0, reset=5.0):
+    return CircuitBreaker("test", failure_threshold=threshold,
+                          window_s=window, reset_timeout_s=reset,
+                          clock=clock)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_trips_after_threshold_in_window():
+    clock = FakeClock()
+    b = make_breaker(clock)
+    assert b.state == "closed"
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+
+
+def test_breaker_window_slides():
+    """Failures older than the window age out: 2 old + 2 fresh never
+    reaches the 3-in-window threshold."""
+    clock = FakeClock()
+    b = make_breaker(clock, threshold=3, window=10.0)
+    b.record_failure()
+    b.record_failure()
+    clock.advance(11.0)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+
+
+def test_breaker_half_open_probe_and_recovery():
+    clock = FakeClock()
+    b = make_breaker(clock, reset=5.0)
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    clock.advance(5.0)
+    assert b.state == "half_open"
+    # exactly one probe admitted while its verdict is pending
+    assert b.allow()
+    assert not b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    assert b.allow()
+
+
+def test_breaker_half_open_failure_reopens_with_fresh_cooldown():
+    clock = FakeClock()
+    b = make_breaker(clock, reset=5.0)
+    for _ in range(3):
+        b.record_failure()
+    clock.advance(5.0)
+    assert b.allow()            # half-open probe
+    b.record_failure()
+    assert b.state == "open"
+    assert b.seconds_until_half_open() == pytest.approx(5.0)
+    clock.advance(4.0)
+    assert not b.allow()
+    clock.advance(1.0)
+    assert b.allow()
+
+
+def test_breaker_hung_probe_expires():
+    """A half-open probe that never reports (wedged device call) must not
+    wedge the breaker: after another cooldown a new probe is admitted."""
+    clock = FakeClock()
+    b = make_breaker(clock, reset=5.0)
+    for _ in range(3):
+        b.record_failure()
+    clock.advance(5.0)
+    assert b.allow()            # probe #1 dispatched, never reports
+    assert not b.allow()
+    clock.advance(5.0)
+    assert b.allow()            # probe slot recycled
+
+
+def test_breaker_snapshot_shape():
+    clock = FakeClock()
+    b = make_breaker(clock)
+    snap = b.snapshot()
+    assert snap["state"] == "closed"
+    assert snap["retry_after_s"] == 0.0
+    for _ in range(3):
+        b.record_failure()
+    snap = b.snapshot()
+    assert snap["state"] == "open"
+    assert snap["retry_after_s"] > 0.0
+
+
+# -- supervisor fusion -------------------------------------------------------
+
+def test_supervisor_degrades_on_open_breaker():
+    clock = FakeClock()
+    sup = ServingSupervisor(
+        content_breaker=make_breaker(clock), clock=clock)
+    assert not sup.degraded
+    for _ in range(3):
+        sup.content_breaker.record_failure()
+    assert sup.degraded
+    status = sup.status()
+    assert status["ready"] is False and status["state"] == "degraded"
+    assert status["breakers"]["test"]["state"] == "open"
+    sup.content_breaker.record_success()
+
+
+def test_supervisor_watchdog_overrun_degrades_then_expires():
+    clock = FakeClock()
+    sup = ServingSupervisor(degraded_cooldown_s=30.0, clock=clock)
+    assert not sup.watchdog_degraded
+    sup.note_dispatch_overrun("score")
+    assert sup.watchdog_degraded and sup.degraded
+    assert sup.retry_after_s() == pytest.approx(30.0)
+    status = sup.status()
+    assert status["watchdog"]["degraded"] and \
+        status["watchdog"]["overruns"] == 1
+    clock.advance(31.0)
+    assert not sup.watchdog_degraded and not sup.degraded
+    assert sup.status()["ready"] is True
+
+
+def test_supervisor_device_verdict_flips_ready():
+    clock = FakeClock()
+    sup = ServingSupervisor(clock=clock)
+    assert sup.status(device_ok=True)["ready"] is True
+    assert sup.status(device_ok=None)["ready"] is True   # nothing to probe
+    assert sup.status(device_ok=False)["ready"] is False
+
+
+def test_supervisor_shed_scores_only_when_open():
+    clock = FakeClock()
+    sup = ServingSupervisor(
+        score_breaker=make_breaker(clock, reset=5.0), clock=clock)
+    assert not sup.shed_scores()
+    for _ in range(3):
+        sup.score_breaker.record_failure()
+    assert sup.shed_scores()
+    assert sup.retry_after_s() >= 1.0
+    clock.advance(5.0)           # half-open: probe traffic flows again
+    assert not sup.shed_scores()
+
+
+# -- round reserve -----------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_reserve_rotates_least_recently_played():
+    store = MemoryStore()
+    reserve = RoundReserve(store, capacity=4)
+    for i in range(3):
+        await reserve.archive(f"text {i}", f'{{"round": {i}}}',
+                              f"jpeg{i}".encode())
+    assert await reserve.size() == 3
+    # round 2 is on screen: first pick must be the oldest-seen (round 0)
+    text, prompt, image = await reserve.pick(exclude=b'{"round": 2}')
+    assert prompt == b'{"round": 0}' and text == "text 0" and image == b"jpeg0"
+    # consecutive degraded promotions serve DIFFERENT rounds
+    _, prompt2, _ = await reserve.pick(exclude=prompt)
+    assert prompt2 == b'{"round": 1}'
+    _, prompt3, _ = await reserve.pick(exclude=prompt2)
+    assert prompt3 != prompt2
+    # the full rotation cycles rather than pinning one round
+    seen = {bytes(prompt), bytes(prompt2), bytes(prompt3)}
+    assert len(seen) == 3
+
+
+@pytest.mark.asyncio
+async def test_reserve_capacity_ring_overwrites_oldest():
+    store = MemoryStore()
+    reserve = RoundReserve(store, capacity=2)
+    for i in range(5):
+        await reserve.archive(f"text {i}", f"prompt {i}", b"j")
+    assert await reserve.size() == 2
+    picked = set()
+    for _ in range(2):
+        _, prompt, _ = await reserve.pick()
+        picked.add(bytes(prompt))
+    assert picked == {b"prompt 3", b"prompt 4"}
+
+
+@pytest.mark.asyncio
+async def test_reserve_skips_consecutive_duplicates():
+    store = MemoryStore()
+    reserve = RoundReserve(store, capacity=4)
+    await reserve.archive("same", "p1", b"j1")
+    await reserve.archive("same", "p1-replayed", b"j1")
+    assert await reserve.size() == 1
+
+
+@pytest.mark.asyncio
+async def test_reserve_empty_and_all_excluded():
+    store = MemoryStore()
+    reserve = RoundReserve(store, capacity=2)
+    assert await reserve.pick() is None
+    await reserve.archive("only", "p", b"j")
+    assert await reserve.pick(exclude=b"p") is None
+    assert (await reserve.pick())[1] == b"p"
+
+
+def test_circuit_open_is_an_exception():
+    with pytest.raises(CircuitOpen):
+        raise CircuitOpen("content")

@@ -57,3 +57,58 @@ def test_base64():
     img = np.zeros((8, 8, 3), dtype=np.uint8)
     s = image_to_base64(img)
     assert isinstance(s, str) and len(s) > 0
+
+
+def test_breaker_and_watchdog_metrics_names():
+    """The supervision subsystem's counters/gauges land in the process
+    metrics registry under stable names — what DEPLOY.md's degraded-mode
+    runbook tells operators to alert on."""
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+    from cassmantle_tpu.utils.circuit import CircuitBreaker
+    from cassmantle_tpu.utils.logging import metrics
+
+    b = CircuitBreaker("mtest", failure_threshold=1, reset_timeout_s=0.0)
+    b.record_failure()          # closed -> open
+    assert b.state == "half_open"   # reset_timeout 0: immediate probe
+    assert b.allow()
+    b.record_success()          # half_open -> closed
+    b.allow()
+    snap = metrics.snapshot()
+    counters, gauges = snap["counters"], snap["gauges"]
+    assert counters["circuit.mtest.failures"] >= 1
+    assert counters["circuit.mtest.opened"] >= 1
+    assert counters["circuit.mtest.half_open"] >= 1
+    assert counters["circuit.mtest.closed"] >= 1
+    assert "circuit.mtest.state" in gauges
+
+    sup = ServingSupervisor(degraded_cooldown_s=0.0)
+    sup.note_dispatch_overrun("mtest-queue")
+    sup.status()
+    snap = metrics.snapshot()
+    assert snap["counters"]["supervisor.dispatch_overruns"] >= 1
+    assert "supervisor.degraded" in snap["gauges"]
+
+
+def test_retry_give_up_on_aborts_immediately():
+    """retry_async(give_up_on=...) re-raises without further attempts —
+    the breaker fast-fail contract (utils/circuit.py)."""
+    import asyncio
+
+    from cassmantle_tpu.utils.retry import retry_async
+
+    calls = []
+
+    class Abort(Exception):
+        pass
+
+    async def op():
+        calls.append(1)
+        raise Abort()
+
+    async def run():
+        with np.testing.assert_raises(Abort):
+            await retry_async(op, max_retries=5, give_up_on=(Abort,),
+                              backoff=lambda a: 0.0)
+
+    asyncio.run(run())
+    assert len(calls) == 1
