@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+from cassmantle_tpu.utils.logging import DEFAULT_BUCKETS_S as _DEFAULT_BUCKETS_S
+
 
 @dataclasses.dataclass(frozen=True)
 class ClipTextConfig:
@@ -284,6 +286,29 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (cassmantle_tpu/obs/, utils/logging.py).
+
+    Applied to the process-global tracer / flight recorder / metrics
+    registry by ``obs.configure_observability`` at server build."""
+
+    # Head-based trace sampling: fraction of root spans recorded. IDs
+    # still propagate (X-Trace-Id stays useful for log correlation)
+    # when a trace is unsampled.
+    trace_sample_rate: float = 1.0
+    # Bounded per-trace span sink: how many traces stay queryable at
+    # /debugz?trace=... (LRU eviction), and the per-trace span cap.
+    trace_capacity: int = 256
+    trace_max_spans: int = 512
+    # Flight-recorder ring: how many structured events /debugz replays.
+    recorder_capacity: int = 512
+    # Default latency-histogram bucket bounds (seconds, cumulative) —
+    # the single definition lives in utils/logging.py so series created
+    # before configure_observability runs get the SAME ladder.
+    latency_buckets_s: Tuple[float, ...] = _DEFAULT_BUCKETS_S
+
+
+@dataclasses.dataclass(frozen=True)
 class GameConfig:
     """Round/game constants (reference values cited in SURVEY.md §2/§5.6)."""
 
@@ -339,6 +364,7 @@ class FrameworkConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     game: GameConfig = dataclasses.field(default_factory=GameConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     quality: QualityGateConfig = dataclasses.field(
         default_factory=QualityGateConfig)
     seed: int = 0

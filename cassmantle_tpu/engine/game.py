@@ -23,6 +23,7 @@ from cassmantle_tpu.engine.rounds import ContentBackend, RoundManager
 from cassmantle_tpu.engine.scoring import GuessScorer, SimilarityFn, score_to_blur
 from cassmantle_tpu.engine.sessions import SessionManager
 from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.obs.trace import tracer
 from cassmantle_tpu.serving.supervisor import ServingSupervisor
 from cassmantle_tpu.utils.logging import metrics
 from cassmantle_tpu.utils.text import format_clock
@@ -144,8 +145,9 @@ class Game:
 
         def render() -> np.ndarray:
             # same off-loop rule as _render_bucket: blur is CPU/device
-            # work that must not stall the event loop
-            with metrics.timer("game.blur_s"):
+            # work that must not stall the event loop (to_thread copies
+            # contextvars, so the span lands in the request trace)
+            with tracer.span("game.blur"), metrics.timer("game.blur_s"):
                 return self.blur_fn(image, radius)
 
         return await asyncio.to_thread(render)
@@ -221,7 +223,7 @@ class Game:
             # JPEG codecs release the GIL; the TPU blur op just blocks
             # this worker thread on device dispatch)
             image = decode_jpeg(raw)
-            with metrics.timer("game.blur_s"):
+            with tracer.span("game.blur"), metrics.timer("game.blur_s"):
                 blurred = self.blur_fn(image, bucket)
             return image_to_base64(np.asarray(blurred))
 
@@ -284,7 +286,8 @@ class Game:
             }
         if not pairs:
             return {"won": 0}
-        with metrics.timer("game.score_s"):
+        with tracer.span("game.score", attrs={"pairs": len(pairs)}), \
+                metrics.timer("game.score_s"):
             scores = await self.scorer.score_pairs(pairs)
         result = await self.sessions.set_scores(session, scores)
         await self.sessions.increment_attempt(session)

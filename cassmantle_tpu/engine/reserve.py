@@ -29,6 +29,7 @@ import pickle
 from typing import Optional, Tuple
 
 from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("reserve")
@@ -84,6 +85,7 @@ class RoundReserve:
         await self.store.hset(META_KEY, f"played:{slot}", stamp)
         metrics.inc("reserve.archived")
         metrics.gauge("reserve.size", await self.size())
+        flight_recorder.record("reserve.archived", slot=slot)
 
     async def size(self) -> int:
         return len(await self.store.hgetall(ROUNDS_KEY))
@@ -119,5 +121,6 @@ class RoundReserve:
             stamp = await self.store.hincrby(META_KEY, "plays", 1)
             await self.store.hset(META_KEY, f"played:{slot}", stamp)
             metrics.inc("reserve.picks")
+            flight_recorder.record("reserve.picked", slot=slot)
             return text, prompt_bytes, image
         return None

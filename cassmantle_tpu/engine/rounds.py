@@ -40,6 +40,8 @@ import numpy as np
 from cassmantle_tpu.engine.masking import EmbedFn, build_prompt_state
 from cassmantle_tpu.engine.reserve import RoundReserve
 from cassmantle_tpu.engine.store import LockTimeout, StateStore
+from cassmantle_tpu.obs.recorder import flight_recorder
+from cassmantle_tpu.obs.trace import tracer
 from cassmantle_tpu.utils.circuit import CircuitBreaker, CircuitOpen
 from cassmantle_tpu.utils.codec import decode_jpeg, encode_jpeg
 from cassmantle_tpu.utils.logging import get_logger, metrics
@@ -145,7 +147,13 @@ class RoundManager:
         if self.breaker is not None and not self.breaker.allow():
             raise CircuitOpen(self.breaker.name)
         try:
-            content = await self.backend.generate(seed, is_seed)
+            # a ROOT trace per generation attempt: round generation is
+            # background work with no HTTP request to inherit from, and
+            # the pipeline's stage spans (prompt decode, t2i) need an
+            # ambient trace to land in
+            with tracer.span("round.generate", root=True,
+                             attrs={"is_seed": is_seed}):
+                content = await self.backend.generate(seed, is_seed)
         except Exception:
             if self.breaker is not None:
                 self.breaker.record_failure()
@@ -278,9 +286,11 @@ class RoundManager:
                 log.info("content buffering complete")
         except LockTimeout:
             log.info("buffer lock held elsewhere; skipping")
-        except Exception:
+        except Exception as exc:
             log.exception("buffering failed; old round will replay")
             metrics.inc("rounds.buffer_failures")
+            flight_recorder.record("round.buffer_failed",
+                                   error=type(exc).__name__)
 
     async def promote_buffer(self) -> None:
         """Swap next→current if a buffer exists (backend.py:204-238)."""
@@ -299,6 +309,7 @@ class RoundManager:
                         return
                     log.warning("no buffered content; replaying round")
                     metrics.inc("rounds.replays")
+                    flight_recorder.record("round.replayed")
                     return
                 prompt_prev = await self.store.hget(PROMPT_KEY, "current")
                 image_prev = await self.store.hget(IMAGE_KEY, "current")
@@ -329,6 +340,7 @@ class RoundManager:
                     await self.store.hdel(STORY_KEY, "next")
                 await self.store.hincrby(STORY_KEY, "episode", 1)
                 metrics.inc("rounds.promoted")
+                flight_recorder.record("round.promoted")
                 log.info("buffer promotion complete")
         except LockTimeout:
             log.info("promotion lock held elsewhere; skipping")
@@ -367,6 +379,7 @@ class RoundManager:
         # heals, the next episode continues from what players last saw
         await self.store.hset(PROMPT_KEY, "seed", text)
         metrics.inc("rounds.reserve_promotions")
+        flight_recorder.record("round.reserve_promotion")
         log.warning("generation dark; promoted reserve round "
                     "(fresh-content degraded mode)")
         return True

@@ -1,0 +1,43 @@
+"""Observability subsystem: request tracing, flight recorder, wiring.
+
+Three pieces, one package (ISSUE 3):
+
+- :mod:`cassmantle_tpu.obs.trace` — contextvar-propagated per-request
+  trace/span IDs with a bounded in-process span sink. The HTTP layer
+  opens a root span per request (returned as ``X-Trace-Id``), the
+  batching queue splits queue-wait from batch-service per member, and
+  device stages record synchronized spans via
+  ``utils.profiling.block_timer``.
+- :mod:`cassmantle_tpu.obs.recorder` — a bounded ring of structured
+  events (breaker transitions, watchdog fires, deadline expiries,
+  reserve rotations, round promotions) surfaced at ``/debugz`` and
+  embedded in a degraded ``/readyz`` verdict.
+- The metrics registry itself stays in :mod:`cassmantle_tpu.utils.logging`
+  (histograms + Prometheus exposition) so the low-level layers keep
+  their one import; this package depends on utils, never the reverse.
+
+``configure_observability(cfg.obs)`` applies the config knobs to the
+process-global instances; server startup calls it (server/app.py).
+"""
+
+from __future__ import annotations
+
+from cassmantle_tpu.obs.recorder import flight_recorder
+from cassmantle_tpu.obs.trace import tracer
+
+__all__ = ["tracer", "flight_recorder", "configure_observability"]
+
+
+def configure_observability(obs_cfg) -> None:
+    """Apply an ``ObsConfig`` to the process-global tracer, flight
+    recorder, and metrics histogram defaults. Idempotent; existing
+    recorded data is kept (capacity shrink drops oldest entries)."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    tracer.configure(
+        capacity=obs_cfg.trace_capacity,
+        sample_rate=obs_cfg.trace_sample_rate,
+        max_spans_per_trace=obs_cfg.trace_max_spans,
+    )
+    flight_recorder.set_capacity(obs_cfg.recorder_capacity)
+    metrics.set_default_buckets(obs_cfg.latency_buckets_s)

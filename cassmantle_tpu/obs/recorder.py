@@ -1,0 +1,93 @@
+"""Serving flight recorder: a bounded ring of structured events.
+
+Counters say *how often* the supervision machinery fired; they cannot
+say *in what order* — and "what happened in the 30 s before the breaker
+tripped" is exactly the question a degraded `/readyz` page raises. The
+flight recorder keeps the last N structured events in process memory:
+
+- breaker transitions (``utils/circuit.py`` — kind ``breaker``),
+- dispatch watchdog fires and deadline expiries (``serving/queue.py`` —
+  kinds ``queue.dispatch_hang`` / ``queue.deadline_expired``),
+- supervisor overrun holds (``serving/supervisor.py`` —
+  ``supervisor.overrun``),
+- round promotions / replays / reserve rotations
+  (``engine/rounds.py`` — ``round.*``) and reserve archive/pick traffic
+  (``engine/reserve.py`` — ``reserve.*``).
+
+Every event carries a monotonic sequence number and a wall timestamp,
+so `/debugz` replays the causal story (trip -> reserve rotation ->
+recovery) in order, and a degraded supervisor verdict embeds its recent
+tail. Thread-safe; ``record`` is a deque append under a lock — cheap
+enough for every transition path that emits one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from cassmantle_tpu.utils.logging import metrics
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 512) -> None:
+        assert capacity > 0, "recorder capacity must be positive"
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._dropped = 0
+
+    def set_capacity(self, capacity: int) -> None:
+        """Resize in place, keeping the newest events on shrink."""
+        capacity = max(1, int(capacity))
+        with self._lock:
+            if capacity == self._events.maxlen:
+                return
+            kept = list(self._events)[-capacity:]
+            self._dropped += len(self._events) - len(kept)
+            self._events = deque(kept, maxlen=capacity)
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event. ``fields`` must be JSON-serializable —
+        these bytes go straight out on `/debugz` and `/readyz`."""
+        with self._lock:
+            self._seq += 1
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append({
+                "seq": self._seq,
+                "ts": time.time(),
+                "kind": kind,
+                **fields,
+            })
+        metrics.inc("obs.events")
+
+    def tail(self, n: Optional[int] = None,
+             kind: Optional[str] = None) -> List[dict]:
+        """The newest events, oldest-first (replay order). ``kind``
+        filters by exact kind or a ``prefix.`` (trailing dot)."""
+        with self._lock:
+            events = list(self._events)
+        if kind is not None:
+            if kind.endswith("."):
+                events = [e for e in events if e["kind"].startswith(kind)]
+            else:
+                events = [e for e in events if e["kind"] == kind]
+        if n is not None:
+            n = int(n)
+            events = events[-n:] if n > 0 else []
+        return events
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "events": len(self._events),
+                "capacity": self._events.maxlen or 0,
+                "total_recorded": self._seq,
+                "dropped": self._dropped,
+            }
+
+
+flight_recorder = FlightRecorder()

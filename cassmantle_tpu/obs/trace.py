@@ -1,0 +1,271 @@
+"""Request-scoped tracing: contextvar propagation + bounded span sink.
+
+The serving stack's latency question — "where did this one slow request
+spend its time" — needs per-request attribution, not aggregate
+percentiles (the SwiftDiffusion/LegoDiffusion per-stage argument,
+PAPERS.md). This module is the minimal native tracer that answers it:
+
+- every HTTP request gets a **trace ID** (returned as ``X-Trace-Id``)
+  and a root span (server/app.py middleware);
+- the ambient span context rides a :mod:`contextvars` variable, so it
+  survives ``await`` chains for free and crosses executor/dispatch
+  threads explicitly (``run_with_ctx`` / ``contextvars.copy_context``);
+- the batching queue records per-member **queue-wait** and
+  **batch-service** spans and links the shared batch span
+  (serving/queue.py);
+- device stages record **device-synchronized** spans through
+  ``utils.profiling.block_timer`` (the timing blocks on the stage's
+  result arrays, so spans measure device work, not dispatch).
+
+Finished spans land in a bounded per-trace ring (LRU eviction at
+``capacity`` traces) queryable at ``/debugz?trace=<id>``. Sampling is
+head-based: the root span draws once against ``sample_rate``; an
+unsampled trace still propagates IDs (the header stays useful for log
+correlation) but records nothing.
+
+Each root context also carries a small mutable ``marks`` dict shared by
+the whole request: the queue writes ``queue_wait_s`` / ``service_s``
+into it so the HTTP layer can return ``X-Queue-Wait`` /
+``X-Service-Time`` headers without re-walking the trace.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from cassmantle_tpu.utils.logging import metrics
+
+
+class SpanContext:
+    """Immutable-by-convention propagation record: who the ambient span
+    is. ``marks`` is the one deliberately shared mutable field — the
+    per-request blackboard (see module docstring)."""
+
+    __slots__ = ("trace_id", "span_id", "sampled", "marks")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool,
+                 marks: Optional[dict] = None) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+        self.marks = marks if marks is not None else {}
+
+
+_current: contextvars.ContextVar[Optional[SpanContext]] = \
+    contextvars.ContextVar("cassmantle_span", default=None)
+
+
+def current_ctx() -> Optional[SpanContext]:
+    return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _current.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+def current_marks() -> Optional[dict]:
+    ctx = _current.get()
+    return ctx.marks if ctx is not None else None
+
+
+def run_with_ctx(ctx: Optional[SpanContext], fn, *args):
+    """Run ``fn(*args)`` with ``ctx`` as the ambient span — the explicit
+    cross-thread hop (dispatch thread, executors): contextvars don't
+    follow plain threads."""
+    token = _current.set(ctx)
+    try:
+        return fn(*args)
+    finally:
+        _current.reset(token)
+
+
+def _new_id(nbytes: int) -> str:
+    return uuid.uuid4().hex[: 2 * nbytes]
+
+
+class _SpanHandle:
+    """What ``tracer.span`` yields: the live ids plus mutable attrs."""
+
+    __slots__ = ("ctx", "attrs")
+
+    def __init__(self, ctx: SpanContext, attrs: dict) -> None:
+        self.ctx = ctx
+        self.attrs = attrs
+
+    @property
+    def trace_id(self) -> str:
+        return self.ctx.trace_id
+
+    @property
+    def span_id(self) -> str:
+        return self.ctx.span_id
+
+
+class Tracer:
+    """Span factory + bounded per-trace sink. One global per process
+    (``tracer``); instantiable standalone for tests."""
+
+    def __init__(self, capacity: int = 256, sample_rate: float = 1.0,
+                 max_spans_per_trace: int = 512,
+                 rng: Optional[random.Random] = None) -> None:
+        self._lock = threading.Lock()
+        # trace_id -> list of finished span dicts, LRU-ordered (a new
+        # span refreshes its trace's position, so long-running traces
+        # survive bursts of short ones); eviction drops a whole trace
+        self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
+        # ids of evicted traces (bounded memory): a late span from an
+        # evicted trace must be DROPPED, not resurrect a torn partial
+        # trace that /debugz would serve with no hint its head is gone
+        self._evicted: "OrderedDict[str, None]" = OrderedDict()
+        self.capacity = capacity
+        self.sample_rate = sample_rate
+        self.max_spans_per_trace = max_spans_per_trace
+        self._rng = rng or random.Random()
+
+    def configure(self, *, capacity: Optional[int] = None,
+                  sample_rate: Optional[float] = None,
+                  max_spans_per_trace: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None:
+                self.capacity = max(1, int(capacity))
+                while len(self._traces) > self.capacity:
+                    evicted_id, _ = self._traces.popitem(last=False)
+                    self._remember_evicted(evicted_id)
+            if sample_rate is not None:
+                self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+            if max_spans_per_trace is not None:
+                self.max_spans_per_trace = max(1, int(max_spans_per_trace))
+
+    # -- context derivation ----------------------------------------------
+    def new_root_ctx(self) -> SpanContext:
+        """Fresh trace; the head-based sampling decision happens here."""
+        sampled = (self.sample_rate >= 1.0
+                   or self._rng.random() < self.sample_rate)
+        return SpanContext(_new_id(16), _new_id(8), sampled, marks={})
+
+    def child_ctx(self, parent: Optional[SpanContext]) -> SpanContext:
+        """A child of ``parent`` (same trace, same marks blackboard);
+        a new root when there is no parent."""
+        if parent is None:
+            return self.new_root_ctx()
+        return SpanContext(parent.trace_id, _new_id(8), parent.sampled,
+                           marks=parent.marks)
+
+    def detached_ctx(self) -> SpanContext:
+        """An always-unsampled context: lets shared infrastructure (a
+        batch with no traced members) run span-producing code paths
+        without recording anything or minting ring-occupying traces."""
+        return SpanContext(_new_id(16), _new_id(8), False, marks={})
+
+    # -- recording --------------------------------------------------------
+    def record_span(self, name: str, ctx: SpanContext, *,
+                    parent_id: Optional[str] = None,
+                    start_wall: float, duration_s: float,
+                    status: str = "ok",
+                    attrs: Optional[dict] = None) -> None:
+        """Sink an already-timed span (the queue's wait/service spans are
+        measured outside any ``with`` block). No-op when unsampled."""
+        if not ctx.sampled:
+            return
+        span = {
+            "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id,
+            "parent_id": parent_id,
+            "name": name,
+            "start_ts": start_wall,
+            "duration_s": duration_s,
+            "status": status,
+        }
+        if attrs:
+            span["attrs"] = dict(attrs)
+        with self._lock:
+            spans = self._traces.get(ctx.trace_id)
+            if spans is None:
+                if ctx.trace_id in self._evicted:
+                    metrics.inc("obs.spans_dropped")
+                    return
+                while len(self._traces) >= self.capacity:
+                    evicted_id, _ = self._traces.popitem(last=False)
+                    self._remember_evicted(evicted_id)
+                    metrics.inc("obs.trace_evictions")
+                spans = []
+                self._traces[ctx.trace_id] = spans
+            else:
+                self._traces.move_to_end(ctx.trace_id)
+            if len(spans) >= self.max_spans_per_trace:
+                # cap hit: drop honestly — count it and mark the last
+                # resident span so /debugz shows the trace is truncated
+                metrics.inc("obs.spans_dropped")
+                spans[-1].setdefault("attrs", {})["truncated"] = True
+                return
+            spans.append(span)
+        metrics.inc("obs.spans")
+
+    def _remember_evicted(self, trace_id: str) -> None:
+        """Bounded (4x capacity) eviction memory; oldest ids age out —
+        by then their in-flight spans have long since finished."""
+        self._evicted[trace_id] = None
+        while len(self._evicted) > 4 * self.capacity:
+            self._evicted.popitem(last=False)
+
+    @contextmanager
+    def span(self, name: str, *, root: bool = False,
+             attrs: Optional[dict] = None):
+        """Open a span as the new ambient context, child of the ambient
+        parent. ``root=True`` forces a fresh trace. The body may mutate
+        ``handle.attrs``; exceptions mark status=error and propagate.
+        (Spans with an explicit non-ambient parent — the queue's batch
+        split — go through :meth:`record_span` directly.)"""
+        if root:
+            ctx = self.new_root_ctx()
+            parent_id = None
+        else:
+            pctx = _current.get()
+            ctx = self.child_ctx(pctx)
+            parent_id = pctx.span_id if pctx is not None else None
+        handle = _SpanHandle(ctx, dict(attrs) if attrs else {})
+        token = _current.set(ctx)
+        start_wall = time.time()
+        start = time.perf_counter()
+        status = "ok"
+        try:
+            yield handle
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            _current.reset(token)
+            self.record_span(
+                name, ctx, parent_id=parent_id, start_wall=start_wall,
+                duration_s=time.perf_counter() - start, status=status,
+                attrs=handle.attrs)
+
+    # -- query ------------------------------------------------------------
+    def get_trace(self, trace_id: str) -> Optional[List[dict]]:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            return [dict(s) for s in spans] if spans is not None else None
+
+    def trace_ids(self) -> List[str]:
+        """Oldest-first resident trace ids (the ``/debugz`` listing)."""
+        with self._lock:
+            return list(self._traces.keys())
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "traces": len(self._traces),
+                "capacity": self.capacity,
+                "sample_rate": self.sample_rate,
+            }
+
+
+tracer = Tracer()

@@ -28,6 +28,7 @@ from cassmantle_tpu.utils.compile_cache import (
     param_cache_path,
 )
 from cassmantle_tpu.utils.logging import metrics
+from cassmantle_tpu.utils.profiling import block_timer
 from cassmantle_tpu.utils.tokenizers import Tokenizer, load_tokenizer
 
 
@@ -94,9 +95,13 @@ class EmbeddingScorer:
         for start in range(0, n, batch):
             chunk = texts[start : start + batch]
             ids, mask = self._tokenize_batch(chunk, batch)
-            with metrics.timer("scorer.encode_s"):
+            # device-synchronized stage span: for a /compute_score
+            # request this is the trace's leaf — the MiniLM encode the
+            # whole guess batch waited on
+            with block_timer("scorer.encode_s") as sink:
                 emb = self._encode(self.params, jnp.asarray(ids),
                                    jnp.asarray(mask))
+                sink.append(emb)
             out_chunks.append(np.asarray(emb)[: len(chunk)])
         metrics.inc("scorer.texts", n)
         return np.concatenate(out_chunks, axis=0)

@@ -10,7 +10,12 @@ Route-for-route parity with the reference (SURVEY.md §1 L4, §3.3-3.5):
 - ``POST /compute_score``  {inputs: {mask_idx: guess}} -> scores
                             (main.py:113-120)
 - ``WS   /clock``          1 Hz {time, reset, conns} push (main.py:55-79)
-- ``GET  /metrics``        counters/timings (new; SURVEY.md §5.5)
+- ``GET  /metrics``        JSON snapshot by default; Prometheus text
+                           exposition under ``Accept: text/plain``
+                           (new; SURVEY.md §5.5, ISSUE 3)
+- ``GET  /debugz``         flight-recorder event ring + trace lookup
+                           (``?trace=<X-Trace-Id>``) — the serving
+                           black box (new; ISSUE 3)
 - ``GET  /healthz``        liveness: process + store + device (new)
 - ``GET  /readyz``         readiness: supervisor verdict — breakers,
                            dispatch watchdog, device health fused; 503 +
@@ -36,6 +41,8 @@ from aiohttp import WSMsgType, web
 
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.obs import configure_observability, flight_recorder, tracer
+from cassmantle_tpu.obs.trace import current_marks
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("app")
@@ -59,6 +66,12 @@ def _session_id(request: web.Request) -> Optional[str]:
     return request.cookies.get("session_id")
 
 
+def _is_loopback(request: web.Request) -> bool:
+    """Fail closed: an unresolvable peer (unix socket behind a proxy)
+    is NOT local — same rule as /debug/trace."""
+    return request.remote in ("127.0.0.1", "::1")
+
+
 @web.middleware
 async def cors_middleware(request: web.Request, handler):
     if request.method == "OPTIONS":
@@ -70,6 +83,52 @@ async def cors_middleware(request: web.Request, handler):
     response.headers["Access-Control-Allow-Methods"] = "GET, POST"
     response.headers["Access-Control-Allow-Headers"] = "*"
     return response
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    """One root span per request; the trace ID returns as ``X-Trace-Id``
+    (sampled traces are then queryable at ``/debugz?trace=<id>``).
+    Static asset mounts and the probe/scrape surfaces skip tracing —
+    a 1/s readiness probe plus a Prometheus scraper would otherwise
+    FIFO-flush the bounded trace ring of the player-request traces an
+    operator actually triages."""
+    # /clock also skips: its WS handshake is prepared before the
+    # middleware regains control (the header could never be returned)
+    # and app.js's 2 s reconnect loop would mint a ring-flushing trace
+    # per flap
+    if request.path.startswith(("/static", "/data", "/media")) or \
+            request.path in ("/healthz", "/readyz", "/metrics",
+                             "/debugz", "/debug/trace", "/clock"):
+        return await handler(request)
+    name = f"http.{request.method.lower()} {request.path}"
+    with tracer.span(name, root=True) as span:
+        try:
+            response = await handler(request)
+        except web.HTTPException as exc:
+            span.attrs["status"] = exc.status
+            exc.headers["X-Trace-Id"] = span.trace_id
+            raise
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # a handler bug: answer the 500 OURSELVES so the response
+            # still carries the trace id — the one trace an operator
+            # most wants to look up from a user report. The log line
+            # carries the same id (JSON formatter), replacing aiohttp's
+            # anonymous error log.
+            span.attrs["status"] = 500
+            log.exception("unhandled error serving %s %s",
+                          request.method, request.path)
+            return web.Response(
+                status=500, text="500 Internal Server Error",
+                headers={"X-Trace-Id": span.trace_id})
+        span.attrs["status"] = response.status
+        if not response.prepared:
+            # a prepared response (WS handshake already sent) can't
+            # take new headers
+            response.headers["X-Trace-Id"] = span.trace_id
+        return response
 
 
 def make_ratelimit_middleware(cfg: FrameworkConfig):
@@ -155,7 +214,16 @@ async def handle_compute_score(request: web.Request) -> web.Response:
         raise web.HTTPBadRequest(text="body must be {inputs: {idx: guess}}")
     with metrics.timer("http.compute_score_s"):
         scores = await game.compute_client_scores(session, inputs)
-    return web.json_response(scores)
+    response = web.json_response(scores)
+    # client-side latency attribution: how long this request's guess
+    # batch waited to coalesce vs how long the device batch it rode
+    # took (filled by BatchingQueue into the request's trace marks;
+    # absent on paths that never touched a queue, e.g. fake backends)
+    marks = current_marks()
+    if marks and "queue_wait_s" in marks:
+        response.headers["X-Queue-Wait"] = f"{marks['queue_wait_s']:.6f}"
+        response.headers["X-Service-Time"] = f"{marks['service_s']:.6f}"
+    return response
 
 
 async def handle_clock(request: web.Request) -> web.WebSocketResponse:
@@ -195,7 +263,51 @@ async def handle_clock(request: web.Request) -> web.WebSocketResponse:
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
+    """Content-negotiated: Prometheus text exposition when the client
+    asks for text/plain (a scraper's Accept header), the historical
+    JSON snapshot otherwise — existing dashboards keep their shape."""
+    accept = request.headers.get("Accept", "")
+    if "text/plain" in accept or "openmetrics" in accept:
+        return web.Response(
+            body=metrics.prometheus().encode(),
+            headers={"Content-Type":
+                     "text/plain; version=0.0.4; charset=utf-8"})
     return web.json_response(metrics.snapshot())
+
+
+async def handle_debugz(request: web.Request) -> web.Response:
+    """The serving black box: ``?trace=<id>`` returns one trace's spans
+    (the id a response's ``X-Trace-Id`` carried); otherwise the
+    flight-recorder tail — breaker transitions, watchdog fires,
+    deadline expiries, reserve rotations, round promotions — in causal
+    order (``?n=`` limits, ``?kind=`` filters by kind or ``prefix.``).
+
+    Loopback-only like ``/debug/trace``: an operator surface. Trace
+    spans carry other players' request timings and the event ring
+    exposes internal serving state — not a player-facing page."""
+    if not _is_loopback(request):
+        raise web.HTTPForbidden(text="loopback only")
+    trace_id = request.query.get("trace")
+    if trace_id:
+        spans = tracer.get_trace(trace_id)
+        if spans is None:
+            raise web.HTTPNotFound(
+                text=f"trace {trace_id!r} not resident (bounded ring "
+                     f"keeps {tracer.capacity} traces)")
+        spans.sort(key=lambda s: s["start_ts"])
+        return web.json_response({"trace_id": trace_id, "spans": spans})
+    try:
+        n = int(request.query.get("n", "200"))
+    except ValueError:
+        raise web.HTTPBadRequest(text="n must be an integer")
+    events = flight_recorder.tail(n, kind=request.query.get("kind"))
+    return web.json_response({
+        "events": events,
+        "recorder": flight_recorder.stats(),
+        "tracer": tracer.stats(),
+        # newest last; each id is fetchable via ?trace=
+        "recent_traces": tracer.trace_ids()[-25:],
+    })
 
 
 async def _probe_store(game: Game) -> bool:
@@ -222,7 +334,8 @@ async def handle_healthz(request: web.Request) -> web.Response:
             "ok": ok,
             "store": store_ok,
             "device": device_ok is not False,
-            "supervisor": game.supervisor.status(device_ok=device_ok),
+            "supervisor": game.supervisor.status(
+                device_ok=device_ok, include_events=_is_loopback(request)),
         },
         status=200 if ok else 503,
     )
@@ -237,7 +350,11 @@ async def handle_readyz(request: web.Request) -> web.Response:
     game = request.app[_GAME]
     store_ok, device_ok = await asyncio.gather(
         _probe_store(game), game.supervisor.probe_device())
-    status = game.supervisor.status(device_ok=device_ok)
+    # the embedded event tail is internal serving state: loopback
+    # operators only (the /debugz boundary) — remote probes/players get
+    # the verdict without the history
+    status = game.supervisor.status(
+        device_ok=device_ok, include_events=_is_loopback(request))
     status["store"] = store_ok
     ready = bool(status["ready"]) and store_ok
     status["ready"] = ready
@@ -261,9 +378,7 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
     optional ``name`` selects only a single sanitized subdirectory —
     a same-host reverse proxy forwarding this route cannot turn it into
     an arbitrary-filesystem-write primitive."""
-    # fail closed: an unresolvable peer (None — e.g. unix-socket behind a
-    # proxy) is NOT treated as local
-    if request.remote not in ("127.0.0.1", "::1"):
+    if not _is_loopback(request):
         raise web.HTTPForbidden(text="loopback only")
     try:
         seconds = min(60.0, float(request.query.get("seconds", "5")))
@@ -354,8 +469,13 @@ async def handle_wordlist(request: web.Request) -> web.Response:
 def create_app(game: Game, cfg: FrameworkConfig,
                start_timer: bool = True,
                device_health: bool = False) -> web.Application:
+    # apply the observability knobs before any route can record
+    # (tracer/recorder/metrics are process globals; idempotent)
+    configure_observability(cfg.obs)
+    # ratelimit OUTSIDE tracing: a client spamming to 429s must shed at
+    # the limiter without minting root traces (ring-flush vector)
     app = web.Application(middlewares=[
-        cors_middleware, make_ratelimit_middleware(cfg)
+        cors_middleware, make_ratelimit_middleware(cfg), tracing_middleware
     ])
     app[_GAME] = game
     # mutable holder created before the app starts: flipping a field at
@@ -375,6 +495,7 @@ def create_app(game: Game, cfg: FrameworkConfig,
     app.router.add_post("/compute_score", handle_compute_score)
     app.router.add_get("/clock", handle_clock)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/debugz", handle_debugz)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/wordlist", handle_wordlist)

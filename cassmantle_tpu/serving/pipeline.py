@@ -11,6 +11,7 @@ implements for tests (engine/content.py).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import os
 import random
 import threading
@@ -46,7 +47,7 @@ from cassmantle_tpu.ops.ddim import (
 from cassmantle_tpu.ops.samplers import make_sampler
 from cassmantle_tpu.ops.decode import greedy_decode
 from cassmantle_tpu.utils.logging import get_logger, metrics
-from cassmantle_tpu.utils.profiling import annotate
+from cassmantle_tpu.utils.profiling import annotate, block_timer
 from cassmantle_tpu.utils.tokenizers import load_tokenizer
 
 log = get_logger("pipeline")
@@ -372,7 +373,10 @@ class Text2ImagePipeline:
         uncond = jnp.asarray(self._tokenize(
             [self.cfg.sampler.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
-        with metrics.timer("pipeline.t2i_s"), self._dispatch_lock:
+        # block_timer = metric + device-synchronized trace span (the
+        # whole CLIP->denoise->VAE jit is ONE XLA computation; its
+        # internal stages stay visible as profiler TraceAnnotations)
+        with self._dispatch_lock, block_timer("pipeline.t2i_s"):
             images = self._sample(self._params, ids, uncond, rng)
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.images", n)
@@ -457,7 +461,7 @@ class Text2ImagePipeline:
         uncond = jnp.asarray(self._tokenize(
             [self.cfg.sampler.negative_prompt] * len(prompts)))
         params = dict(self._params, vae_enc=self.enc_params)
-        with metrics.timer("pipeline.i2i_s"), self._dispatch_lock:
+        with self._dispatch_lock, block_timer("pipeline.i2i_s"):
             out = self._i2i_fns[k](
                 params, ids, uncond, imgf, jax.random.PRNGKey(seed)
             )
@@ -716,9 +720,10 @@ class PromptGenerator:
         """Batched greedy continuation: one device dispatch for N texts,
         each trimmed to its first two sentences (reference
         backend.py:253-265)."""
-        with metrics.timer("pipeline.prompt_s"):
+        with block_timer("pipeline.prompt_s") as sink:
             out_tokens, gen_len = self.decode_ids_batch(
                 seed_texts, max_new_tokens)
+            sink.append(out_tokens)
         texts = []
         for i in range(len(seed_texts)):
             k = int(gen_len[i])
@@ -817,6 +822,10 @@ class TPUContentBackend(ContentBackend):
     async def generate(self, seed: str, is_seed: bool,
                        text: Optional[str] = None) -> RoundContent:
         loop = asyncio.get_running_loop()
+        # run_in_executor does not carry contextvars: copy the context
+        # so the round-generation trace follows onto the worker thread
+        # (the pipeline's block_timer stage spans land in it)
+        ctx = contextvars.copy_context()
         return await loop.run_in_executor(
-            None, self.generate_sync, seed, is_seed, text
+            None, ctx.run, self.generate_sync, seed, is_seed, text
         )

@@ -35,14 +35,21 @@ import asyncio
 import concurrent.futures
 import queue as _thread_queue
 import threading
+import time
 from typing import Callable, Generic, List, Optional, Sequence, TypeVar
 
+from cassmantle_tpu.obs.recorder import flight_recorder
+from cassmantle_tpu.obs.trace import current_ctx, run_with_ctx, tracer
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 T = TypeVar("T")
 R = TypeVar("R")
 
 log = get_logger("queue")
+
+# batch-size histogram bounds: the configured bucket ladder's shape
+# (powers of two through the largest score bucket)
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)
 
 
 class QueueFull(Exception):
@@ -213,6 +220,20 @@ class BatchingQueue(Generic[T, R]):
     def _expire(self, fut: asyncio.Future) -> None:
         if not fut.done():
             metrics.inc(f"{self.name}.deadline_expired")
+            flight_recorder.record("queue.deadline_expired",
+                                   queue=self.name)
+            # the wait histogram must include the waits that EXPIRED —
+            # they are the tail that matters during degradation; only
+            # counting survivors would report healthy p99s while users
+            # time out
+            t_submit = getattr(fut, "_obs_t", None)
+            if t_submit is not None:
+                metrics.observe(f"{self.name}.queue_wait_s",
+                                time.perf_counter() - t_submit)
+                # consumed: if the batch was already in flight when the
+                # deadline hit, _record_batch_obs must not observe this
+                # future a second time
+                fut._obs_t = None          # type: ignore[attr-defined]
             fut.set_exception(DeadlineExceeded(self.name))
 
     async def submit(self, item: T, *,
@@ -226,6 +247,11 @@ class BatchingQueue(Generic[T, R]):
             metrics.inc(f"{self.name}.rejected_degraded")
             raise QueueFull(f"{self.name} (degraded)")
         fut: asyncio.Future = loop.create_future()
+        # trace propagation rides the future, not the queue tuple: the
+        # (item, fut) shape is a stable seam (tests poke it directly),
+        # and a future without these attributes simply goes untraced
+        fut._obs_ctx = current_ctx()        # type: ignore[attr-defined]
+        fut._obs_t = time.perf_counter()    # type: ignore[attr-defined]
         try:
             self._queue.put_nowait((item, fut))
         except asyncio.QueueFull:
@@ -248,7 +274,8 @@ class BatchingQueue(Generic[T, R]):
         try:
             batch.append(await self._queue.get())
             loop = asyncio.get_running_loop()
-            deadline = loop.time() + self.max_delay_s
+            opened = loop.time()
+            deadline = opened + self.max_delay_s
             while len(batch) < self.max_batch:
                 timeout = deadline - loop.time()
                 if timeout <= 0:
@@ -259,6 +286,11 @@ class BatchingQueue(Generic[T, R]):
                     )
                 except asyncio.TimeoutError:
                     break
+            # how long the window actually held the first item before
+            # dispatch: ~0 under load (bucket fills instantly), ~the
+            # full max_delay under trickle traffic — the knob's cost
+            metrics.gauge(f"{self.name}.coalesce_wait_s",
+                          loop.time() - opened)
         except asyncio.CancelledError:
             for _, fut in batch:
                 if not fut.done():
@@ -278,7 +310,33 @@ class BatchingQueue(Generic[T, R]):
             futures = [fut for _, fut in batch]
             metrics.inc(f"{self.name}.batches")
             metrics.inc(f"{self.name}.items", len(items))
-            dispatch, started = _dispatcher.submit(self.handler, items)
+            metrics.observe(f"{self.name}.batch_size", len(items),
+                            buckets=BATCH_SIZE_BUCKETS)
+            # the batch span JOINS the first traced member's trace (a
+            # single-request batch — the interactive case — reads as one
+            # contiguous trace); every traced member additionally gets
+            # queue_wait/batch_service spans in its OWN trace, linked to
+            # the batch by id (_record_batch_obs)
+            ctxs = [c for c in (getattr(f, "_obs_ctx", None)
+                                for f in futures) if c is not None]
+            # prefer a SAMPLED member as the batch span's parent: joining
+            # an unsampled member's trace would silently drop the batch
+            # and device-stage spans for every sampled member behind it.
+            # No traced member at all -> a DETACHED (unsampled) ctx, so
+            # the batch records nothing rather than minting an orphan
+            # root trace per batch that would flush the ring
+            parent = next((c for c in ctxs if c.sampled),
+                          ctxs[0] if ctxs else None)
+            batch_ctx = (tracer.child_ctx(parent) if parent is not None
+                         else tracer.detached_ctx())
+            start_wall = time.time()
+            t_dispatch = time.perf_counter()
+            status = "ok"
+            # the handler runs on the dispatch thread under the batch
+            # span's context, so its block_timer stage spans land in the
+            # batch's trace (contextvars don't cross threads on their own)
+            dispatch, started = _dispatcher.submit(
+                run_with_ctx, batch_ctx, self.handler, items)
             wrapped = asyncio.wrap_future(dispatch)
             try:
                 with metrics.timer(f"{self.name}.batch_s"):
@@ -294,6 +352,7 @@ class BatchingQueue(Generic[T, R]):
             except asyncio.CancelledError:
                 # queue stopping mid-batch: the in-flight futures must
                 # fail, not dangle (their handler result is dropped)
+                status = "error"
                 self._disown(wrapped)
                 for fut in futures:
                     if not fut.done():
@@ -303,10 +362,15 @@ class BatchingQueue(Generic[T, R]):
                 # OUR handler is running and wedged (hung XLA call): fail
                 # the batch, flip the supervisor degraded, and hand
                 # future batches a fresh dispatch thread
+                status = "error"
                 log.error(
                     "%s handler exceeded %.1fs hang deadline; replacing "
                     "dispatch thread", self.name, self.hang_timeout_s)
                 metrics.inc(f"{self.name}.dispatch_hangs")
+                flight_recorder.record(
+                    "queue.dispatch_hang", queue=self.name,
+                    hang_timeout_s=self.hang_timeout_s,
+                    batch_size=len(items))
                 if self.supervisor is not None:
                     self.supervisor.note_dispatch_overrun(self.name)
                 _dispatcher.replace()
@@ -317,11 +381,60 @@ class BatchingQueue(Generic[T, R]):
                     if not fut.done():
                         fut.set_exception(exc)
             except Exception as exc:  # noqa: BLE001 — propagate per-item
+                status = "error"
                 log.exception("%s batch failed", self.name)
                 metrics.inc(f"{self.name}.failures")
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(exc)
+            finally:
+                self._record_batch_obs(
+                    batch_ctx, parent, futures, start_wall, t_dispatch,
+                    status)
+
+    def _record_batch_obs(self, batch_ctx, parent, futures,
+                          start_wall: float, t_dispatch: float,
+                          status: str) -> None:
+        """Sink the batch span plus, per traced member, the queue-wait /
+        batch-service split: wait is submit -> dispatch handoff, service
+        is handoff -> batch completion (shared by all members — the
+        device ran them as one computation). Also fills the request's
+        marks blackboard so the HTTP layer can answer with
+        ``X-Queue-Wait`` / ``X-Service-Time`` headers."""
+        service_s = time.perf_counter() - t_dispatch
+        tracer.record_span(
+            f"{self.name}.batch", batch_ctx,
+            parent_id=parent.span_id if parent is not None else None,
+            start_wall=start_wall, duration_s=service_s, status=status,
+            attrs={"queue": self.name, "batch_size": len(futures)})
+        for fut in futures:
+            t_submit = getattr(fut, "_obs_t", None)
+            if t_submit is None:
+                continue
+            wait_s = t_dispatch - t_submit
+            metrics.observe(f"{self.name}.queue_wait_s", wait_s)
+            ctx = getattr(fut, "_obs_ctx", None)
+            if ctx is None:
+                continue
+            # a request that rode several batches (gathered submits)
+            # reports its slowest leg — the one that bounded its latency
+            ctx.marks["queue_wait_s"] = max(
+                wait_s, ctx.marks.get("queue_wait_s", 0.0))
+            ctx.marks["service_s"] = max(
+                service_s, ctx.marks.get("service_s", 0.0))
+            if not ctx.sampled:
+                continue
+            link = {"queue": self.name,
+                    "batch_trace": batch_ctx.trace_id,
+                    "batch_span": batch_ctx.span_id}
+            tracer.record_span(
+                f"{self.name}.queue_wait", tracer.child_ctx(ctx),
+                parent_id=ctx.span_id, start_wall=start_wall - wait_s,
+                duration_s=wait_s, attrs=link)
+            tracer.record_span(
+                f"{self.name}.batch_service", tracer.child_ctx(ctx),
+                parent_id=ctx.span_id, start_wall=start_wall,
+                duration_s=service_s, status=status, attrs=link)
 
     async def _await_dispatch(self, wrapped: asyncio.Future,
                               started: "threading.Event"):

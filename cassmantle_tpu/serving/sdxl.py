@@ -51,7 +51,7 @@ from cassmantle_tpu.utils.compile_cache import (
     param_cache_path,
 )
 from cassmantle_tpu.utils.logging import get_logger, metrics
-from cassmantle_tpu.utils.profiling import annotate
+from cassmantle_tpu.utils.profiling import annotate, block_timer
 from cassmantle_tpu.utils.tokenizers import load_tokenizer
 
 log = get_logger("sdxl")
@@ -265,7 +265,8 @@ class SDXLPipeline:
         uncond = jnp.asarray(self._tokenize(
             [self.cfg.sampler.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
-        with metrics.timer("pipeline.sdxl_s"), self._dispatch_lock:
+        # metric + device-synchronized trace span in one
+        with self._dispatch_lock, block_timer("pipeline.sdxl_s"):
             images = self._sample(self._params, ids, uncond, rng)
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.sdxl_images", n)

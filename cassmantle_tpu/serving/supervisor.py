@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.utils.circuit import OPEN, CircuitBreaker
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
@@ -68,6 +69,8 @@ class ServingSupervisor:
                 self.clock() + self.degraded_cooldown_s,
             )
         metrics.inc("supervisor.dispatch_overruns")
+        flight_recorder.record("supervisor.overrun", queue=queue_name,
+                               cooldown_s=self.degraded_cooldown_s)
         log.error("dispatch overrun on %r: degraded for %.0fs",
                   queue_name, self.degraded_cooldown_s)
 
@@ -117,10 +120,15 @@ class ServingSupervisor:
             self.score_breaker.seconds_until_half_open(),
         )
 
-    def status(self, device_ok: Optional[bool] = None) -> Dict[str, object]:
+    def status(self, device_ok: Optional[bool] = None,
+               include_events: bool = False) -> Dict[str, object]:
         """The `/readyz` body. ``device_ok`` is the (executor-run)
         DeviceHealth verdict when the caller has one; None = no device to
-        probe (fake backend)."""
+        probe (fake backend). ``include_events`` embeds the flight-
+        recorder tail in a degraded verdict — the HTTP layer sets it
+        only for loopback callers (the same internal-state boundary
+        `/debugz` enforces; remote probes get the verdict, not the
+        event history)."""
         degraded = self.degraded
         ready = not degraded and device_ok is not False
         with self._lock:
@@ -131,7 +139,7 @@ class ServingSupervisor:
                     0.0, self._degraded_until - self.clock()),
             }
         metrics.gauge("supervisor.degraded", 0.0 if ready else 1.0)
-        return {
+        status: Dict[str, object] = {
             "ready": ready,
             "state": "ok" if ready else "degraded",
             "breakers": {
@@ -141,3 +149,9 @@ class ServingSupervisor:
             "watchdog": watchdog,
             "device": device_ok,
         }
+        if not ready and include_events:
+            # a degraded verdict carries the recent event history that
+            # explains it — the flight-recorder tail (trip order,
+            # watchdog fires, reserve rotations), not just end states
+            status["events"] = flight_recorder.tail(25)
+        return status

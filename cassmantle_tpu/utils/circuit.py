@@ -77,10 +77,19 @@ class CircuitBreaker:
     def _set_state(self, state: str) -> None:
         if state == self._state:
             return
-        self._state = state
+        prev, self._state = self._state, state
         event = {CLOSED: "closed", OPEN: "opened", HALF_OPEN: "half_open"}[state]
         metrics.inc(f"circuit.{self.name}.{event}")
         metrics.gauge(f"circuit.{self.name}.state", _STATE_GAUGE[state])
+        # the flight recorder keeps the ORDER of transitions — /debugz
+        # replays trip -> reserve rotation -> recovery causally. Lazy
+        # import: utils never depends on obs at module scope (the same
+        # rule logging/profiling follow)
+        from cassmantle_tpu.obs.recorder import flight_recorder
+
+        flight_recorder.record("breaker", name=self.name,
+                               state=state, prev=prev,
+                               recent_failures=len(self._failures))
         log.warning("breaker %r -> %s", self.name, state)
 
     def _tick(self, now: float) -> None:

@@ -1,89 +1,299 @@
-"""Structured logging + metrics counters.
+"""Structured logging + metrics registry (counters/gauges/histograms).
 
 The reference's only observability is print statements with [INFO]/[ERROR]
-prefixes (SURVEY.md §5.1/§5.5). Here: stdlib logging with a single namespaced
-logger tree, plus a tiny in-process metrics registry (counters/gauges/latency
-histograms) surfaced by the server's /metrics route — the north-star metric is
-images/sec/chip, so the serving path increments these at every stage.
+prefixes (SURVEY.md §5.1/§5.5). Here: stdlib logging with a single
+namespaced logger tree (opt-in JSON lines carrying the active trace ID via
+``CASSMANTLE_LOG_FORMAT=json``), plus an in-process metrics registry
+surfaced by the server's /metrics route — JSON snapshot by default,
+Prometheus text exposition under ``Accept: text/plain``.
+
+Timings are **fixed-bucket cumulative histograms**, not sample lists: the
+old keep-last-1024 trim silently turned p50/p99 into sliding-window stats
+(and indexed p99 off-by-one at small n); buckets make memory constant per
+series, percentiles all-time, and the exposition Prometheus-native
+(``_bucket{le=...}/_sum/_count``). The JSON snapshot keeps the historical
+``count/mean_s/p50_s/p99_s`` shape, with percentiles now interpolated
+from the cumulative bucket counts.
+
+Metric names are dotted lowercase (``subsystem.metric``), with dynamic
+segments (queue/breaker names) interpolated in the middle; timing
+histograms end ``_s`` (seconds) and size histograms ``_size``.
+``tools/check_metrics.py`` lints every literal emission site against this
+convention and the catalog in ``docs/OBSERVABILITY.md``.
 """
 
 from __future__ import annotations
 
+import bisect
+import json
 import logging
+import os
 import threading
 import time
-from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, List
+from typing import Dict, Optional, Sequence, Tuple
+
+# Latency-shaped default bounds: sub-ms host work through cold-compile
+# minutes. Overridable per-process via ObsConfig.latency_buckets_s
+# (set_default_buckets) and per-series via observe(buckets=...).
+DEFAULT_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+_LOGGER_LOCK = threading.Lock()
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line, carrying the active trace ID so a
+    request's log lines and its `/debugz` trace join on one key."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        try:
+            # lazy: utils.logging must stay importable before (and
+            # without) the obs package — never a module-level cycle
+            from cassmantle_tpu.obs.trace import current_trace_id
+
+            trace_id = current_trace_id()
+        except Exception:
+            trace_id = None
+        if trace_id:
+            payload["trace_id"] = trace_id
+        if record.exc_info:
+            payload["exc"] = self.formatException(record.exc_info)
+        return json.dumps(payload, ensure_ascii=False)
+
+
+def _make_formatter() -> logging.Formatter:
+    if os.environ.get("CASSMANTLE_LOG_FORMAT", "").lower() == "json":
+        return JsonLogFormatter()
+    return logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s %(message)s"
+    )
 
 
 def get_logger(name: str) -> logging.Logger:
     logger = logging.getLogger(f"cassmantle.{name}")
-    if not logging.getLogger("cassmantle").handlers:
-        handler = logging.StreamHandler()
-        handler.setFormatter(
-            logging.Formatter(
-                "%(asctime)s %(levelname)s %(name)s %(message)s"
-            )
-        )
-        root = logging.getLogger("cassmantle")
-        root.addHandler(handler)
-        root.setLevel(logging.INFO)
-        root.propagate = False
+    root = logging.getLogger("cassmantle")
+    if not root.handlers:
+        # double-checked under a lock: two threads racing the bare
+        # check above would each attach a handler and duplicate every
+        # log line for the life of the process
+        with _LOGGER_LOCK:
+            if not root.handlers:
+                handler = logging.StreamHandler()
+                handler.setFormatter(_make_formatter())
+                root.addHandler(handler)
+                root.setLevel(logging.INFO)
+                root.propagate = False
     return logger
 
 
+LabelsKey = Tuple[Tuple[str, str], ...]
+SeriesKey = Tuple[str, LabelsKey]
+
+
+def _series_key(name: str, labels: Optional[Dict[str, str]]) -> SeriesKey:
+    if not labels:
+        return name, ()
+    return name, tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _flat_name(key: SeriesKey) -> str:
+    """JSON-snapshot key: plain name, or name{k="v",...} when labeled —
+    unlabeled series (every pre-existing name) keep their exact keys."""
+    name, labels = key
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Histogram:
+    """Cumulative fixed-bucket histogram: constant memory per series,
+    all-time percentile estimates via in-bucket linear interpolation."""
+
+    __slots__ = ("bounds", "counts", "total", "sum")
+
+    def __init__(self, bounds: Sequence[float]) -> None:
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        assert self.bounds, "histogram needs at least one bucket bound"
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        # Prometheus buckets are le= (inclusive upper bounds)
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.total += 1
+        self.sum += float(value)
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0..1). Values in the +Inf overflow
+        bucket report the top finite bound — a lower bound on the true
+        quantile (size your buckets to cover the tail you care about)."""
+        if self.total == 0:
+            return 0.0
+        rank = q * self.total
+        cum = 0
+        for i, count in enumerate(self.counts):
+            if count and cum + count >= rank:
+                if i >= len(self.bounds):      # overflow bucket
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                return lo + (hi - lo) * ((rank - cum) / count)
+            cum += count
+        return self.bounds[-1]
+
+    def mean(self) -> float:
+        return self.sum / self.total if self.total else 0.0
+
+
+def _prom_name(name: str, labels: LabelsKey) -> Tuple[str, str]:
+    """(metric_name, label_suffix) in Prometheus grammar: dots/dashes to
+    underscores, ``cassmantle_`` namespace prefix, the ``_s`` seconds
+    suffix expanded to ``_seconds`` per convention."""
+    base = name.replace(".", "_").replace("-", "_")
+    if base.endswith("_s"):
+        base = base[:-2] + "_seconds"
+    suffix = ""
+    if labels:
+        inner = ",".join(
+            '{}="{}"'.format(k, v.replace("\\", "\\\\").replace('"', '\\"'))
+            for k, v in labels)
+        suffix = "{" + inner + "}"
+    return "cassmantle_" + base, suffix
+
+
 class Metrics:
-    """Thread-safe counters/gauges/timers. One global registry per process."""
+    """Thread-safe counters/gauges/histograms. One global registry per
+    process; instantiable standalone (golden tests use fresh instances)."""
 
-    def __init__(self) -> None:
+    def __init__(self,
+                 default_buckets: Sequence[float] = DEFAULT_BUCKETS_S
+                 ) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[str, float] = defaultdict(float)
-        self._gauges: Dict[str, float] = {}
-        self._timings: Dict[str, List[float]] = defaultdict(list)
+        self._counters: Dict[SeriesKey, float] = {}
+        self._gauges: Dict[SeriesKey, float] = {}
+        self._hists: Dict[SeriesKey, Histogram] = {}
+        self._default_buckets = tuple(default_buckets)
 
-    def inc(self, name: str, value: float = 1.0) -> None:
+    def set_default_buckets(self, bounds: Sequence[float]) -> None:
+        """Default bounds for histograms created AFTER this call;
+        existing series keep their buckets (cumulative counts cannot be
+        re-binned)."""
         with self._lock:
-            self._counters[name] += value
+            self._default_buckets = tuple(bounds)
 
-    def gauge(self, name: str, value: float) -> None:
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        key = _series_key(name, labels)
         with self._lock:
-            self._gauges[name] = value
+            self._counters[key] = self._counters.get(key, 0.0) + value
 
-    def observe(self, name: str, seconds: float) -> None:
+    def gauge(self, name: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
-            samples = self._timings[name]
-            samples.append(seconds)
-            if len(samples) > 1024:  # bounded memory
-                del samples[: len(samples) - 1024]
+            self._gauges[_series_key(name, labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None,
+                buckets: Optional[Sequence[float]] = None) -> None:
+        """Record into the series' histogram. ``buckets`` applies only
+        on first observation of a series (fixing its bounds for life)."""
+        key = _series_key(name, labels)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = Histogram(buckets or self._default_buckets)
+                self._hists[key] = hist
+            hist.observe(value)
 
     @contextmanager
-    def timer(self, name: str):
+    def timer(self, name: str, labels: Optional[Dict[str, str]] = None):
         start = time.perf_counter()
         try:
             yield
         finally:
-            self.observe(name, time.perf_counter() - start)
+            self.observe(name, time.perf_counter() - start, labels=labels)
 
+    # -- exposition -------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
+        """The backward-compatible JSON shape: flat counters/gauges plus
+        ``timings`` entries of ``{count, mean_s, p50_s, p99_s}`` (the
+        ``_s`` keys are historical; non-seconds histograms like
+        ``*.batch_size`` report their native unit under them)."""
         with self._lock:
-            timings = {}
-            for name, samples in self._timings.items():
-                if not samples:
-                    continue
-                ordered = sorted(samples)
-                timings[name] = {
-                    "count": len(ordered),
-                    "mean_s": sum(ordered) / len(ordered),
-                    "p50_s": ordered[len(ordered) // 2],
-                    "p99_s": ordered[min(len(ordered) - 1,
-                                         int(len(ordered) * 0.99))],
+            timings = {
+                _flat_name(key): {
+                    "count": h.total,
+                    "mean_s": h.mean(),
+                    "p50_s": h.quantile(0.5),
+                    "p99_s": h.quantile(0.99),
                 }
+                for key, h in self._hists.items() if h.total
+            }
             return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
+                "counters": {_flat_name(k): v
+                             for k, v in self._counters.items()},
+                "gauges": {_flat_name(k): v
+                           for k, v in self._gauges.items()},
                 "timings": timings,
             }
+
+    def prometheus(self) -> str:
+        """Text exposition (format version 0.0.4): counters as
+        ``*_total``, gauges plain, histograms as cumulative
+        ``_bucket{le=...}`` + ``_sum`` + ``_count``. Deterministically
+        sorted so scrapes (and golden tests) are stable."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (h.bounds, tuple(h.counts), h.sum, h.total)
+                     for k, h in self._hists.items()}
+        lines = []
+        typed = set()
+
+        def _emit_type(pname: str, kind: str) -> None:
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} {kind}")
+
+        def _fmt(v: float) -> str:
+            return repr(v) if isinstance(v, float) and not v.is_integer() \
+                else str(int(v))
+
+        for key in sorted(counters):
+            pname, suffix = _prom_name(key[0], key[1])
+            _emit_type(pname + "_total", "counter")
+            lines.append(f"{pname}_total{suffix} {_fmt(counters[key])}")
+        for key in sorted(gauges):
+            pname, suffix = _prom_name(key[0], key[1])
+            _emit_type(pname, "gauge")
+            lines.append(f"{pname}{suffix} {_fmt(gauges[key])}")
+        for key in sorted(hists):
+            bounds, counts, total_sum, total = hists[key]
+            pname, suffix = _prom_name(key[0], key[1])
+            _emit_type(pname, "histogram")
+            label_body = suffix[1:-1] + "," if suffix else ""
+            cum = 0
+            for bound, count in zip(bounds, counts):
+                cum += count
+                lines.append(
+                    f'{pname}_bucket{{{label_body}le="{_fmt(bound)}"}} '
+                    f"{cum}")
+            cum += counts[-1]
+            lines.append(f'{pname}_bucket{{{label_body}le="+Inf"}} {cum}')
+            lines.append(f"{pname}_sum{suffix} {repr(float(total_sum))}")
+            lines.append(f"{pname}_count{suffix} {total}")
+        return "\n".join(lines) + "\n"
 
 
 metrics = Metrics()

@@ -39,12 +39,28 @@ def annotate(name: str) -> Iterator[None]:
 
 @contextlib.contextmanager
 def block_timer(name: str, *results) -> Iterator[list]:
-    """Time a region to metrics, blocking on listed device arrays at exit."""
+    """Time a region to metrics, blocking on listed device arrays at exit.
+
+    Also records a **device-synchronized stage span** into the active
+    trace (obs/trace.py) when one is ambient: the block-until-ready at
+    exit means the span's duration covers the device work, not just
+    dispatch — these are the per-stage spans a request trace shows for
+    scorer encodes, prompt decodes, and image generations."""
+    from cassmantle_tpu.obs.trace import current_ctx, tracer
+
     sink: list = []
+    start_wall = time.time()
     start = time.perf_counter()
     try:
         yield sink
     finally:
         for r in list(results) + sink:
             jax.block_until_ready(r)
-        metrics.observe(name, time.perf_counter() - start)
+        elapsed = time.perf_counter() - start
+        metrics.observe(name, elapsed)
+        ctx = current_ctx()
+        if ctx is not None and ctx.sampled:
+            tracer.record_span(
+                name, tracer.child_ctx(ctx), parent_id=ctx.span_id,
+                start_wall=start_wall, duration_s=elapsed,
+                attrs={"device_synced": True})

@@ -58,11 +58,12 @@ def pytest_configure(config):
 # host; see ROADMAP.md for the tier commands.
 
 FAST_MODULES = frozenset({
-    "test_aux", "test_bench_harness", "test_eval", "test_fault_injection",
+    "test_aux", "test_bench_harness", "test_check_metrics", "test_eval",
+    "test_fault_injection",
     "test_flash_attention", "test_frontend", "test_fused_conv",
     "test_game", "test_js_runtime", "test_layers_norm", "test_masking",
     "test_masking_agreement", "test_multihost",
-    "test_native_store", "test_ops", "test_pipeline",
+    "test_native_store", "test_obs", "test_ops", "test_pipeline",
     "test_pipeline_parallel", "test_samplers", "test_scoring",
     "test_server", "test_spell", "test_store",
     "test_supervisor", "test_utils", "test_weights",

@@ -1,0 +1,76 @@
+"""Metric-name drift gate: every literal metrics emission in the
+package must follow the naming convention and appear in the
+docs/OBSERVABILITY.md catalog (tools/check_metrics.py). Runs in the
+fast tier so drift fails tier-1 before it ships."""
+
+from tools.check_metrics import (
+    _name_matches,
+    check,
+    extract_sites,
+    load_catalog,
+)
+
+
+def test_package_metric_names_clean():
+    assert check() == []
+
+
+def test_catalog_is_nonempty():
+    catalog = load_catalog()
+    assert len(catalog) > 40          # the full serving surface
+    assert "http.init" in catalog
+    assert "circuit.<name>.opened" in catalog
+
+
+def test_extractor_reads_fstrings_as_wildcards():
+    sites = extract_sites(
+        "metrics.inc(f'{self.name}.batches')\n"
+        "metrics.observe('a.b_s', 1.0)\n"
+        "metrics.timer(name)\n",            # dynamic: skipped
+        "<test>")
+    assert ("*.batches", "inc", 1) in sites
+    assert ("a.b_s", "observe", 2) in sites
+    assert len(sites) == 2
+
+
+def test_extractor_covers_block_timer_stage_names():
+    """block_timer emits a metric + stage span; its literal names must
+    lint like any metrics.observe (the device-stage names this layer
+    leans on — scorer.encode_s, pipeline.t2i_s — would otherwise drift
+    off the catalog unchecked)."""
+    sites = extract_sites(
+        "with block_timer('scorer.encode_s') as sink:\n    pass\n",
+        "<test>")
+    assert ("scorer.encode_s", "observe", 1) in sites
+    # the package-wide scan actually sees the real stage sites
+    import pathlib
+
+    from tools.check_metrics import PACKAGE
+
+    all_names = set()
+    for p in sorted(pathlib.Path(PACKAGE).rglob("*.py")):
+        for name, _, _ in extract_sites(p.read_text(), str(p)):
+            all_names.add(name)
+    assert {"scorer.encode_s", "pipeline.t2i_s",
+            "pipeline.sdxl_s", "pipeline.prompt_s"} <= all_names
+
+
+def test_wildcard_matching_rules():
+    assert _name_matches("circuit.*.*", "circuit.<name>.opened")
+    assert _name_matches("score.batches", "<queue>.batches")
+    assert _name_matches("store.lock_*", "store.lock_<kind>")
+    assert not _name_matches("score.batches", "<queue>.items")
+    assert not _name_matches("a.b.c", "a.b")
+
+
+def test_violations_are_detected():
+    bad = extract_sites("metrics.inc('UPPER.case')\n"
+                        "metrics.inc('nosegments')\n"
+                        "metrics.observe('a.no_unit', 1.0)\n", "<t>")
+    # extraction itself keeps them; check() logic is exercised via the
+    # package scan above — here pin the convention primitives
+    assert ("UPPER.case", "inc", 1) in bad
+    from tools.check_metrics import _SEGMENT
+
+    assert not _SEGMENT.match("UPPER")
+    assert _SEGMENT.match("lower_case_1")

@@ -1,0 +1,564 @@
+"""Observability subsystem tests (ISSUE 3): tracer propagation, metrics
+histograms + Prometheus exposition, flight recorder, and the end-to-end
+acceptance paths (X-Trace-Id through the queue to device stage spans;
+/debugz replaying a breaker-trip -> reserve-rotation -> recovery story).
+"""
+
+import asyncio
+import dataclasses
+import json
+import logging as stdlog
+import threading
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.obs.recorder import FlightRecorder, flight_recorder
+from cassmantle_tpu.obs.trace import Tracer, run_with_ctx, tracer
+from cassmantle_tpu.utils.logging import JsonLogFormatter, Metrics
+
+
+def make_cfg(rate=1000.0):
+    cfg = _tiny_config()
+    return cfg.replace(game=dataclasses.replace(
+        cfg.game, rate_limit_default=rate, rate_limit_api=rate,
+        time_per_prompt=30.0,
+    ))
+
+
+# -- metrics registry ------------------------------------------------------
+
+def test_histogram_percentiles_unbiased():
+    """Bucketed percentiles are all-time (no sliding-window trim) and
+    interpolate inside the bucket — the old keep-last-1024 list made
+    p50/p99 window stats and mis-indexed p99 at small n."""
+    m = Metrics()
+    buckets = tuple((i + 1) / 10 for i in range(10))    # 0.1 .. 1.0
+    for i in range(1, 101):
+        m.observe("t.lat_s", i / 100, buckets=buckets)
+    snap = m.snapshot()["timings"]["t.lat_s"]
+    assert set(snap) == {"count", "mean_s", "p50_s", "p99_s"}
+    assert snap["count"] == 100
+    assert abs(snap["mean_s"] - 0.505) < 1e-9
+    assert abs(snap["p50_s"] - 0.5) < 1e-9
+    assert abs(snap["p99_s"] - 0.99) < 1e-9
+
+
+def test_histogram_small_n_sane():
+    """n=1: both percentiles land inside the single value's bucket (the
+    old code's int(n*0.99) indexed sample 0 — the MINIMUM — as p99)."""
+    m = Metrics()
+    m.observe("t.one_s", 0.3, buckets=(0.25, 0.5, 1.0))
+    snap = m.snapshot()["timings"]["t.one_s"]
+    assert 0.25 < snap["p50_s"] <= 0.5
+    assert 0.25 < snap["p99_s"] <= 0.5
+    assert snap["p99_s"] >= snap["p50_s"]
+
+
+def test_histogram_memory_bounded():
+    m = Metrics()
+    for i in range(5000):
+        m.observe("t.mem_s", float(i), buckets=(1.0, 10.0))
+    hist = m._hists[("t.mem_s", ())]
+    assert len(hist.counts) == 3                 # 2 bounds + overflow
+    assert m.snapshot()["timings"]["t.mem_s"]["count"] == 5000
+
+
+def test_prometheus_exposition_golden():
+    m = Metrics(default_buckets=(0.5, 1.0))
+    m.inc("t.hits")
+    m.inc("t.hits", 2)
+    m.inc("t.labeled", labels={"queue": "score"})
+    m.gauge("t.depth", 3)
+    for v in (0.25, 0.5, 2.0):
+        m.observe("t.lat_s", v)
+    assert m.prometheus() == (
+        "# TYPE cassmantle_t_hits_total counter\n"
+        "cassmantle_t_hits_total 3\n"
+        "# TYPE cassmantle_t_labeled_total counter\n"
+        'cassmantle_t_labeled_total{queue="score"} 1\n'
+        "# TYPE cassmantle_t_depth gauge\n"
+        "cassmantle_t_depth 3\n"
+        "# TYPE cassmantle_t_lat_seconds histogram\n"
+        'cassmantle_t_lat_seconds_bucket{le="0.5"} 2\n'
+        'cassmantle_t_lat_seconds_bucket{le="1"} 2\n'
+        'cassmantle_t_lat_seconds_bucket{le="+Inf"} 3\n'
+        "cassmantle_t_lat_seconds_sum 2.75\n"
+        "cassmantle_t_lat_seconds_count 3\n"
+    )
+
+
+def test_snapshot_json_shape_backward_compatible():
+    """The pre-histogram consumers (tests, __main__, dashboards) read
+    flat counters/gauges and count/mean_s/p50_s/p99_s timings."""
+    m = Metrics()
+    m.inc("a.b")
+    m.gauge("c.d", 1.0)
+    m.observe("e.f_s", 0.1)
+    snap = m.snapshot()
+    assert set(snap) == {"counters", "gauges", "timings"}
+    assert snap["counters"] == {"a.b": 1.0}
+    assert snap["gauges"] == {"c.d": 1.0}
+    assert set(snap["timings"]["e.f_s"]) == \
+        {"count", "mean_s", "p50_s", "p99_s"}
+    # labeled series key as name{k="v"} without disturbing plain names
+    m.inc("a.b", labels={"q": "x"})
+    assert m.snapshot()["counters"]['a.b{q="x"}'] == 1.0
+
+
+# -- logger fixes ----------------------------------------------------------
+
+def test_get_logger_single_handler_under_contention():
+    """The double-handler race: N threads racing the first get_logger
+    must end with exactly ONE handler (duplicated handlers duplicate
+    every log line for the process lifetime)."""
+    from cassmantle_tpu.utils.logging import get_logger
+
+    root = stdlog.getLogger("cassmantle")
+    for h in root.handlers[:]:
+        root.removeHandler(h)
+    barrier = threading.Barrier(16)
+
+    def worker():
+        barrier.wait()
+        get_logger("race")
+
+    threads = [threading.Thread(target=worker) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(root.handlers) == 1
+
+
+def test_json_log_format_injects_trace_id(monkeypatch):
+    fmt = JsonLogFormatter()
+    record = stdlog.LogRecord(
+        name="cassmantle.x", level=stdlog.INFO, pathname=__file__,
+        lineno=1, msg="hello %s", args=("world",), exc_info=None)
+    with tracer.span("t.json", root=True) as h:
+        line = fmt.format(record)
+    data = json.loads(line)
+    assert data["msg"] == "hello world"
+    assert data["level"] == "INFO"
+    assert data["trace_id"] == h.trace_id
+    # outside any trace: the key is simply absent
+    assert "trace_id" not in json.loads(fmt.format(record))
+
+    # the env switch installs the JSON formatter on first handler attach
+    from cassmantle_tpu.utils.logging import get_logger
+
+    monkeypatch.setenv("CASSMANTLE_LOG_FORMAT", "json")
+    root = stdlog.getLogger("cassmantle")
+    old = root.handlers[:]
+    for h2 in old:
+        root.removeHandler(h2)
+    try:
+        get_logger("jsontest")
+        assert isinstance(root.handlers[0].formatter, JsonLogFormatter)
+    finally:
+        for h2 in root.handlers[:]:
+            root.removeHandler(h2)
+        for h2 in old:
+            root.addHandler(h2)
+
+
+# -- tracer ----------------------------------------------------------------
+
+def test_span_nesting_and_parent_ids():
+    tr = Tracer(capacity=8)
+    with tr.span("a.root", root=True) as root:
+        with tr.span("a.child") as child:
+            assert child.trace_id == root.trace_id
+    spans = {s["name"]: s for s in tr.get_trace(root.trace_id)}
+    assert spans["a.child"]["parent_id"] == root.span_id
+    assert spans["a.root"]["parent_id"] is None
+    assert spans["a.child"]["duration_s"] >= 0.0
+
+
+def test_trace_ring_evicts_oldest():
+    tr = Tracer(capacity=2)
+    handles = []
+    for i in range(3):
+        with tr.span("a.b", root=True) as h:
+            handles.append(h)
+    assert tr.get_trace(handles[0].trace_id) is None
+    assert tr.get_trace(handles[1].trace_id) is not None
+    assert tr.get_trace(handles[2].trace_id) is not None
+
+
+def test_trace_ring_is_lru_and_never_resurrects_evicted():
+    """Activity protects a long-running trace from bursts of short ones
+    (true LRU, not FIFO), and a late span from an ALREADY-evicted trace
+    is dropped rather than resurrecting a torn partial trace."""
+    import time as _time
+
+    tr = Tracer(capacity=2)
+    long_running = tr.new_root_ctx()
+    tr.record_span("w.early", tr.child_ctx(long_running),
+                   start_wall=_time.time(), duration_s=0.0)
+    with tr.span("a.b", root=True):          # ring: [long, b]
+        pass
+    # a new span refreshes the long trace's LRU slot...
+    tr.record_span("w.mid", tr.child_ctx(long_running),
+                   start_wall=_time.time(), duration_s=0.0)
+    with tr.span("a.c", root=True):          # evicts b, not long
+        pass
+    assert tr.get_trace(long_running.trace_id) is not None
+    # ...and once genuinely evicted, it stays gone
+    evicted = tr.new_root_ctx()
+    tr.record_span("w.x", tr.child_ctx(evicted),
+                   start_wall=_time.time(), duration_s=0.0)
+    for _ in range(3):
+        with tr.span("a.flood", root=True):
+            pass
+    assert tr.get_trace(evicted.trace_id) is None
+    tr.record_span("w.late", tr.child_ctx(evicted),
+                   start_wall=_time.time(), duration_s=0.0)
+    assert tr.get_trace(evicted.trace_id) is None     # no torn revival
+
+
+def test_degraded_status_events_are_opt_in():
+    """The flight-recorder tail is internal state: status() embeds it
+    only when the HTTP layer vouches the caller is loopback."""
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    sup = ServingSupervisor()
+    for _ in range(sup.content_breaker.failure_threshold):
+        sup.content_breaker.record_failure()
+    assert "events" not in sup.status()                      # default
+    assert "events" in sup.status(include_events=True)       # operator
+    sup.content_breaker.record_success()
+    assert "events" not in sup.status(include_events=True)   # healthy
+
+
+def test_unsampled_trace_propagates_ids_but_records_nothing():
+    tr = Tracer(capacity=8, sample_rate=0.0)
+    with tr.span("a.b", root=True) as h:
+        assert h.trace_id                      # header stays useful
+        with tr.span("a.c") as c:
+            assert c.trace_id == h.trace_id
+    assert tr.get_trace(h.trace_id) is None
+
+
+def test_ctx_crosses_threads_explicitly():
+    """run_with_ctx is the dispatch-thread seam: a span opened on a
+    foreign thread under a carried ctx parents correctly."""
+    tr = Tracer(capacity=8)
+    out = {}
+
+    def on_thread():
+        with tr.span("a.stage") as s:
+            out["trace"] = s.trace_id
+
+    with tr.span("a.root", root=True) as root:
+        t = threading.Thread(
+            target=run_with_ctx, args=(root.ctx, on_thread))
+        t.start()
+        t.join()
+    assert out["trace"] == root.trace_id
+    spans = {s["name"]: s for s in tr.get_trace(root.trace_id)}
+    assert spans["a.stage"]["parent_id"] == root.span_id
+
+
+def test_error_spans_marked():
+    tr = Tracer(capacity=8)
+    with pytest.raises(ValueError):
+        with tr.span("a.bad", root=True) as h:
+            raise ValueError("boom")
+    (span,) = tr.get_trace(h.trace_id)
+    assert span["status"] == "error"
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_flight_recorder_capacity_and_ordering():
+    r = FlightRecorder(capacity=4)
+    for i in range(10):
+        r.record("t.event", i=i)
+    tail = r.tail()
+    assert [e["i"] for e in tail] == [6, 7, 8, 9]
+    assert [e["seq"] for e in tail] == [7, 8, 9, 10]     # monotonic
+    assert r.stats()["dropped"] == 6
+    assert [e["i"] for e in r.tail(2)] == [8, 9]
+    r.record("other.kind")
+    assert all(e["kind"] == "t.event" for e in r.tail(kind="t.event"))
+    assert [e["kind"] for e in r.tail(kind="other.")] == ["other.kind"]
+    r.set_capacity(2)
+    assert [e["kind"] for e in r.tail()] == ["t.event", "other.kind"]
+
+
+# -- queue split (unit) ----------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_queue_records_wait_service_split_and_marks():
+    from cassmantle_tpu.serving.queue import BatchingQueue
+
+    q = BatchingQueue(lambda items: [x * 2 for x in items],
+                      max_delay_ms=5, name="obsq")
+
+    async def request():
+        with tracer.span("req.root", root=True) as h:
+            result = await q.submit(21)
+            return h, result
+
+    handle, result = await request()
+    await q.stop()
+    assert result == 42
+    spans = {s["name"]: s for s in tracer.get_trace(handle.trace_id)}
+    # member-side split + the batch span joined into the same trace
+    # (single-request batch)
+    assert "obsq.queue_wait" in spans and "obsq.batch_service" in spans
+    assert spans["obsq.batch"]["attrs"]["batch_size"] == 1
+    assert spans["obsq.queue_wait"]["parent_id"] == handle.span_id
+    link = spans["obsq.batch_service"]["attrs"]
+    assert link["batch_span"] == spans["obsq.batch"]["span_id"]
+    # the marks blackboard carries the same split for response headers
+    assert handle.ctx.marks["queue_wait_s"] >= 0.0
+    assert handle.ctx.marks["service_s"] >= 0.0
+
+
+def test_span_cap_truncates_honestly():
+    """Past max_spans_per_trace the drop is counted and the trace is
+    visibly marked truncated — never a silently-shortened trace."""
+    tr = Tracer(capacity=4, max_spans_per_trace=2)
+    with tr.span("c.root", root=True) as h:
+        for _ in range(3):
+            with tr.span("c.child"):
+                pass
+    spans = tr.get_trace(h.trace_id)
+    assert len(spans) == 2
+    assert spans[-1]["attrs"]["truncated"] is True
+
+
+@pytest.mark.asyncio
+async def test_expired_deadline_still_observes_queue_wait():
+    """The queue_wait_s histogram must include waits that EXPIRED —
+    excluding them would report healthy p99s exactly while users time
+    out behind a wedged device."""
+    from cassmantle_tpu.serving.queue import BatchingQueue, DeadlineExceeded
+    from cassmantle_tpu.utils.logging import metrics
+
+    q = BatchingQueue(lambda items: items, name="expq")
+    q.start()
+    await q.stop()
+    q._task = object()        # collector never drains (test_queue idiom)
+    with pytest.raises(DeadlineExceeded):
+        await q.submit(1, deadline_s=0.02)
+    snap = metrics.snapshot()
+    assert snap["counters"]["expq.deadline_expired"] >= 1
+    wait = snap["timings"]["expq.queue_wait_s"]
+    assert wait["count"] >= 1
+    assert wait["p99_s"] >= 0.0
+
+
+@pytest.mark.asyncio
+async def test_untraced_submits_mint_no_orphan_batch_traces():
+    """A batch whose members carry no trace ctx records nothing — it
+    must not mint a root trace per batch and flush the bounded ring."""
+    from cassmantle_tpu.serving.queue import BatchingQueue
+
+    before = set(tracer.trace_ids())
+    q = BatchingQueue(lambda items: items, name="orphq", max_delay_ms=1)
+    assert await q.submit(7) == 7       # submitted outside any trace
+    await q.stop()
+    new = set(tracer.trace_ids()) - before
+    assert not new
+
+
+@pytest.mark.asyncio
+async def test_500_response_carries_trace_id():
+    """Unhandled handler errors — the trace an operator most wants to
+    look up from a user report — still answer with X-Trace-Id."""
+    from cassmantle_tpu.engine.content import (
+        FakeContentBackend,
+        hash_embed,
+        hash_similarity,
+    )
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.server.app import create_app
+
+    cfg = make_cfg()
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity)
+
+    async def boom(session):
+        raise RuntimeError("handler bug")
+
+    game.client_status = boom
+    app = create_app(game, cfg, start_timer=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        res = await client.get("/client/status")
+        assert res.status == 500
+        trace_id = res.headers["X-Trace-Id"]
+        spans = tracer.get_trace(trace_id)
+        (root,) = [s for s in spans
+                   if s["name"] == "http.get /client/status"]
+        assert root["attrs"]["status"] == 500
+    finally:
+        await client.close()
+
+
+# -- end-to-end acceptance -------------------------------------------------
+
+async def _score_client():
+    """HTTP -> engine -> REAL batching queue -> tiny MiniLM scorer:
+    the fake content backend keeps round generation cheap while the
+    guess path exercises the full traced queue + device stage."""
+    from cassmantle_tpu.engine.content import FakeContentBackend
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.server.app import create_app
+    from cassmantle_tpu.serving.service import InferenceService
+
+    cfg = make_cfg()
+    service = InferenceService(
+        cfg, backend=FakeContentBackend(image_size=32))
+    game = Game(cfg, MemoryStore(), service.content_backend,
+                embed=service.embed, similarity=service.similarity,
+                supervisor=service.supervisor)
+    app = create_app(game, cfg, start_timer=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, game
+
+
+@pytest.mark.asyncio
+async def test_trace_id_end_to_end_through_queue_and_device_stage():
+    """Acceptance: a /compute_score response carries an X-Trace-Id whose
+    trace contains queue-wait, batch-service, and a device-synchronized
+    stage span — plus the X-Queue-Wait/X-Service-Time header pair."""
+    client, game = await _score_client()
+    try:
+        await client.get("/init")
+        current = await game.rounds.fetch_current_prompt()
+        mask = current["masks"][0]
+        res = await client.post(
+            "/compute_score", json={"inputs": {str(mask): "storm"}})
+        assert res.status == 200
+        trace_id = res.headers["X-Trace-Id"]
+        assert float(res.headers["X-Queue-Wait"]) >= 0.0
+        assert float(res.headers["X-Service-Time"]) > 0.0
+
+        dbg = await client.get(f"/debugz?trace={trace_id}")
+        assert dbg.status == 200
+        spans = (await dbg.json())["spans"]
+        names = {s["name"] for s in spans}
+        assert f"http.post /compute_score" in names
+        assert "game.score" in names
+        assert "score.queue_wait" in names
+        assert "score.batch_service" in names
+        # the device stage the batch ran, synchronized on its arrays
+        stage = [s for s in spans if s["name"] == "scorer.encode_s"]
+        assert stage and stage[0]["attrs"]["device_synced"] is True
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_metrics_content_negotiation():
+    client, _ = await _score_client()
+    try:
+        res = await client.get("/metrics")
+        data = await res.json()           # default stays JSON
+        assert {"counters", "gauges", "timings"} <= set(data)
+        res = await client.get("/metrics",
+                               headers={"Accept": "text/plain"})
+        assert res.status == 200
+        assert "version=0.0.4" in res.headers["Content-Type"]
+        text = await res.text()
+        assert "# TYPE cassmantle_http_init_total counter" in text
+        assert 'cassmantle_score_batch_seconds_bucket{le="+Inf"}' in text
+        assert "cassmantle_score_batch_seconds_count" in text
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_debugz_replays_trip_rotation_recovery_in_order():
+    """Acceptance: /debugz replays breaker trip -> reserve rotation ->
+    recovery causally; a degraded /readyz embeds the same tail."""
+    from aiohttp.test_utils import TestClient as TC, TestServer as TS
+
+    from cassmantle_tpu.engine.content import (
+        FakeContentBackend,
+        hash_embed,
+        hash_similarity,
+    )
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.server.app import create_app
+    from cassmantle_tpu.utils.codec import encode_jpeg
+
+    cfg = make_cfg()
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity)
+    app = create_app(game, cfg, start_timer=False)
+    client = TC(TS(app))
+    await client.start_server()
+    try:
+        watermark = flight_recorder.stats()["total_recorded"]
+        # 1. trip the content breaker
+        breaker = game.supervisor.content_breaker
+        for _ in range(breaker.failure_threshold):
+            breaker.record_failure()
+        # 2. degraded /readyz carries the event history explaining it
+        res = await client.get("/readyz")
+        body = await res.json()
+        assert res.status == 503
+        assert any(e["kind"] == "breaker" and e["state"] == "open"
+                   for e in body["events"])
+        # 3. archive a reserve round, then promote with an empty buffer
+        #    -> reserve rotation, not a replay
+        state = json.dumps({"tokens": ["a", "fresh", "round"],
+                            "masks": [1], "embeds": {}})
+        jpeg = encode_jpeg(np.zeros((8, 8, 3), dtype=np.uint8))
+        await game.reserve.archive("a fresh round", state, jpeg)
+        await game.rounds.promote_buffer()
+        # 4. recovery
+        breaker.record_success()
+        res = await client.get("/readyz")
+        assert res.status == 200
+
+        dbg = await client.get("/debugz")
+        events = [e for e in (await dbg.json())["events"]
+                  if e["seq"] > watermark]
+        opened = next(i for i, e in enumerate(events)
+                      if e["kind"] == "breaker" and e["state"] == "open")
+        rotated = next(i for i, e in enumerate(events)
+                       if e["kind"] == "round.reserve_promotion")
+        closed = next(i for i, e in enumerate(events)
+                      if e["kind"] == "breaker"
+                      and e["state"] == "closed" and i > opened)
+        assert opened < rotated < closed
+        # filtered + trace-miss paths
+        dbg = await client.get("/debugz?kind=breaker&n=5")
+        assert all(e["kind"] == "breaker"
+                   for e in (await dbg.json())["events"])
+        missing = await client.get("/debugz?trace=deadbeef")
+        assert missing.status == 404
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_round_generation_gets_background_trace():
+    """Background round generation (no HTTP request) roots its own
+    trace so pipeline stage spans have somewhere to land."""
+    client, game = await _score_client()
+    try:
+        watermark = set(tracer.trace_ids())
+        await game.rounds.buffer_contents()
+        new = [t for t in tracer.trace_ids() if t not in watermark]
+        gen_traces = [
+            t for t in new
+            if any(s["name"] == "round.generate"
+                   for s in (tracer.get_trace(t) or []))]
+        assert gen_traces, "round.generate root span not recorded"
+    finally:
+        await client.close()

@@ -87,6 +87,29 @@ def test_breaker_and_watchdog_metrics_names():
     snap = metrics.snapshot()
     assert snap["counters"]["supervisor.dispatch_overruns"] >= 1
     assert "supervisor.degraded" in snap["gauges"]
+    # every transition above also landed in the flight recorder
+    # (ISSUE 3), and span/event volume self-reports
+    assert snap["counters"]["obs.events"] >= 1
+
+
+async def test_queue_instrumentation_metric_names():
+    """The batch-shape instrumentation lands under stable names —
+    what docs/OBSERVABILITY.md's catalog (and tools/check_metrics.py)
+    pin for operators."""
+    from cassmantle_tpu.serving.queue import BatchingQueue
+    from cassmantle_tpu.utils.logging import metrics
+
+    q = BatchingQueue(lambda items: list(items), max_delay_ms=1,
+                      name="pinq")
+    await q.submit(1)
+    await q.stop()
+    snap = metrics.snapshot()
+    for counter in ("pinq.batches", "pinq.items"):
+        assert snap["counters"][counter] >= 1
+    for hist in ("pinq.batch_s", "pinq.queue_wait_s", "pinq.batch_size"):
+        assert snap["timings"][hist]["count"] >= 1
+    for gauge in ("pinq.depth", "pinq.coalesce_wait_s"):
+        assert gauge in snap["gauges"]
 
 
 def test_retry_give_up_on_aborts_immediately():
