@@ -1,0 +1,24 @@
+"""Static analysis: the lint framework behind ``tools/check_*.py``.
+
+The two worst bugs this repo has shipped were concurrency bugs (the
+PR 1 device-dispatch deadlock, the PR 2 wedged dispatch thread) — the
+class of hazard an AST pass catches before it reaches a serving fleet.
+This package is the shared machinery: ``core`` (module parsing,
+``# lint: ignore[rule]`` suppressions, JSON/human reporters, the
+runner), plus one module per pass. ``docs/STATIC_ANALYSIS.md`` is the
+rule catalog and the how-to-add-a-pass guide.
+
+Entry points: ``tools/check_concurrency.py`` (lock discipline,
+blocking-in-async, host-sync), ``tools/check_metrics.py`` (metric
+naming/catalog), ``tools/lint_all.py`` (everything, one exit code) —
+all gated as fast-tier tests.
+"""
+
+from cassmantle_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintPass,
+    Module,
+    iter_modules,
+    parse_source,
+    run_passes,
+)

@@ -1,0 +1,132 @@
+"""Blocking-call-in-async pass: the event-loop stall detector.
+
+A blocking call lexically inside an ``async def`` body freezes the
+whole worker — the 1 Hz clock pushes, every WS connection, every
+in-flight request — for its full duration (the PR 2 wedge class, seen
+from the other side). This pass flags, inside ``async def`` bodies:
+
+- ``time.sleep`` (use ``await asyncio.sleep``);
+- unbounded waits — zero-arg ``.result()`` / ``.get()`` / ``.wait()`` /
+  ``.join()`` (await the async counterpart or add a timeout + executor);
+- device syncs — ``block_until_ready`` / ``jax.device_get`` (route
+  through ``loop.run_in_executor`` like the pipelines do);
+- synchronous I/O — ``open()``, ``requests.*`` / ``urllib.request.*``
+  HTTP, ``subprocess.run/call/check_*`` and ``os.system``.
+
+Executor-routed work passes by construction: ``await
+loop.run_in_executor(None, fn, ...)`` passes ``fn`` as a *reference*,
+not a call, and directly-awaited calls are exempt (awaiting yields).
+Nested sync ``def``/``lambda`` bodies are skipped — they run wherever
+they are called, typically on an executor thread.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence
+
+from cassmantle_tpu.analysis.core import Finding, LintPass, Module, call_name
+from cassmantle_tpu.analysis.lockorder import blocking_wait_reason
+
+RULE = "async-blocking-call"
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+
+# awaited wrappers whose call-arguments are coroutine/future factories
+# (`await asyncio.wait_for(cond.wait(), ...)`): the inner call is
+# awaited machinery, not a blocking call on the loop
+_ASYNC_WRAPPERS = {
+    "asyncio.wait_for", "asyncio.wait", "asyncio.shield",
+    "asyncio.gather", "asyncio.wrap_future", "asyncio.ensure_future",
+    "asyncio.create_task", "asyncio.as_completed",
+}
+
+# async handler/pipeline/engine layers — the dirs whose async defs feed
+# the serving event loop (ops/models are sync-only by construction)
+REPO_DIRS = ("cassmantle_tpu/server/", "cassmantle_tpu/serving/",
+             "cassmantle_tpu/engine/")
+
+
+def _blocking_reason(node: ast.Call) -> Optional[str]:
+    reason = blocking_wait_reason(node)
+    if reason is not None:
+        return reason
+    name = call_name(node)
+    if name is None:
+        return None
+    if name == "open":
+        return "synchronous file I/O"
+    root = name.split(".", 1)[0]
+    if root == "requests" or name.startswith("urllib.request."):
+        return "synchronous HTTP request"
+    if name == "os.system":
+        return "os.system() blocks on the child process"
+    if root == "subprocess" and \
+            name.rsplit(".", 1)[-1] in _SUBPROCESS_BLOCKING:
+        return "synchronous subprocess wait"
+    return None
+
+
+class AsyncBlockingPass(LintPass):
+    name = "async-blocking"
+    description = "blocking calls lexically inside async def bodies"
+
+    def __init__(self, dirs: Optional[Sequence[str]] = None) -> None:
+        # None = lint every module handed in (fixtures); the repo run
+        # scopes to the event-loop layers via for_repo()
+        self.dirs = tuple(dirs) if dirs else None
+
+    @classmethod
+    def for_repo(cls) -> "AsyncBlockingPass":
+        return cls(dirs=REPO_DIRS)
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if self.dirs and not any(module.rel.startswith(d)
+                                 for d in self.dirs):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._scan_async(node, module)
+
+    def _scan_async(self, fn: ast.AsyncFunctionDef,
+                    module: Module) -> Iterator[Finding]:
+        findings: List[Finding] = []
+
+        def scan(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested defs run elsewhere (nested async defs
+                # are visited by the outer walk in run())
+            if isinstance(node, ast.Await):
+                value = node.value
+                if isinstance(value, ast.Call):
+                    # the awaited call yields; only its arguments can
+                    # still hide a blocking call — and when the awaited
+                    # call is asyncio machinery, its call-arguments are
+                    # coroutine factories, exempt one level down too
+                    wrapper = call_name(value) in _ASYNC_WRAPPERS
+                    for child in ast.iter_child_nodes(value):
+                        if wrapper and isinstance(child, ast.Call):
+                            for sub in ast.iter_child_nodes(child):
+                                scan(sub)
+                        else:
+                            scan(child)
+                else:
+                    scan(value)
+                return
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node)
+                if reason is not None:
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        f"{reason} inside async def {fn.name!r} — the "
+                        f"event loop stalls for its full duration; "
+                        f"await the async form or route through "
+                        f"loop.run_in_executor",
+                        getattr(node, "end_lineno", None)))
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+
+        for stmt in fn.body:
+            scan(stmt)
+        yield from findings

@@ -1,0 +1,216 @@
+"""Shared AST lint infrastructure: modules, suppressions, runner, reporters.
+
+Every pass (lock discipline, blocking-in-async, host-sync, metric
+names) plugs into the same three pieces:
+
+- :func:`iter_modules` / :func:`parse_source` build :class:`Module`
+  objects — source + AST + parsed suppression comments — once per file,
+  shared by all passes in a run;
+- :class:`LintPass` subclasses yield :class:`Finding`s from a module;
+- :func:`run_passes` filters findings through the suppressions and
+  sorts them; :func:`format_human` / :func:`to_json` render them; and
+  :func:`main_for` is the shared CLI (``<tool> [root] [--json]``,
+  exit 1 on findings) every ``tools/check_*.py`` entry point wraps.
+
+Suppression syntax (see docs/STATIC_ANALYSIS.md):
+
+- ``# lint: ignore[rule]`` on (any line of) the offending statement —
+  or on its own line directly above it — suppresses that rule there;
+  always follow with ``— reason``;
+- ``# lint: ignore-file[rule]`` anywhere in a file suppresses the rule
+  for the whole file.
+
+This package is stdlib-only on purpose: the lint tools must run (and
+fail CI) in a few hundred milliseconds, with no jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import pathlib
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+PACKAGE = REPO / "cassmantle_tpu"
+
+_IGNORE = re.compile(
+    r"#\s*lint:\s*ignore(?P<scope>-file)?\[(?P<rules>[a-z0-9_\-, ]+)\]"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: ``rule`` names the check (the suppression key),
+    ``lineno``/``end_lineno`` anchor it (suppression comments anywhere
+    in that statement span apply)."""
+
+    rule: str
+    path: str
+    lineno: int
+    message: str
+    end_lineno: Optional[int] = None
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path,
+                "lineno": self.lineno, "message": self.message}
+
+
+class Suppressions:
+    """``# lint: ignore[rule]`` comments, parsed from the token stream
+    (comments never reach the AST)."""
+
+    def __init__(self) -> None:
+        self.file_rules: Set[str] = set()
+        self.line_rules: Dict[int, Set[str]] = {}
+
+    @classmethod
+    def parse(cls, source: str) -> "Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _IGNORE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group("rules").split(",")
+                         if r.strip()}
+                if m.group("scope"):
+                    sup.file_rules |= rules
+                else:
+                    row = tok.start[0]
+                    sup.line_rules.setdefault(row, set()).update(rules)
+                    # a comment standing on its own line covers the next
+                    # line too (the statement it annotates)
+                    if tok.line[:tok.start[1]].strip() == "":
+                        sup.line_rules.setdefault(
+                            row + 1, set()).update(rules)
+        except tokenize.TokenError:
+            pass  # half-written file: lint what parsed, suppress nothing
+        return sup
+
+    def allows(self, rule: str, lineno: int,
+               end_lineno: Optional[int] = None) -> bool:
+        if rule in self.file_rules:
+            return True
+        for line in range(lineno, (end_lineno or lineno) + 1):
+            if rule in self.line_rules.get(line, ()):
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file, shared by every pass in a run."""
+
+    rel: str                      # repo-relative path (or fixture name)
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+
+
+def parse_source(source: str, rel: str = "<fixture>") -> Module:
+    return Module(rel=rel, source=source,
+                  tree=ast.parse(source, filename=rel),
+                  suppressions=Suppressions.parse(source))
+
+
+def iter_modules(root: pathlib.Path,
+                 repo_root: pathlib.Path = REPO) -> List[Module]:
+    modules = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            rel = str(path.relative_to(repo_root))
+        except ValueError:
+            rel = str(path)
+        modules.append(parse_source(path.read_text(), rel))
+    return modules
+
+
+class LintPass:
+    """One named check. ``run`` yields raw findings; the runner applies
+    suppressions, so passes never need to know about them."""
+
+    name = "base"
+    description = ""
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def run_passes(modules: Iterable[Module],
+               passes: Sequence[LintPass]) -> List[Finding]:
+    findings = []
+    for module in modules:
+        for p in passes:
+            for f in p.run(module):
+                if not module.suppressions.allows(
+                        f.rule, f.lineno, f.end_lineno):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.lineno, f.rule, f.message))
+    return findings
+
+
+# -- shared AST helpers ----------------------------------------------------
+
+def dotted_name(expr: ast.expr) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains; None for anything dynamic."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+# -- reporting -------------------------------------------------------------
+
+def format_human(findings: Sequence[Finding]) -> str:
+    lines = [str(f) for f in findings]
+    lines.append(f"{len(findings)} violation(s)" if findings else "clean")
+    return "\n".join(lines)
+
+
+def to_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {"violations": [f.as_dict() for f in findings],
+         "count": len(findings)},
+        indent=2, sort_keys=True)
+
+
+def main_for(passes: Sequence[LintPass], argv: Optional[Sequence[str]],
+             default_root: pathlib.Path = PACKAGE,
+             prog: str = "lint") -> int:
+    """Shared CLI: ``<tool> [root] [--json]``; exit 1 on findings."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog=prog)
+    parser.add_argument("root", nargs="?", default=str(default_root))
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    args = parser.parse_args(argv)
+    findings = run_passes(iter_modules(pathlib.Path(args.root)), passes)
+    if args.json:
+        print(to_json(findings))
+    else:
+        print(format_human(findings),
+              file=sys.stderr if findings else sys.stdout)
+    return 1 if findings else 0

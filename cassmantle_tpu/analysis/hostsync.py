@@ -1,0 +1,224 @@
+"""Host-sync pass: stray device→host synchronization in the hot path.
+
+Two contexts, one rule (``host-sync``):
+
+1. **Inside jit regions** — functions compiled by ``jax.jit`` (directly
+   decorated, passed to ``jax.jit(...)`` / ``partial(jax.jit, ...)`` /
+   ``dp_sharded_sampler(...)``, or reachable from one through
+   same-module calls). ``float()``/``int()`` on arrays, ``.item()``,
+   ``np.asarray``, ``jax.device_get`` and ``block_until_ready`` there
+   are at best silent constant-folds and at worst trace errors.
+
+2. **Inside loops of host-side serving/ops code** — the serialization
+   hazard the DDIM/decode paths live or die by: one sync per loop
+   iteration (``int(gen_len[i])`` per row, ``np.asarray(x)`` per chunk)
+   turns a single batched device round-trip into N sequential ones.
+   Syncs *outside* loops are the normal "collect the result once"
+   boundary and stay unflagged.
+
+``float()``/``int()`` are only flagged on bare-name / subscript
+arguments (``float(x)``, ``int(lens[i])``) — attribute chains and call
+results (``float(self.cfg...)``, ``int(os.environ.get(...))``) are
+config/host reads, not array syncs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    dotted_name,
+)
+
+RULE = "host-sync"
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_JIT_WRAPPERS = {"dp_sharded_sampler"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+# the serving pipelines + device ops — where a stray sync serializes
+# the DDIM loop (engine/server host code syncs at will)
+REPO_DIRS = ("cassmantle_tpu/ops/", "cassmantle_tpu/serving/")
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+          ast.DictComp, ast.GeneratorExp)
+
+
+def _sync_reason(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last == "item" and not node.args:
+        return ".item() forces a device->host sync"
+    if name in ("np.asarray", "numpy.asarray", "np.array", "numpy.array"):
+        return f"{name}() on a device value forces a device->host sync"
+    if last == "device_get":
+        return "device_get() forces a device->host sync"
+    if last == "block_until_ready":
+        return "block_until_ready() waits on in-flight device work"
+    if name in ("float", "int") and len(node.args) == 1 \
+            and not node.keywords \
+            and isinstance(node.args[0], (ast.Name, ast.Subscript)):
+        return f"{name}() on an array value forces a device->host sync"
+    return None
+
+
+class HostSyncPass(LintPass):
+    name = "host-sync"
+    description = ("device->host syncs inside jit regions and inside "
+                   "loops of serving/ops hot paths")
+
+    def __init__(self, dirs: Optional[Sequence[str]] = None) -> None:
+        self.dirs = tuple(dirs) if dirs else None
+
+    @classmethod
+    def for_repo(cls) -> "HostSyncPass":
+        return cls(dirs=REPO_DIRS)
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if self.dirs and not any(module.rel.startswith(d)
+                                 for d in self.dirs):
+            return
+        fns = self._function_table(module.tree)
+        jit_fns = self._jit_closure(module.tree, fns)
+        seen: Set[int] = set()
+        for qual, fn in fns.items():
+            if id(fn) in seen:  # bare-name alias of a method entry
+                continue
+            seen.add(id(fn))
+            if fn in jit_fns:
+                yield from self._scan(fn, module,
+                                      f"inside jit-compiled {qual!r}",
+                                      loops_only=False)
+            else:
+                yield from self._scan(fn, module,
+                                      f"inside a loop in {qual!r} (one "
+                                      f"sync per iteration serializes "
+                                      f"the device pipeline — hoist it "
+                                      f"out of the loop)",
+                                      loops_only=True)
+
+    # -- jit-region discovery ---------------------------------------------
+
+    @staticmethod
+    def _function_table(tree: ast.Module) -> Dict[str, ast.AST]:
+        """qual -> node for top-level functions and methods; bare method
+        names are also keyed (for ``self.X`` / ``jax.jit(self.X)``
+        resolution) when unambiguous enough — first definition wins."""
+        fns: Dict[str, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fns.setdefault(f"{node.name}.{sub.name}", sub)
+                        fns.setdefault(sub.name, sub)
+        return fns
+
+    @staticmethod
+    def _target_names(expr: ast.expr) -> List[str]:
+        """Function names referenced by a jit(...) argument: a bare
+        name, a ``self.X`` attribute, or either inside ``partial``."""
+        if isinstance(expr, ast.Name):
+            return [expr.id]
+        if isinstance(expr, ast.Attribute):
+            return [expr.attr]
+        if isinstance(expr, ast.Call) and \
+                call_name(expr) in _PARTIAL_NAMES and expr.args:
+            return HostSyncPass._target_names(expr.args[0])
+        return []
+
+    def _jit_entries(self, tree: ast.Module,
+                     fns: Dict[str, ast.AST]) -> Set[ast.AST]:
+        entries: Set[ast.AST] = set()
+        # decorated: @jax.jit / @jax.jit(...) / @partial(jax.jit, ...)
+        for fn in set(fns.values()):
+            for dec in getattr(fn, "decorator_list", ()):
+                names = []
+                if isinstance(dec, ast.Call):
+                    dec_name = call_name(dec)
+                    if dec_name in _JIT_NAMES:
+                        names = ["<self>"]
+                    elif dec_name in _PARTIAL_NAMES and dec.args and \
+                            dotted_name(dec.args[0]) in _JIT_NAMES:
+                        names = ["<self>"]
+                elif dotted_name(dec) in _JIT_NAMES:
+                    names = ["<self>"]
+                if names:
+                    entries.add(fn)
+        # passed: jax.jit(f) / jax.jit(partial(f, ...)) /
+        # dp_sharded_sampler(self._sample_impl, ...)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in _JIT_NAMES and \
+                    (name or "").rsplit(".", 1)[-1] not in _JIT_WRAPPERS:
+                continue
+            if not node.args:
+                continue
+            for target in self._target_names(node.args[0]):
+                if target in fns:
+                    entries.add(fns[target])
+        return entries
+
+    def _jit_closure(self, tree: ast.Module,
+                     fns: Dict[str, ast.AST]) -> Set[ast.AST]:
+        """Entries plus same-module functions they (transitively) call
+        — a helper called from a jit body runs traced too."""
+        closure = set(self._jit_entries(tree, fns))
+        queue = list(closure)
+        while queue:
+            fn = queue.pop()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                target = None
+                if isinstance(f, ast.Name) and f.id in fns:
+                    target = fns[f.id]
+                elif (isinstance(f, ast.Attribute)
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in ("self", "cls")
+                      and f.attr in fns):
+                    target = fns[f.attr]
+                if target is not None and target not in closure:
+                    closure.add(target)
+                    queue.append(target)
+        return closure
+
+    # -- scanning ----------------------------------------------------------
+
+    def _scan(self, fn: ast.AST, module: Module, context: str,
+              loops_only: bool) -> Iterator[Finding]:
+        findings: List[Finding] = []
+
+        def scan(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                if loops_only:
+                    return  # nested defs get their own host-side scan
+                # inside a jit region, nested closures run traced
+            if isinstance(node, _LOOPS):
+                in_loop = True
+            if isinstance(node, ast.Call):
+                reason = _sync_reason(node)
+                if reason is not None and (in_loop or not loops_only):
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        f"{reason} {context}",
+                        getattr(node, "end_lineno", None)))
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_loop)
+
+        for stmt in fn.body:
+            scan(stmt, in_loop=False)
+        yield from findings

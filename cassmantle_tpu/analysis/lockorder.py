@@ -1,0 +1,351 @@
+"""Lock-discipline pass: acquisition-order cycles, locks held across
+``await``, locks held across known-blocking calls.
+
+This is the static half of the defense against the PR 1 deadlock class
+(two call paths acquiring the same pair of locks in opposite order hung
+the backend under 3 concurrent round generations; the runtime half is
+``utils/locks.OrderedLock``). Per module it:
+
+1. extracts every lock attribute — ``self.X = threading.Lock() /
+   RLock() / Condition()`` or ``OrderedLock(...)`` inside a class, and
+   the same at module level;
+2. walks each top-level function / method tracking the *statically
+   nested* ``with <lock>:`` stack, recording a directed edge
+   ``held -> acquired`` for every nested acquisition — including
+   **inter-procedural** nesting through same-module calls (``self.m()``
+   and bare-name calls) via a transitive acquires fixpoint;
+3. fails on cycles in that graph (``lock-order-cycle``), on ``await``
+   under a held lock (``lock-across-await`` — the event loop stalls
+   every other coroutine needing the lock), and on known-blocking calls
+   under a held lock (``lock-blocking-call`` — ``time.sleep``, unbounded
+   ``.result()/.get()/.wait()/.join()``, ``block_until_ready`` /
+   ``jax.device_get`` device syncs).
+
+Known limits (documented in docs/STATIC_ANALYSIS.md): analysis is
+per-module; calls through non-``self`` receivers and property reads are
+not resolved; ``.acquire()``/``.release()`` outside ``with`` are not
+tracked. Reentrant kinds (RLock/Condition) do not self-deadlock, so
+self-edges on them are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+)
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+    "OrderedLock", "locks.OrderedLock",
+}
+_REENTRANT_CTORS = {
+    "threading.RLock", "RLock", "threading.Condition", "Condition",
+}
+
+RULE_CYCLE = "lock-order-cycle"
+RULE_AWAIT = "lock-across-await"
+RULE_BLOCKING = "lock-blocking-call"
+
+
+def blocking_wait_reason(node: ast.Call) -> Optional[str]:
+    """Why this call is a known-blocking wait, or None. Shared with the
+    blocking-in-async pass. Zero-arg ``.result()/.get()/.wait()/.join()``
+    are unbounded waits (dict.get etc. always take arguments)."""
+    name = call_name(node)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if name == "time.sleep":
+        return "time.sleep() blocks the thread"
+    if last == "block_until_ready":
+        return "block_until_ready() waits on in-flight device work"
+    if last == "device_get":
+        return "device_get() forces a device->host sync"
+    if last in ("result", "get", "wait", "join") \
+            and not node.args and not node.keywords:
+        return f".{last}() with no timeout is an unbounded blocking wait"
+    return None
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    qual: str
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    # (callee_qual, locks held at the call site, lineno)
+    calls: List[Tuple[str, Tuple[str, ...], int]] = \
+        dataclasses.field(default_factory=list)
+    # direct nested acquisitions: (held, acquired, lineno)
+    edges: List[Tuple[str, str, int]] = dataclasses.field(default_factory=list)
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+
+
+class LockOrderPass(LintPass):
+    name = "lock-order"
+    description = ("lock acquisition-order cycles, locks held across "
+                   "await, locks held across blocking calls")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        locks = self._collect_locks(module.tree)
+        if not locks:
+            return
+        infos = self._analyze_functions(module, locks)
+        for info in infos.values():
+            yield from info.findings
+        yield from self._cycle_findings(module, locks, infos)
+
+    # -- lock + function discovery ----------------------------------------
+
+    @staticmethod
+    def _lock_kind(value: ast.expr) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            ctor = call_name(value)
+            if ctor in _LOCK_CTORS:
+                return ("reentrant" if ctor in _REENTRANT_CTORS
+                        else "exclusive")
+        return None
+
+    def _collect_locks(self, tree: ast.Module) -> Dict[Tuple[Optional[str],
+                                                             str], str]:
+        """(class or None, attr) -> kind, for every lock-typed attribute
+        assignment anywhere in the module."""
+        locks: Dict[Tuple[Optional[str], str], str] = {}
+
+        def visit_assign(node: ast.Assign, cls: Optional[str]) -> None:
+            kind = self._lock_kind(node.value)
+            if kind is None:
+                return
+            for target in node.targets:
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self" and cls is not None):
+                    locks[(cls, target.attr)] = kind
+                elif isinstance(target, ast.Name) and cls is None:
+                    locks[(None, target.id)] = kind
+
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                visit_assign(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        visit_assign(sub, node.name)
+        return locks
+
+    @staticmethod
+    def _functions(tree: ast.Module):
+        """Yield (class_name or None, function node) for top-level
+        functions and methods."""
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield None, node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield node.name, sub
+
+    # -- per-function scan -------------------------------------------------
+
+    def _analyze_functions(self, module: Module,
+                           locks) -> Dict[str, _FnInfo]:
+        fn_names: Set[str] = set()
+        fns = list(self._functions(module.tree))
+        for cls, fn in fns:
+            fn_names.add(f"{cls}.{fn.name}" if cls else fn.name)
+        infos: Dict[str, _FnInfo] = {}
+        for cls, fn in fns:
+            qual = f"{cls}.{fn.name}" if cls else fn.name
+            info = _FnInfo(qual=qual)
+            self._scan(fn.body, [], module, cls, locks, fn_names, info)
+            infos[qual] = info
+        return infos
+
+    def _resolve_lock(self, expr: ast.expr, cls: Optional[str],
+                      locks) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and cls is not None
+                and (cls, expr.attr) in locks):
+            return f"{cls}.{expr.attr}"
+        if isinstance(expr, ast.Name) and (None, expr.id) in locks:
+            return expr.id
+        return None
+
+    @staticmethod
+    def _resolve_callee(node: ast.Call, cls: Optional[str],
+                        fn_names: Set[str]) -> Optional[str]:
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls") and cls is not None):
+            qual = f"{cls}.{f.attr}"
+            return qual if qual in fn_names else None
+        if isinstance(f, ast.Name) and f.id in fn_names:
+            return f.id
+        return None
+
+    def _scan(self, nodes, held: List[str], module: Module,
+              cls: Optional[str], locks, fn_names: Set[str],
+              info: _FnInfo) -> None:
+        for node in nodes if isinstance(nodes, list) else [nodes]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # nested definitions execute elsewhere
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    lock = self._resolve_lock(item.context_expr, cls, locks)
+                    if lock is not None:
+                        for h in held:
+                            info.edges.append((h, lock,
+                                               item.context_expr.lineno))
+                        info.acquires.add(lock)
+                        held.append(lock)
+                        pushed += 1
+                    else:
+                        self._scan(item.context_expr, held, module, cls,
+                                   locks, fn_names, info)
+                self._scan(node.body, held, module, cls, locks, fn_names,
+                           info)
+                for _ in range(pushed):
+                    held.pop()
+                continue
+            if isinstance(node, ast.Await):
+                if held:
+                    info.findings.append(Finding(
+                        RULE_AWAIT, module.rel, node.lineno,
+                        f"await while holding lock {held[-1]!r} in "
+                        f"{info.qual}: every coroutine needing the lock "
+                        f"stalls until this resumes",
+                        getattr(node, "end_lineno", None)))
+                value = node.value
+                if isinstance(value, ast.Call):
+                    # the awaited call itself yields; its arguments may
+                    # still hide blocking calls
+                    self._scan(list(ast.iter_child_nodes(value)), held,
+                               module, cls, locks, fn_names, info)
+                else:
+                    self._scan(value, held, module, cls, locks, fn_names,
+                               info)
+                continue
+            if isinstance(node, ast.Call):
+                if held:
+                    reason = blocking_wait_reason(node)
+                    if reason is not None:
+                        info.findings.append(Finding(
+                            RULE_BLOCKING, module.rel, node.lineno,
+                            f"{reason} while holding lock {held[-1]!r} "
+                            f"in {info.qual}",
+                            getattr(node, "end_lineno", None)))
+                callee = self._resolve_callee(node, cls, fn_names)
+                if callee is not None:
+                    info.calls.append((callee, tuple(held), node.lineno))
+                self._scan(list(ast.iter_child_nodes(node)), held, module,
+                           cls, locks, fn_names, info)
+                continue
+            self._scan(list(ast.iter_child_nodes(node)), held, module, cls,
+                       locks, fn_names, info)
+
+    # -- inter-procedural graph + cycles ----------------------------------
+
+    def _cycle_findings(self, module: Module, locks,
+                        infos: Dict[str, _FnInfo]) -> Iterator[Finding]:
+        # transitive acquires fixpoint over same-module calls
+        acq = {q: set(i.acquires) for q, i in infos.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, info in infos.items():
+                for callee, _, _ in info.calls:
+                    extra = acq.get(callee, ())
+                    if not set(extra) <= acq[q]:
+                        acq[q] |= set(extra)
+                        changed = True
+        # edge set: direct nesting + held-at-call -> callee's acquires
+        edges: Dict[Tuple[str, str], str] = {}
+        kinds = {(f"{c}.{a}" if c else a): k for (c, a), k in locks.items()}
+        for q, info in infos.items():
+            for a, b, lineno in info.edges:
+                edges.setdefault((a, b), f"{module.rel}:{lineno} ({q})")
+            for callee, held, lineno in info.calls:
+                for b in acq.get(callee, ()):
+                    for a in held:
+                        edges.setdefault(
+                            (a, b),
+                            f"{module.rel}:{lineno} ({q} -> {callee})")
+        lines = {}
+        for (a, b), site in edges.items():
+            lines[(a, b)] = int(site.split(":")[1].split(" ")[0])
+        yield from self._emit_cycles(module, edges, lines, kinds)
+
+    def _emit_cycles(self, module: Module, edges, lines,
+                     kinds) -> Iterator[Finding]:
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        reported: Set[frozenset] = set()
+        for (a, b) in sorted(edges):
+            if a == b:
+                if kinds.get(a) == "reentrant":
+                    continue
+                key = frozenset((a,))
+                if key in reported:
+                    continue
+                reported.add(key)
+                yield Finding(
+                    RULE_CYCLE, module.rel, lines[(a, b)],
+                    f"lock {a!r} re-acquired while already held "
+                    f"(self-deadlock for a non-reentrant lock) at "
+                    f"{edges[(a, b)]}")
+                continue
+            path = self._find_path(adj, b, a)
+            if path is None:
+                continue
+            cycle = [a] + path  # a, b, ..., a — already closed
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            hops = []
+            for x, y in zip(cycle, cycle[1:]):
+                hops.append(f"{x} -> {y} at {edges.get((x, y), '?')}")
+            yield Finding(
+                RULE_CYCLE, module.rel, lines[(a, b)],
+                "lock acquisition-order cycle (deadlock under "
+                "concurrency): " + "; ".join(hops))
+
+    @staticmethod
+    def _find_path(adj, src: str, dst: str) -> Optional[List[str]]:
+        """BFS path src..dst (inclusive) or None."""
+        parents: Dict[str, Optional[str]] = {src: None}
+        queue = [src]
+        while queue:
+            node = queue.pop(0)
+            if node == dst:
+                path = []
+                cur: Optional[str] = node
+                while cur is not None:
+                    path.append(cur)
+                    cur = parents[cur]
+                return list(reversed(path))
+            for nxt in adj.get(node, ()):
+                if nxt not in parents:
+                    parents[nxt] = node
+                    queue.append(nxt)
+        return None
+
+
+def default_passes() -> Sequence[LintPass]:
+    """The concurrency pass set ``tools/check_concurrency.py`` runs."""
+    from cassmantle_tpu.analysis.asyncblock import AsyncBlockingPass
+    from cassmantle_tpu.analysis.hostsync import HostSyncPass
+
+    return (LockOrderPass(), AsyncBlockingPass.for_repo(),
+            HostSyncPass.for_repo())

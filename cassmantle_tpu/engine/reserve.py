@@ -21,6 +21,14 @@ Living in the store (not process memory) keeps the two store properties
 the engine is built on: reserve rounds survive worker restarts, and in a
 multi-worker fleet every worker draws from (and play-stamps) one shared
 rotation instead of N private ones.
+
+Concurrency contract (docs/STATIC_ANALYSIS.md lock hierarchy): the
+reserve holds **no thread locks of its own** — ``archive`` runs after
+generation under the buffer/startup store locks and ``pick`` runs under
+the promotion store lock (level 0 of the hierarchy, the cross-worker
+TTL locks), and every slot write is a single atomic store command. Any
+future in-process caching here must take an ``OrderedLock`` ranked
+inside the store-lock tier per that table.
 """
 
 from __future__ import annotations

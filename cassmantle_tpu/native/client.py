@@ -65,8 +65,14 @@ def _binary_runs() -> bool:
 def ensure_built() -> Optional[str]:
     """Build the server if needed; returns binary path or None. A
     present-but-unrunnable binary (toolchain mismatch with the build
-    host) rebuilds from source like a missing one."""
-    if os.path.exists(BINARY) and _binary_runs():
+    host) rebuilds from source like a missing one, and so does a binary
+    older than mantlestore.cc (a stale build would silently drop source
+    fixes — e.g. the lock-tombstone sweep semantics)."""
+    source = os.path.join(NATIVE_DIR, "mantlestore.cc")
+    runnable = os.path.exists(BINARY) and _binary_runs()
+    stale = runnable and os.path.exists(source) and \
+        os.path.getmtime(source) > os.path.getmtime(BINARY)
+    if runnable and not stale:
         return BINARY
     try:
         subprocess.run(
@@ -75,6 +81,13 @@ def ensure_built() -> Optional[str]:
         )
         return BINARY if os.path.exists(BINARY) else None
     except Exception as exc:  # no toolchain: callers fall back to memory
+        if runnable:
+            # a stale-but-runnable binary beats no store at all (git
+            # checkouts don't preserve mtimes; a toolchain-less deploy
+            # host must keep using the prebuilt binary)
+            log.warning("mantlestore rebuild failed (%s); using the "
+                        "existing binary despite newer source", exc)
+            return BINARY
         log.warning("mantlestore build failed: %s", exc)
         return None
 

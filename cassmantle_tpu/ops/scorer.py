@@ -79,6 +79,7 @@ class EmbeddingScorer:
             toks = self.tokenizer.encode(text)[: self.seq_len]
             if not toks:
                 toks = [self.tokenizer.pad_id]
+            # lint: ignore[host-sync] — toks is a host token list, not a device array
             ids[i, : len(toks)] = np.asarray(toks, dtype=np.int32) % (
                 self.cfg.vocab_size
             )
@@ -102,6 +103,7 @@ class EmbeddingScorer:
                 emb = self._encode(self.params, jnp.asarray(ids),
                                    jnp.asarray(mask))
                 sink.append(emb)
+            # lint: ignore[host-sync] — one sync per dispatched chunk, not per text
             out_chunks.append(np.asarray(emb)[: len(chunk)])
         metrics.inc("scorer.texts", n)
         return np.concatenate(out_chunks, axis=0)
@@ -129,6 +131,7 @@ class EmbeddingScorer:
         emb = self.embed([word] + list(candidates))
         sims = emb[1:] @ emb[0]
         order = np.argsort(-sims)[:top_k]
+        # lint: ignore[host-sync] — sims is a host np array (embed returns host)
         return [(candidates[i], float(sims[i])) for i in order]
 
     async def similarity_async(self, pairs) -> np.ndarray:

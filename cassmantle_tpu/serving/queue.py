@@ -40,6 +40,7 @@ from typing import Callable, Generic, List, Optional, Sequence, TypeVar
 
 from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.obs.trace import current_ctx, run_with_ctx, tracer
+from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 T = TypeVar("T")
@@ -82,7 +83,9 @@ class _DispatchWorker:
     a fresh thread, without ever pinning process exit."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # docs/STATIC_ANALYSIS.md hierarchy: worker bookkeeping nests
+        # inside nothing and may (in principle) precede supervisor state
+        self._lock = OrderedLock("queue.dispatch_worker", rank=20)
         self._jobs: Optional[_thread_queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -439,20 +442,35 @@ class BatchingQueue(Generic[T, R]):
     async def _await_dispatch(self, wrapped: asyncio.Future,
                               started: "threading.Event"):
         """Await the dispatched batch, raising _HandlerWedged only when
-        THIS handler has been running past the hang deadline. Time spent
+        THIS handler has been RUNNING past the hang deadline. Time spent
         merely queued behind another queue's dispatch on the shared
-        thread never counts: that dispatch's own watchdog replaces the
-        wedged thread, and replace() moves unstarted jobs (this one) onto
-        the fresh thread."""
+        thread never counts: the hang clock arms only once ``started``
+        is observed set, so a handler that began late (behind a slow but
+        healthy neighbor) gets its full budget — declaring it wedged at
+        the first window expiry would fail the batch, flip the
+        supervisor degraded, and disown a healthy in-flight device call.
+        (A genuinely queued-forever job is bounded elsewhere: the
+        neighbor's own watchdog replaces the wedged thread and
+        replace() moves unstarted jobs onto the fresh one, and every
+        submission carries its per-request deadline.)"""
         if self.hang_timeout_s is None:
             return await wrapped
+        loop = asyncio.get_running_loop()
+        hang_deadline = None   # armed when the handler is seen running
         while True:
-            done, _ = await asyncio.wait({wrapped},
-                                         timeout=self.hang_timeout_s)
-            if done:
-                return wrapped.result()   # re-raises handler exceptions
-            if started.is_set():
+            if hang_deadline is None and started.is_set():
+                hang_deadline = loop.time() + self.hang_timeout_s
+            if hang_deadline is not None and \
+                    loop.time() >= hang_deadline:
                 raise _HandlerWedged()
+            timeout = (self.hang_timeout_s if hang_deadline is None
+                       else hang_deadline - loop.time())
+            done, _ = await asyncio.wait({wrapped}, timeout=timeout)
+            if done:
+                # asyncio.wait just completed this future, so .result()
+                # returns immediately (re-raising handler exceptions)
+                # lint: ignore[async-blocking-call] — future already done
+                return wrapped.result()
 
     @staticmethod
     def _disown(wrapped: asyncio.Future) -> None:

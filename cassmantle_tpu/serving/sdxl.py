@@ -190,9 +190,9 @@ class SDXLPipeline:
         # one in-flight device batch per pipeline (see Text2ImagePipeline:
         # concurrent executions of one compiled computation have
         # deadlocked the CPU backend under some jaxlib builds)
-        import threading
+        from cassmantle_tpu.utils.locks import OrderedLock
 
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = OrderedLock("pipeline.sdxl_dispatch", rank=11)
 
     # -- conditioning ------------------------------------------------------
 
@@ -268,6 +268,7 @@ class SDXLPipeline:
         # metric + device-synchronized trace span in one
         with self._dispatch_lock, block_timer("pipeline.sdxl_s"):
             images = self._sample(self._params, ids, uncond, rng)
+            # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.sdxl_images", n)
         return np.asarray(images[:n])

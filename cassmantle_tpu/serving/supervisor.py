@@ -23,12 +23,12 @@ game from the store and must NOT be killed by a liveness probe.
 from __future__ import annotations
 
 import asyncio
-import threading
 import time
 from typing import Callable, Dict, Optional
 
 from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.utils.circuit import OPEN, CircuitBreaker
+from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("supervisor")
@@ -52,7 +52,9 @@ class ServingSupervisor:
         # set by server/app.py when real-device serving wires DeviceHealth
         self.device_health = device_health
         self.degraded_cooldown_s = degraded_cooldown_s
-        self._lock = threading.Lock()
+        # rank per the docs/STATIC_ANALYSIS.md lock hierarchy: supervisor
+        # state is leaf-ward of the dispatch locks, outward of breakers
+        self._lock = OrderedLock("supervisor", rank=30)
         self._degraded_until = 0.0
         self._overruns = 0
 

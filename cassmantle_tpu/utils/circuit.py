@@ -21,11 +21,11 @@ while the scorer path records from request handlers.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Callable, Deque, Dict
 
+from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("circuit")
@@ -64,7 +64,10 @@ class CircuitBreaker:
         self.window_s = window_s
         self.reset_timeout_s = reset_timeout_s
         self.clock = clock
-        self._lock = threading.Lock()
+        # innermost tier of the docs/STATIC_ANALYSIS.md lock hierarchy:
+        # breaker state may be read under the supervisor lock, never the
+        # other way around
+        self._lock = OrderedLock(f"circuit.{name}", rank=40)
         self._state = CLOSED
         self._failures: Deque[float] = deque()
         self._opened_at = 0.0

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Optional
 
+from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("health")
@@ -70,7 +71,9 @@ class DeviceHealth:
     def __init__(self, timeout_s: float = 10.0, cache_s: float = 15.0):
         self.timeout_s = timeout_s
         self.cache_s = cache_s
-        self._lock = threading.Lock()
+        # leaf tier of the docs/STATIC_ANALYSIS.md lock hierarchy: the
+        # probe cache nests inside anything, holds nothing else
+        self._lock = OrderedLock("health.device", rank=50)
         self._healthy: Optional[bool] = None
         self._checked_at = 0.0
         self._inflight: Optional[_Probe] = None

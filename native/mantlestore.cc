@@ -107,7 +107,11 @@ class Store {
         ++it;
     }
     for (auto it = locks_.begin(); it != locks_.end();) {
-      if (t >= it->second.deadline)
+      // keep expired entries for a grace period: LOCK already treats
+      // them as acquirable, and the tombstone is what lets the owner's
+      // late UNLOCK report :2 (overrun) instead of :0 — sweeping at the
+      // deadline made that hazard verdict race the 1 Hz sweep
+      if (t >= it->second.deadline + 60.0)
         it = locks_.erase(it);
       else
         ++it;

@@ -58,7 +58,8 @@ def pytest_configure(config):
 # host; see ROADMAP.md for the tier commands.
 
 FAST_MODULES = frozenset({
-    "test_aux", "test_bench_harness", "test_check_metrics", "test_eval",
+    "test_aux", "test_bench_harness", "test_check_concurrency",
+    "test_check_metrics", "test_eval",
     "test_fault_injection",
     "test_flash_attention", "test_frontend", "test_fused_conv",
     "test_game", "test_js_runtime", "test_layers_norm", "test_masking",
@@ -93,6 +94,24 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.fast)
         if name in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+
+
+@pytest.fixture(autouse=True)
+def _lock_sentinel():
+    """Arm the OrderedLock deadlock sentinel (utils/locks.py) in raising
+    mode for EVERY test: any hierarchy/order violation a test drives
+    through the converted serving locks (queue, supervisor, breakers,
+    pipeline dispatch) fails that test with both acquisition sites —
+    the fast tier doubles as a runtime deadlock sentinel. The observed-
+    order graph resets per test so unrelated tests' acquisition orders
+    can't combine into a phantom inversion."""
+    from cassmantle_tpu.utils import locks
+
+    locks.reset_observations()
+    locks.enable_sentinel(raise_on_violation=True)
+    yield
+    locks.disable_sentinel()
+    locks.reset_observations()
 
 
 @pytest.fixture(scope="session")

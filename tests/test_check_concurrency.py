@@ -1,0 +1,505 @@
+"""Concurrency lint + runtime deadlock-sentinel gate (fast tier).
+
+Golden fixture snippets pin each rule of the three
+``cassmantle_tpu/analysis`` concurrency passes (known violations must
+fail; suppressed / executor-routed / consistently-ordered variants must
+pass), the repo itself must lint clean through the real entry points
+(``tools/check_concurrency.py``, ``tools/lint_all.py``), and the
+``utils/locks.OrderedLock`` sentinel must raise on seeded inversions —
+including the PR 1 dispatch-deadlock shape, pinned here as a regression
+fixture for the static pass AND as a runtime cross-thread inversion.
+"""
+
+import textwrap
+import threading
+
+import pytest
+
+from cassmantle_tpu.analysis.asyncblock import AsyncBlockingPass
+from cassmantle_tpu.analysis.core import parse_source, run_passes
+from cassmantle_tpu.analysis.hostsync import HostSyncPass
+from cassmantle_tpu.analysis.lockorder import LockOrderPass
+from cassmantle_tpu.utils import locks
+from cassmantle_tpu.utils.locks import LockOrderViolation, OrderedLock
+
+
+def lint(src, *passes, rel="<fixture>"):
+    return run_passes([parse_source(textwrap.dedent(src), rel)],
+                      list(passes))
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- lock-order pass ---------------------------------------------------------
+
+def test_direct_lock_order_cycle_fails():
+    findings = lint("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def x(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def y(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, LockOrderPass())
+    assert rules(findings) == ["lock-order-cycle"]
+    assert "P._a" in findings[0].message and "P._b" in findings[0].message
+
+
+def test_pr1_dispatch_deadlock_shape_is_caught():
+    """Regression fixture: the PR 1 deadlock — two call paths acquiring
+    the pipeline/dispatch lock pair in opposite order, nested only
+    THROUGH method calls (inter-procedural), exactly how the real hang
+    hid from review."""
+    findings = lint("""
+        import threading
+
+        class Backend:
+            def __init__(self):
+                self._pipeline_lock = threading.Lock()
+                self._dispatch_lock = threading.Lock()
+
+            def generate(self):
+                with self._pipeline_lock:
+                    self._dispatch()
+
+            def _dispatch(self):
+                with self._dispatch_lock:
+                    pass
+
+            def score(self):
+                with self._dispatch_lock:
+                    self._finish()
+
+            def _finish(self):
+                with self._pipeline_lock:
+                    pass
+    """, LockOrderPass())
+    assert rules(findings) == ["lock-order-cycle"]
+    msg = findings[0].message
+    assert "Backend._pipeline_lock" in msg
+    assert "Backend._dispatch_lock" in msg
+
+
+def test_consistent_lock_order_is_clean():
+    findings = lint("""
+        import threading
+
+        class Backend:
+            def __init__(self):
+                self._pipeline_lock = threading.Lock()
+                self._dispatch_lock = threading.Lock()
+
+            def generate(self):
+                with self._pipeline_lock:
+                    self._dispatch()
+
+            def _dispatch(self):
+                with self._dispatch_lock:
+                    pass
+
+            def score(self):
+                with self._pipeline_lock:
+                    with self._dispatch_lock:
+                        pass
+    """, LockOrderPass())
+    assert findings == []
+
+
+def test_self_reacquire_through_helper_fails_for_lock_not_rlock():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._l = threading.{kind}()
+
+            def a(self):
+                with self._l:
+                    self.b()
+
+            def b(self):
+                with self._l:
+                    pass
+    """
+    bad = lint(src.format(kind="Lock"), LockOrderPass())
+    assert rules(bad) == ["lock-order-cycle"]
+    assert "re-acquired" in bad[0].message
+    assert lint(src.format(kind="RLock"), LockOrderPass()) == []
+
+
+def test_lock_across_await_fails():
+    findings = lint("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def run(self, thing):
+                with self._lock:
+                    await thing()
+    """, LockOrderPass())
+    assert rules(findings) == ["lock-across-await"]
+
+
+def test_lock_across_blocking_call_fails_and_suppression_passes():
+    src = """
+        import threading
+        import time
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self, fut):
+                with self._lock:
+                    time.sleep(1.0){sup}
+                    fut.result(){sup}
+    """
+    findings = lint(src.format(sup=""), LockOrderPass())
+    assert rules(findings) == ["lock-blocking-call", "lock-blocking-call"]
+    sup = "  # lint: ignore[lock-blocking-call] — fixture reason"
+    assert lint(src.format(sup=sup), LockOrderPass()) == []
+
+
+def test_bounded_wait_under_lock_is_clean():
+    findings = lint("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def run(self, fut):
+                with self._lock:
+                    fut.result(timeout=1.0)
+    """, LockOrderPass())
+    assert findings == []
+
+
+# -- blocking-call-in-async pass ---------------------------------------------
+
+def test_blocking_calls_in_async_fail():
+    findings = lint("""
+        import time
+        import requests
+
+        async def handler(fut, path):
+            time.sleep(1.0)
+            fut.result()
+            requests.get("http://x")
+            open(path).read()
+    """, AsyncBlockingPass())
+    assert rules(findings) == ["async-blocking-call"] * 4
+
+
+def test_awaited_and_executor_routed_variants_pass():
+    findings = lint("""
+        import asyncio
+        import time
+
+        async def handler(loop, cond, fut):
+            await asyncio.sleep(1.0)
+            await loop.run_in_executor(None, time.sleep, 1.0)
+            await asyncio.wait_for(cond.wait(), timeout=0.1)
+            fut.result(timeout=1.0)
+
+            def sync_helper():
+                time.sleep(1.0)  # runs on an executor thread
+
+            await loop.run_in_executor(None, sync_helper)
+    """, AsyncBlockingPass())
+    assert findings == []
+
+
+def test_async_suppression_and_dir_scoping():
+    src = """
+        import time
+
+        async def handler():
+            time.sleep(1.0)
+    """
+    scoped = AsyncBlockingPass.for_repo()
+    # outside the event-loop layers: not scanned
+    assert lint(src, scoped, rel="cassmantle_tpu/models/x.py") == []
+    # inside: scanned and failing
+    assert rules(lint(src, scoped,
+                      rel="cassmantle_tpu/server/x.py")) == \
+        ["async-blocking-call"]
+    sup = src.replace(
+        "time.sleep(1.0)",
+        "time.sleep(1.0)  # lint: ignore[async-blocking-call] — why")
+    assert lint(sup, scoped, rel="cassmantle_tpu/server/x.py") == []
+
+
+# -- host-sync pass ----------------------------------------------------------
+
+def test_sync_in_jit_region_fails():
+    findings = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return float(x)
+
+        def g(x):
+            return np.asarray(x)
+
+        g_jit = jax.jit(g)
+    """, HostSyncPass())
+    assert rules(findings) == ["host-sync", "host-sync"]
+
+
+def test_jit_detection_through_wrappers_and_transitive_calls():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        class Pipe:
+            def __init__(self, mesh):
+                self._sample = dp_sharded_sampler(self._sample_impl, mesh)
+                self._i2i = jax.jit(partial(self._img2img_impl, 1))
+
+            def _sample_impl(self, params, ids):
+                return self._helper(ids)
+
+            def _helper(self, ids):
+                return ids.item()
+
+            def _img2img_impl(self, k, lat):
+                return int(lat[0])
+    """, HostSyncPass())
+    assert rules(findings) == ["host-sync", "host-sync"]
+    assert any("_helper" in f.message for f in findings)
+    assert any("_img2img_impl" in f.message for f in findings)
+
+
+def test_sync_in_host_loop_fails_but_boundary_sync_passes():
+    findings = lint("""
+        import numpy as np
+
+        def stage(xs):
+            out = []
+            for x in xs:
+                out.append(np.asarray(x))   # one sync per iteration
+            return out
+
+        def boundary(x):
+            return np.asarray(x)            # the collect-once sync
+    """, HostSyncPass())
+    assert rules(findings) == ["host-sync"]
+    assert findings[0].message.startswith("np.asarray")
+
+
+def test_config_reads_in_jit_are_not_syncs():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(self, x):
+            s = float(self.cfg.sampler.image_size)
+            n = int(len(x))
+            return s, n
+    """, HostSyncPass())
+    assert findings == []
+
+
+def test_hostsync_suppression_above_line_passes():
+    findings = lint("""
+        import numpy as np
+
+        def stage(xs):
+            out = []
+            for x in xs:
+                # lint: ignore[host-sync] — fixture reason
+                out.append(np.asarray(x))
+            return out
+    """, HostSyncPass())
+    assert findings == []
+
+
+# -- the repo itself lints clean ---------------------------------------------
+
+def test_repo_is_concurrency_clean():
+    from tools.check_concurrency import check
+
+    assert check() == []
+
+
+def test_check_concurrency_cli_exits_zero():
+    from tools.check_concurrency import main
+
+    assert main([]) == 0
+
+
+def test_lint_all_runs_every_pass_with_one_exit_code(tmp_path):
+    from tools.lint_all import main
+
+    assert main([]) == 0
+    # one dirty tree -> nonzero: a bad metric name AND a lock cycle
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        metrics.inc("nosegments")
+
+        class P:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def x(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def y(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """))
+    assert main([str(tmp_path)]) == 1
+
+
+# -- OrderedLock runtime sentinel --------------------------------------------
+# (the autouse conftest fixture arms raising mode + resets the graph)
+
+def test_seeded_inversion_raises_with_both_sites():
+    a = OrderedLock("sentinel_a")
+    b = OrderedLock("sentinel_b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderViolation) as exc:
+            a.acquire()
+    assert "sentinel_a" in str(exc.value)
+    assert "sentinel_b" in str(exc.value)
+    assert "deadlock" in str(exc.value)
+    # the violating acquire did NOT take the lock: still free
+    assert not a.locked()
+
+
+def test_cross_thread_inversion_raises():
+    """The PR 1 shape at runtime: thread 1 nests pipeline->dispatch,
+    the main thread then nests dispatch->pipeline."""
+    pipeline = OrderedLock("t_pipeline")
+    dispatch = OrderedLock("t_dispatch")
+
+    def worker():
+        with pipeline:
+            with dispatch:
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    with dispatch:
+        with pytest.raises(LockOrderViolation):
+            pipeline.acquire()
+
+
+def test_rank_violation_raises_and_correct_order_passes():
+    outer = OrderedLock("t_outer", rank=10)
+    inner = OrderedLock("t_inner", rank=40)
+    with outer:
+        with inner:
+            pass
+    with inner:
+        with pytest.raises(LockOrderViolation) as exc:
+            outer.acquire()
+    assert "rank" in str(exc.value)
+
+
+def test_reacquire_raises():
+    lock = OrderedLock("t_reacquire")
+    with lock:
+        with pytest.raises(LockOrderViolation) as exc:
+            lock.acquire()
+    assert "re-acquire" in str(exc.value)
+    # release path stayed balanced: usable again
+    with lock:
+        pass
+
+
+def test_log_only_mode_counts_violations():
+    from cassmantle_tpu.utils.logging import metrics
+
+    locks.enable_sentinel(raise_on_violation=False)
+    a = OrderedLock("t_log_a")
+    b = OrderedLock("t_log_b")
+    with a:
+        with b:
+            pass
+    before = metrics.snapshot()["counters"].get(
+        "locks.order_violations", 0)
+    with b:
+        with a:  # inversion: logged + counted, not raised
+            pass
+    after = metrics.snapshot()["counters"]["locks.order_violations"]
+    assert after == before + 1
+
+
+def test_sentinel_disabled_skips_checks():
+    locks.disable_sentinel()
+    a = OrderedLock("t_off_a")
+    b = OrderedLock("t_off_b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass  # no raise, no tracking
+
+
+def test_production_locks_are_ordered_and_ranked():
+    """The converted supervisor/queue/circuit/health locks carry the
+    documented hierarchy (docs/STATIC_ANALYSIS.md), so the fault-
+    injection suite runs them all under the sentinel."""
+    from cassmantle_tpu.serving.queue import _DispatchWorker
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+    from cassmantle_tpu.utils.circuit import CircuitBreaker
+    from cassmantle_tpu.utils.health import DeviceHealth
+
+    ranked = {
+        _DispatchWorker()._lock: ("queue.dispatch_worker", 20),
+        ServingSupervisor()._lock: ("supervisor", 30),
+        CircuitBreaker("probe")._lock: ("circuit.probe", 40),
+        DeviceHealth()._lock: ("health.device", 50),
+    }
+    for lock, (name, rank) in ranked.items():
+        assert isinstance(lock, OrderedLock)
+        assert lock.name == name
+        assert lock.rank == rank
+    # strictly increasing leaf-ward: dispatch worker < supervisor <
+    # breaker < health cache
+    ranks = [rank for _, rank in ranked.values()]
+    assert ranks == sorted(ranks)
+
+
+def test_lock_hierarchy_documented():
+    import pathlib
+
+    doc = pathlib.Path(__file__).resolve().parents[1] / "docs" / \
+        "STATIC_ANALYSIS.md"
+    text = doc.read_text()
+    for name in ("pipeline.t2i_dispatch", "queue.dispatch_worker",
+                 "supervisor", "circuit.<name>", "health.device"):
+        assert name in text, f"lock {name} missing from hierarchy table"
+    for rule in ("lock-order-cycle", "lock-across-await",
+                 "lock-blocking-call", "async-blocking-call",
+                 "host-sync", "metric-name"):
+        assert rule in text, f"rule {rule} missing from catalog"

@@ -151,6 +151,39 @@ async def test_watchdog_ignores_queue_wait_behind_other_dispatch():
 
 
 @pytest.mark.asyncio
+async def test_watchdog_hang_clock_arms_at_handler_start_not_submit():
+    """A handler that STARTS late (behind another queue's slow-but-legit
+    dispatch) gets its full hang budget from the moment it runs: the
+    hang clock must arm at handler start, not at submit. Before the fix,
+    the first watchdog window expiring after the late start declared the
+    healthy handler wedged — failing the batch with DispatchTimeout and
+    disowning a healthy in-flight dispatch — even though it had run for
+    only a fraction of its budget."""
+    slow_started = threading.Event()
+
+    def slow_but_legit(items):
+        slow_started.set()
+        time.sleep(0.75)
+        return items
+
+    def healthy_but_late(items):
+        # runs 0.45s — inside the 0.5s hang budget from ITS start, but
+        # spanning the submit-relative window boundary at t=1.0
+        time.sleep(0.45)
+        return items
+
+    qa = BatchingQueue(slow_but_legit, max_delay_ms=1, name="slowq2")
+    qb = BatchingQueue(healthy_but_late, max_delay_ms=1,
+                       hang_timeout_s=0.5, name="lateq")
+    ta = asyncio.ensure_future(qa.submit("a"))
+    await asyncio.to_thread(slow_started.wait, 2.0)
+    assert await qb.submit("b") == "b"
+    assert await ta == "a"
+    await qa.stop()
+    await qb.stop()
+
+
+@pytest.mark.asyncio
 async def test_submit_deadline_fails_future_under_hung_handler():
     """A wedged handler (hung XLA call) must not hang submitters: the
     per-request deadline fails the future on time (acceptance criterion:

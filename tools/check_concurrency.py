@@ -1,0 +1,50 @@
+"""Concurrency lint gate: lock discipline, blocking-in-async, host-sync.
+
+Runs the three ``cassmantle_tpu/analysis`` concurrency passes over the
+package (rule catalog: ``docs/STATIC_ANALYSIS.md``):
+
+- ``lock-order-cycle`` / ``lock-across-await`` / ``lock-blocking-call``
+  — the static defense against the PR 1 dispatch-deadlock class;
+- ``async-blocking-call`` — blocking calls inside ``async def`` bodies
+  in the server/serving/engine event-loop layers;
+- ``host-sync`` — device→host syncs inside jit regions or inside loops
+  of serving/ops hot paths.
+
+Run standalone: ``python tools/check_concurrency.py [cassmantle_tpu/]
+[--json]`` (exit 1 on violations). Gated as a fast-tier test in
+``tests/test_check_concurrency.py``, so a reintroduced deadlock shape
+fails tier-1 before it ships.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from cassmantle_tpu.analysis.core import (  # noqa: E402
+    PACKAGE,
+    iter_modules,
+    main_for,
+    run_passes,
+)
+from cassmantle_tpu.analysis.lockorder import default_passes  # noqa: E402
+
+
+def check(root: pathlib.Path = PACKAGE) -> List[str]:
+    """All violations as human-readable strings; empty = clean."""
+    return [str(f) for f in
+            run_passes(iter_modules(root), default_passes())]
+
+
+def main(argv=None) -> int:
+    return main_for(default_passes(), argv, default_root=PACKAGE,
+                    prog="check_concurrency")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

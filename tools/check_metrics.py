@@ -1,163 +1,35 @@
 """Static metric-name lint: convention + docs-catalog coverage.
 
-Walks every module in ``cassmantle_tpu/`` for literal
-``metrics.inc/gauge/observe/timer`` names (plain strings and f-strings —
-interpolated segments become wildcards) and checks:
+Thin CLI shim: the pass itself lives on the shared lint framework in
+``cassmantle_tpu/analysis/metric_names.py`` (rules unchanged — dotted
+lowercase ``subsystem.metric`` names, histogram ``_s``/``_size``
+suffixes, every literal name present in the ``docs/OBSERVABILITY.md``
+catalog; f-string holes are wildcards). Drift fails tier-1
+(``tests/test_check_metrics.py``).
 
-1. **Convention** — dotted lowercase ``subsystem.metric`` names, at
-   least two segments, each ``[a-z0-9_]`` (or a dynamic wildcard);
-   histogram names (``observe``/``timer``) end ``_s`` (seconds) or
-   ``_size``.
-2. **Catalog coverage** — every name matches an entry in the metric
-   catalog in ``docs/OBSERVABILITY.md`` (entries use ``<x>``
-   placeholders for dynamic segments), so a new metric cannot ship
-   without operator documentation. Drift fails tier-1
-   (``tests/test_check_metrics.py``).
-
-Run standalone: ``python tools/check_metrics.py`` (exit 1 on violations).
+Run standalone: ``python tools/check_metrics.py [--json]`` (exit 1 on
+violations).
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
-from typing import List, Optional, Tuple
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-PACKAGE = REPO / "cassmantle_tpu"
-CATALOG_DOC = REPO / "docs" / "OBSERVABILITY.md"
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-_METHODS = {"inc", "gauge", "observe", "timer"}
-_SEGMENT = re.compile(r"^[a-z0-9_*]+$")
-_CATALOG_NAME = re.compile(r"`([a-z0-9_.<>*]+\.[a-z0-9_.<>*]+)`")
-
-
-def _literal_name(node: ast.expr) -> Optional[str]:
-    """The metric name as a pattern: f-string holes become ``*``.
-    None = not a literal (dynamic whole-name pass-through like
-    profiling.block_timer's ``name`` arg — its callers are linted)."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for value in node.values:
-            if isinstance(value, ast.Constant):
-                parts.append(str(value.value))
-            else:
-                parts.append("*")
-        return "".join(parts)
-    return None
-
-
-def extract_sites(source: str, path: str) -> List[Tuple[str, str, int]]:
-    """(name_pattern, method, lineno) for every literal metrics call —
-    ``metrics.inc/gauge/observe/timer(...)`` plus ``block_timer(...)``
-    (utils/profiling.py's metric-emitting stage timer, linted as an
-    ``observe`` so device-stage names can't drift off the catalog)."""
-    sites = []
-    tree = ast.parse(source, filename=path)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and node.args):
-            continue
-        if (isinstance(node.func, ast.Attribute)
-                and node.func.attr in _METHODS
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "metrics"):
-            method = node.func.attr
-        elif (isinstance(node.func, ast.Name)
-                and node.func.id == "block_timer"):
-            method = "observe"
-        else:
-            continue
-        name = _literal_name(node.args[0])
-        if name is not None:
-            sites.append((name, method, node.lineno))
-    return sites
-
-
-_WILD = "\x00"
-
-
-def _segments_match(code_seg: str, cat_seg: str) -> bool:
-    """Mutual-wildcard segment match: ``*`` in code (an interpolated
-    chunk) and ``<x>`` in the catalog both stand for any value. Both
-    sides normalize their wildcard to one token, then each side's
-    pattern is tried against the other's text."""
-    code_norm = code_seg.replace("*", _WILD)
-    cat_norm = re.sub(r"<[a-z0-9_]+>", _WILD, cat_seg)
-    cat_re = re.escape(cat_norm).replace(_WILD, ".+")
-    code_re = re.escape(code_norm).replace(_WILD, ".+")
-    return bool(re.fullmatch(cat_re, code_norm)
-                or re.fullmatch(code_re, cat_norm))
-
-
-def _name_matches(code_name: str, cat_name: str) -> bool:
-    code_segs = code_name.split(".")
-    cat_segs = cat_name.split(".")
-    if len(code_segs) != len(cat_segs):
-        return False
-    return all(_segments_match(c, k)
-               for c, k in zip(code_segs, cat_segs))
-
-
-def load_catalog() -> List[str]:
-    if not CATALOG_DOC.exists():
-        return []
-    return sorted(set(_CATALOG_NAME.findall(CATALOG_DOC.read_text())))
-
-
-def check() -> List[str]:
-    """All violations as human-readable strings; empty = clean."""
-    catalog = load_catalog()
-    violations = []
-    if not catalog:
-        violations.append(
-            f"{CATALOG_DOC}: metric catalog missing or empty")
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(REPO)
-        for name, method, lineno in extract_sites(path.read_text(),
-                                                  str(path)):
-            where = f"{rel}:{lineno}"
-            segs = name.split(".")
-            if len(segs) < 2:
-                violations.append(
-                    f"{where}: {name!r} needs >=2 dotted segments "
-                    f"(subsystem.metric)")
-                continue
-            bad = [s for s in segs if not _SEGMENT.match(s)]
-            if bad:
-                violations.append(
-                    f"{where}: {name!r} has non-[a-z0-9_] segment(s) "
-                    f"{bad}")
-                continue
-            if method in ("observe", "timer") and \
-                    not (segs[-1].endswith("_s")
-                         or segs[-1].endswith("_size")):
-                violations.append(
-                    f"{where}: histogram {name!r} must end _s "
-                    f"(seconds) or _size")
-                continue
-            if catalog and not any(_name_matches(name, entry)
-                                   for entry in catalog):
-                violations.append(
-                    f"{where}: {name!r} not in the "
-                    f"docs/OBSERVABILITY.md metric catalog")
-    return violations
-
-
-def main() -> int:
-    violations = check()
-    for v in violations:
-        print(v, file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} metric-name violation(s)",
-              file=sys.stderr)
-        return 1
-    print("metric names clean")
-    return 0
-
+from cassmantle_tpu.analysis.metric_names import (  # noqa: E402,F401
+    CATALOG_DOC,
+    PACKAGE,
+    _name_matches,
+    _SEGMENT,
+    check,
+    extract_sites,
+    load_catalog,
+    main,
+)
 
 if __name__ == "__main__":
     raise SystemExit(main())

@@ -1,0 +1,36 @@
+"""All static passes, one exit code: metrics + concurrency.
+
+The single CI/pre-commit gate: runs the metric-name pass
+(``tools/check_metrics.py``) and the three concurrency passes
+(``tools/check_concurrency.py``) over the package in one module walk,
+and exits 1 if any pass finds anything. Gated as a fast-tier test via
+``tests/test_check_concurrency.py``.
+
+Run standalone: ``python tools/lint_all.py [cassmantle_tpu/] [--json]``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from cassmantle_tpu.analysis.core import PACKAGE, main_for  # noqa: E402
+from cassmantle_tpu.analysis.lockorder import default_passes  # noqa: E402
+from cassmantle_tpu.analysis.metric_names import MetricNamePass  # noqa: E402
+
+
+def all_passes():
+    return [MetricNamePass(), *default_passes()]
+
+
+def main(argv=None) -> int:
+    return main_for(all_passes(), argv, default_root=PACKAGE,
+                    prog="lint_all")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
