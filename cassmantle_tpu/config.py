@@ -236,6 +236,37 @@ class SamplerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpecDecodeConfig:
+    """Speculative decoding for the prompt-LM serving decode
+    (ops/decode.py::speculative_decode): a draft proposes ``gamma``
+    tokens and the target scores all gamma+1 positions in one
+    ``decode_chunk`` forward, amortizing one full weight read over the
+    chunk — the step-count lever for the memory-bound greedy loop
+    (docs/PERF_NOTES.md "LM decode accounting").
+
+    Engages only when ``sampler.text_temperature == 0`` (greedy — the
+    reference's decode mode), where acceptance is exact argmax match and
+    output is bit-identical to the plain greedy scan
+    (tests/test_spec_decode.py). ``CASSMANTLE_NO_SPEC_DECODE=1`` is the
+    runtime kill switch (docs/DEPLOY.md §6)."""
+
+    # "off" | "ngram" (self-drafting prompt lookup, zero extra HBM) |
+    # "draft_model" (a smaller zoo LM with its own prefill/decode cache)
+    mode: str = "off"
+    # drafted tokens per verify chunk: each chunk commits 1..gamma+1
+    # tokens for one target forward of width gamma+1
+    gamma: int = 4
+    # suffix length for the "ngram" prompt-lookup draft
+    ngram: int = 3
+    # the "draft_model" draft: a smaller GPT-2-family config sharing the
+    # target's tokenizer/vocab (gpt2-small drafting for gpt2-large; its
+    # checkpoint loads from <weights_dir>/gpt2_draft.safetensors). When
+    # it EQUALS the target's gpt2 config the target's own params are
+    # reused (the self-draft degenerate, useful in tests).
+    draft_model: Optional[GPT2Config] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. Axes follow the scaling-book convention:
 
@@ -365,6 +396,8 @@ class FrameworkConfig:
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     game: GameConfig = dataclasses.field(default_factory=GameConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    spec_decode: SpecDecodeConfig = dataclasses.field(
+        default_factory=SpecDecodeConfig)
     quality: QualityGateConfig = dataclasses.field(
         default_factory=QualityGateConfig)
     seed: int = 0
@@ -429,6 +462,19 @@ def fusedconv_serving_config() -> FrameworkConfig:
     return base.replace(models=dataclasses.replace(
         base.models, unet=dataclasses.replace(
             base.models.unet, fused_conv=True, conv_pad_to=128)))
+
+
+def spec_decode_serving_config() -> FrameworkConfig:
+    """The default serving config with speculative decoding on for the
+    prompt LM, self-drafting n-gram mode (zero extra HBM, no draft
+    checkpoint needed — works in every deployment). Same decode output
+    as the plain config by construction (exact greedy acceptance); this
+    is the ON arm of the `gpt2_spec` bench A/B. Swap ``mode`` to
+    "draft_model" with a gpt2-small config to draft with a second zoo
+    LM instead."""
+
+    return FrameworkConfig(
+        spec_decode=SpecDecodeConfig(mode="ngram", gamma=4, ngram=3))
 
 
 def deepcache_serving_config() -> FrameworkConfig:

@@ -23,7 +23,11 @@ import jax
 import jax.numpy as jnp
 
 from cassmantle_tpu.config import GPT2Config
-from cassmantle_tpu.models.layers import MultiHeadAttention, TransformerMLP
+from cassmantle_tpu.models.layers import (
+    MultiHeadAttention,
+    TransformerMLP,
+    chunk_causal_mask,
+)
 
 
 class GPT2Block(nn.Module):
@@ -133,12 +137,40 @@ class GPT2LM(nn.Module):
         cache: Tuple,
         valid: jax.Array,      # (B, max_len) cache validity incl. this step
     ) -> Tuple[jax.Array, Tuple]:
-        """One greedy-decode step; returns (logits (B, V), updated cache)."""
-        x = self.wte(token[:, None]) + self.wpe(index[None, None])
-        mask = valid[:, None, None, :]
+        """One greedy-decode step; the S=1 case of :meth:`decode_chunk`
+        (one code path, so the speculative verify forward and the plain
+        greedy scan run the exact same per-position computation).
+        Returns (logits (B, V), updated cache)."""
+        logits, new_cache = self.decode_chunk(
+            token[:, None], index, cache, valid)
+        return logits[:, 0], new_cache
+
+    def decode_chunk(
+        self,
+        tokens: jax.Array,     # (B, S) ids for positions index..index+S-1
+        index: jax.Array,      # scalar int32: cache position of tokens[:, 0]
+        cache: Tuple,
+        valid: jax.Array,      # (B, max_len) cache validity incl. the chunk
+    ) -> Tuple[jax.Array, Tuple]:
+        """Multi-token cached decode: score S positions in ONE forward.
+
+        The speculative-decode verify step (ops/decode.py): the chunk's
+        k/v append into the cache at ``index..index+S-1`` (one
+        dynamic-update-slice per layer — the chunk-append contract in
+        models/layers.py) and each query j attends the cache under the
+        shared causal chunk mask (``<= index + j``), so logits[:, j]
+        equals what ``decode_step`` would produce after feeding
+        tokens[:, :j+1] one at a time. One weight read serves all S
+        positions — the whole point of drafting.
+
+        Returns (logits (B, S, V), updated cache).
+        """
+        _, s = tokens.shape
+        positions = index + jnp.arange(s)
+        x = self.wte(tokens) + self.wpe(positions[None, :])
+        mask = chunk_causal_mask(valid, index, s)
         new_cache = []
         for block, (ck, cv) in zip(self.blocks, cache):
             x, kv = block(x, mask=mask, kv_cache=(ck, cv, index))
             new_cache.append(kv)
-        logits = self._logits(self.ln_f(x))[:, 0]
-        return logits, tuple(new_cache)
+        return self._logits(self.ln_f(x)), tuple(new_cache)

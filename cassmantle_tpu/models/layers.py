@@ -45,6 +45,34 @@ def timestep_embedding(
     return emb
 
 
+def chunk_causal_mask(valid: jax.Array, index: jax.Array, length: int,
+                      window: Optional[int] = None) -> jax.Array:
+    """Causal mask for a multi-token decode chunk appended at ``index``.
+
+    ``valid`` (B, max_len) is the caller's cache-validity mask (the same
+    convention single-token ``decode_step`` takes, covering the prompt
+    and every chunk position); query j of the chunk sits at cache
+    position ``index + j`` and may additionally attend only positions
+    ``<= index + j`` — the within-chunk causal triangle a single-step
+    decode gets for free. With ``window`` the Mistral sliding band is
+    enforced per query on top. Returns (B, 1, length, max_len), ready
+    for the attention op's (B, H, Sq, Sk) broadcast.
+
+    This is the one definition of the chunk-mask convention the
+    speculative-decode verify forward (ops/decode.py) relies on: cache
+    positions past the accepted prefix are *rolled back* simply by the
+    next chunk's ``valid`` excluding them before the kv chunk-append
+    overwrites them.
+    """
+    max_len = valid.shape[-1]
+    cache_pos = jnp.arange(max_len)
+    q_pos = index + jnp.arange(length)
+    ok = cache_pos[None, :] <= q_pos[:, None]            # (length, max_len)
+    if window is not None:
+        ok = ok & (cache_pos[None, :] > q_pos[:, None] - window)
+    return valid[:, None, None, :] & ok[None, None, :, :]
+
+
 class MultiHeadAttention(nn.Module):
     """Projection + ops.attention + out-projection.
 
@@ -76,7 +104,13 @@ class MultiHeadAttention(nn.Module):
         - Decode mode (``kv_cache=(cache_k, cache_v, index)``): writes this
           call's k/v into the cache at ``index`` along the sequence axis and
           attends over the whole cache; the caller supplies ``mask`` marking
-          valid cache positions. Returns (out, (new_k, new_v)).
+          valid cache positions. Returns (out, (new_k, new_v)). The write
+          is a chunk-append: ``x`` may carry S > 1 positions (speculative
+          verify, ops/decode.py) and the S-wide k/v slab lands at
+          ``index..index+S-1`` in one ``dynamic_update_slice`` — the caller
+          then owes a per-query causal mask (``chunk_causal_mask``), since
+          with S > 1 a plain validity mask would let early chunk positions
+          see later ones.
         """
         features = x.shape[-1]
         head_dim = self.head_dim or features // self.num_heads

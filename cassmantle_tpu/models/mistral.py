@@ -278,25 +278,38 @@ class MistralLM(nn.Module):
         cache: Tuple,
         valid: jax.Array,      # (B, max_len) cache validity incl. this step
     ) -> Tuple[jax.Array, Tuple]:
-        """One cached decode step; returns (logits (B, V), new cache).
+        """One cached decode step; the S=1 case of :meth:`decode_chunk`
+        (one code path shared with the speculative verify forward).
+        Returns (logits (B, V), new cache)."""
+        logits, new_cache = self.decode_chunk(
+            token[:, None], index, cache, valid)
+        return logits[:, 0], new_cache
 
-        The sliding window is enforced here on top of the caller's
-        validity mask: cache positions at or below ``index - window``
-        are never attended.
-        """
+    def decode_chunk(
+        self,
+        tokens: jax.Array,     # (B, S) ids for positions index..index+S-1
+        index: jax.Array,      # scalar int32: cache position of tokens[:, 0]
+        cache: Tuple,
+        valid: jax.Array,      # (B, max_len) cache validity incl. the chunk
+    ) -> Tuple[jax.Array, Tuple]:
+        """Multi-token cached decode (the GPT2LM.decode_chunk contract):
+        RoPE follows the true positions ``index + j`` and the sliding
+        window is enforced per query inside the shared causal chunk
+        mask — cache positions at or below ``index + j - window`` are
+        never attended by query j. Returns (logits (B, S, V), new
+        cache)."""
+        from cassmantle_tpu.models.layers import chunk_causal_mask
+
         cfg = self.cfg
-        max_len = valid.shape[-1]
-        cache_pos = jnp.arange(max_len)
-        window_ok = (cache_pos > index - cfg.sliding_window) & (
-            cache_pos <= index
-        )
-        mask = (valid & window_ok[None, :])[:, None, None, :]
-        cos, sin = rope_tables(index[None, None], cfg.head_dim,
+        _, s = tokens.shape
+        mask = chunk_causal_mask(valid, index, s,
+                                 window=cfg.sliding_window)
+        positions = index + jnp.arange(s)
+        cos, sin = rope_tables(positions[None, :], cfg.head_dim,
                                cfg.rope_theta)
-        x = self.embed(token[:, None])
+        x = self.embed(tokens)
         new_cache = []
         for block, (ck, cv) in zip(self.blocks, cache):
             x, kv = block(x, cos, sin, mask=mask, kv_cache=(ck, cv, index))
             new_cache.append(kv)
-        logits = self._logits(self.ln_f(x))[:, 0]
-        return logits, tuple(new_cache)
+        return self._logits(self.ln_f(x)), tuple(new_cache)

@@ -10,6 +10,8 @@ through the serving queue.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import List, Sequence, Tuple
 
 import jax
@@ -48,8 +50,19 @@ class EmbeddingScorer:
         weights_dir=None,
         seq_len: int = 16,
         batch_buckets: Sequence[int] = (8, 64, 256, 1024),
+        embed_cache_size: int = 2048,
     ) -> None:
         self.cfg = cfg
+        # Text -> unit-embedding LRU: /compute_score re-embeds the
+        # round's FIXED answer words on every request, so a hit halves
+        # the per-guess device batch (and duplicate answers within one
+        # batch collapse to a single device row). Embeddings are
+        # content-addressed by text — nothing ever invalidates.
+        # Untracked short-hold leaf lock (docs/STATIC_ANALYSIS.md):
+        # dict updates only, the device encode runs OUTSIDE it.
+        self._embed_cache: OrderedDict = OrderedDict()
+        self._embed_cache_size = embed_cache_size
+        self._embed_cache_lock = threading.Lock()
         self.seq_len = min(seq_len, cfg.max_positions)
         self.batch_buckets = tuple(batch_buckets)
         self.tokenizer: Tokenizer = load_tokenizer(
@@ -86,11 +99,10 @@ class EmbeddingScorer:
             mask[i, : len(toks)] = 1
         return ids, mask
 
-    def embed(self, texts: Sequence[str]) -> np.ndarray:
-        """(n,) texts -> (n, D) unit embeddings, via one padded bucket."""
+    def _embed_device(self, texts: Sequence[str]) -> np.ndarray:
+        """The uncached device path: (n,) texts -> (n, D) unit
+        embeddings via padded buckets (one encode per bucket chunk)."""
         n = len(texts)
-        if n == 0:
-            return np.zeros((0, self.cfg.hidden_size), dtype=np.float32)
         batch = _pick_bucket(n, self.batch_buckets)
         out_chunks = []
         for start in range(0, n, batch):
@@ -105,8 +117,48 @@ class EmbeddingScorer:
                 sink.append(emb)
             # lint: ignore[host-sync] — one sync per dispatched chunk, not per text
             out_chunks.append(np.asarray(emb)[: len(chunk)])
-        metrics.inc("scorer.texts", n)
         return np.concatenate(out_chunks, axis=0)
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        """(n,) texts -> (n, D) unit embeddings.
+
+        Cache-aware: rows already in the LRU (or duplicated within this
+        call) never reach the device — only the unique uncached texts
+        form the padded encode batch. ``scorer.embed_cache_misses``
+        therefore counts device rows actually embedded;
+        ``scorer.embed_cache_hits`` counts rows served without device
+        work. The returned array is always freshly assembled — callers
+        may mutate it."""
+        n = len(texts)
+        if n == 0:
+            return np.zeros((0, self.cfg.hidden_size), dtype=np.float32)
+        out = np.zeros((n, self.cfg.hidden_size), dtype=np.float32)
+        miss_rows: "OrderedDict[str, list]" = OrderedDict()
+        with self._embed_cache_lock:
+            for i, text in enumerate(texts):
+                emb = self._embed_cache.get(text)
+                if emb is not None:
+                    self._embed_cache.move_to_end(text)
+                    out[i] = emb
+                else:
+                    miss_rows.setdefault(text, []).append(i)
+        if miss_rows:
+            fresh = self._embed_device(list(miss_rows))
+            with self._embed_cache_lock:
+                for row, (text, idxs) in zip(fresh, miss_rows.items()):
+                    out[idxs] = row
+                    if self._embed_cache_size > 0:
+                        # copy: a row VIEW would pin the whole encode
+                        # batch array alive for the entry's lifetime
+                        self._embed_cache[text] = row.copy()
+                        self._embed_cache.move_to_end(text)
+                        while len(self._embed_cache) > \
+                                self._embed_cache_size:
+                            self._embed_cache.popitem(last=False)
+        metrics.inc("scorer.texts", n)
+        metrics.inc("scorer.embed_cache_misses", len(miss_rows))
+        metrics.inc("scorer.embed_cache_hits", n - len(miss_rows))
+        return out
 
     def similarity(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
         """[(guess, answer)] -> cosine similarity per pair, one device

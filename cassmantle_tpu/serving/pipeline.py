@@ -562,9 +562,9 @@ class PromptGenerator:
             )
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
-        from cassmantle_tpu.ops.decode import make_apply_pair
+        from cassmantle_tpu.ops.decode import make_apply_fns
 
-        self._prefill, self._step = make_apply_pair(self.model)
+        self._prefill, self._step, self._chunk = make_apply_fns(self.model)
         if cfg.models.lm_int8:
             from cassmantle_tpu.ops.quant import (
                 quantized_apply,
@@ -574,8 +574,75 @@ class PromptGenerator:
             dq_dtype = jnp.dtype(cfg.models.param_dtype)
             self._prefill = quantized_apply(self._prefill, dq_dtype)
             self._step = quantized_apply(self._step, dq_dtype)
+            self._chunk = quantized_apply(self._chunk, dq_dtype)
             log.info("lm_int8: serving %.2f GB quantized param tree",
                      tree_nbytes(self.params) / 1e9)
+        self._init_spec_decode(cfg, weights_dir)
+
+    def _init_spec_decode(self, cfg: FrameworkConfig, weights_dir) -> None:
+        """Build the draft source for speculative decoding
+        (ops/decode.py). ``self._spec_draft`` is None when off; else a
+        static NgramDraft/ModelDraft whose identity is stable for the
+        life of the generator (it keys the jit cache). Stats of the
+        most recent spec decode land in ``self.last_spec_stats``."""
+        from cassmantle_tpu.ops.decode import ModelDraft, NgramDraft
+
+        spec = cfg.spec_decode
+        self._spec_draft = None
+        self._spec_draft_params = None
+        self.last_spec_stats = None
+        if spec.mode == "off":
+            return
+        if spec.mode == "ngram":
+            self._spec_draft = NgramDraft(ngram=spec.ngram)
+            return
+        assert spec.mode == "draft_model", \
+            f"unknown spec_decode.mode {spec.mode!r}"
+        d = spec.draft_model
+        assert d is not None, "spec_decode.mode='draft_model' needs a " \
+                              "draft_model config"
+        assert d.vocab_size == self.mcfg.vocab_size, (
+            "draft and target must share a tokenizer/vocab "
+            f"({d.vocab_size} vs {self.mcfg.vocab_size}) — speculative "
+            "acceptance compares token ids directly")
+        if cfg.models.mistral is None and d == cfg.models.gpt2:
+            # self-draft degenerate: reuse the target's (possibly
+            # quantized) apply fns and params — no second tree
+            self._spec_draft = ModelDraft(self._prefill, self._step)
+            self._spec_draft_params = self.params
+            return
+        from cassmantle_tpu.models.weights import convert_gpt2
+        from cassmantle_tpu.ops.decode import make_apply_fns
+
+        draft_model = GPT2LM(d)
+        loaded = maybe_load(
+            weights_dir, "gpt2_draft.safetensors",
+            lambda t: convert_gpt2(t, d.num_layers, d.hidden_size),
+            "gpt2_draft", cast_to=cfg.models.param_dtype)
+        self._spec_draft_params = (
+            loaded if loaded is not None
+            else init_params_cached(
+                draft_model, 6, jnp.zeros((1, 8), dtype=jnp.int32),
+                cache_path=param_cache_path("gpt2_draft", d),
+                cast_to=cfg.models.param_dtype))
+        d_prefill, d_step, _ = make_apply_fns(draft_model)
+        self._spec_draft = ModelDraft(d_prefill, d_step)
+
+    def _spec_enabled(self, bucket: int, max_new: int) -> bool:
+        """Host-side, per bucket group: the spec path engages only for
+        greedy decodes (temperature 0 — where acceptance is exact and
+        output provably identical), only when the chunk scratch tail
+        still fits the model's position table (the last chunk appends up
+        to gamma past the budget), and only with the kill switch clear."""
+        if self._spec_draft is None:
+            return False
+        if self.cfg.sampler.text_temperature > 0.0:
+            return False
+        if os.environ.get("CASSMANTLE_NO_SPEC_DECODE", "").lower() \
+                not in ("", "0", "false", "no", "off"):
+            return False
+        gamma = self.cfg.spec_decode.gamma
+        return bucket + max_new + gamma + 1 <= self.mcfg.max_positions
 
     def _load_int8_checkpoint(self, name: str, weights_dir):
         """Pre-quantized checkpoint (tools/quantize_weights.py): int8
@@ -676,6 +743,7 @@ class PromptGenerator:
             ).append(i)
         out_tokens = np.zeros((len(rows), max_new), dtype=np.int32)
         out_len = np.zeros((len(rows),), dtype=np.int32)
+        spec_stats = []
         for bucket, idxs in groups.items():
             n = len(idxs)
             n_pad = next((b for b in self.BATCH_BUCKETS if n <= b), n)
@@ -692,26 +760,51 @@ class PromptGenerator:
                 # lint: ignore[host-sync] — toks is a host token list
                 ids[row, : len(toks)] = np.asarray(toks) % m.vocab_size
                 lens[row] = max(1, len(toks))
-            with self._dispatch_lock:
-                tokens, gen_len = greedy_decode(
-                    (self._prefill, self._step),
-                    self.params,
-                    jnp.asarray(ids),
-                    jnp.asarray(lens),
-                    jax.random.PRNGKey(seed),
-                    max_new,
-                    # an out-of-vocab eos (byte-fallback tokenizer vs a
-                    # smaller model vocab) can never be emitted: pass
-                    # vocab_size as an unreachable sentinel so early-stop
-                    # is cleanly disabled — a modulo here would ALIAS a
-                    # real token as a phantom terminator and silently
-                    # truncate generations
-                    (self.tokenizer.eos_id
-                     if self.tokenizer.eos_id < m.vocab_size
-                     else m.vocab_size),
-                    self.cfg.sampler.text_temperature,
-                    self.cfg.sampler.text_top_k,
-                )
+            # an out-of-vocab eos (byte-fallback tokenizer vs a smaller
+            # model vocab) can never be emitted: pass vocab_size as an
+            # unreachable sentinel so early-stop is cleanly disabled — a
+            # modulo here would ALIAS a real token as a phantom
+            # terminator and silently truncate generations
+            eos = (self.tokenizer.eos_id
+                   if self.tokenizer.eos_id < m.vocab_size
+                   else m.vocab_size)
+            if self._spec_enabled(bucket, max_new):
+                from cassmantle_tpu.ops.decode import speculative_decode
+
+                with self._dispatch_lock, \
+                        block_timer("decode.verify_s") as sink:
+                    # draft + verify fuse into one device computation;
+                    # the in-jit spec_draft/spec_verify TraceAnnotations
+                    # split the two on the profiler path
+                    tokens, gen_len, stats = speculative_decode(
+                        (self._prefill, self._step, self._chunk),
+                        self.params,
+                        jnp.asarray(ids),
+                        jnp.asarray(lens),
+                        max_new,
+                        eos,
+                        self.cfg.spec_decode.gamma,
+                        self._spec_draft,
+                        self._spec_draft_params,
+                        # dummy pad rows must not throttle the lockstep
+                        # accept-min; their rows are dropped below anyway
+                        jnp.asarray(np.arange(n_pad) < n),
+                    )
+                    sink.append(tokens)  # device-synchronized span
+                spec_stats.append(stats)
+            else:
+                with self._dispatch_lock:
+                    tokens, gen_len = greedy_decode(
+                        (self._prefill, self._step),
+                        self.params,
+                        jnp.asarray(ids),
+                        jnp.asarray(lens),
+                        jax.random.PRNGKey(seed),
+                        max_new,
+                        eos,
+                        self.cfg.sampler.text_temperature,
+                        self.cfg.sampler.text_top_k,
+                    )
             # one sync per DISPATCHED bucket group (not per row): each
             # group is a separate device computation whose result must
             # land before its rows scatter into the output
@@ -719,7 +812,27 @@ class PromptGenerator:
             out_tokens[idxs] = np.asarray(tokens[:n])
             # lint: ignore[host-sync] — per-dispatch sync, not per-item
             out_len[idxs] = np.asarray(gen_len[:n])
+        self._record_spec_stats(spec_stats)
         return jnp.asarray(out_tokens), jnp.asarray(out_len)
+
+    def _record_spec_stats(self, spec_stats) -> None:
+        """ONE host transfer for the whole decode batch's spec counters
+        (after the per-group dispatch loop — never per chunk):
+        ``decode.spec_chunks`` counts verify forwards and
+        ``decode.spec_accept_rate`` gauges accepted/drafted, the number
+        that says whether the draft source is paying for itself."""
+        if not spec_stats:
+            return
+        # stack the per-group device stats, then ONE transfer + sum
+        chunks, drafted, accepted = np.asarray(
+            jnp.stack(list(spec_stats))).sum(axis=0).tolist()
+        self.last_spec_stats = {
+            "chunks": chunks, "drafted": drafted, "accepted": accepted,
+            "accept_rate": (accepted / drafted) if drafted else 0.0,
+        }
+        metrics.inc("decode.spec_chunks", chunks)
+        if drafted:
+            metrics.gauge("decode.spec_accept_rate", accepted / drafted)
 
     def decode_ids(self, seed_text: str,
                    max_new_tokens: Optional[int] = None,

@@ -68,9 +68,11 @@ FAST_MODULES = frozenset({
     "test_pipeline_parallel", "test_samplers", "test_scoring",
     "test_server", "test_spell", "test_store",
     "test_supervisor", "test_utils", "test_weights",
-    # deliberately NOT fast (stay in the default tier): test_mistral and
-    # test_torch_parity — heavyweight parity suites whose coverage the
-    # fast smoke doesn't need twice (test_weights pins the converters)
+    # deliberately NOT fast (stay in the default tier): test_mistral,
+    # test_torch_parity, and test_spec_decode — heavyweight parity
+    # suites whose coverage the fast smoke doesn't need twice
+    # (test_weights pins the converters; test_pipeline smokes the
+    # decode path)
 })
 
 SLOW_MODULES = frozenset({

@@ -219,14 +219,72 @@ def test_scorer_similarity_identity(scorer):
 
 
 def test_scorer_batch_padding_consistency(scorer):
-    """Same text embedded alone or in a padded batch must match."""
+    """Same text embedded alone or in a padded batch must match.
+    The embed cache is cleared between the calls so the second one
+    really recomputes on device (a hit would compare a row to itself)."""
     solo = scorer.embed(["glacier"])
+    scorer._embed_cache.clear()
     batch = scorer.embed(["glacier", "a", "b", "c", "d"])
     np.testing.assert_allclose(solo[0], batch[0], atol=1e-4)
 
 
 def test_scorer_empty(scorer):
     assert scorer.similarity([]).shape == (0,)
+
+
+def _cache_counters():
+    from cassmantle_tpu.utils.logging import metrics
+
+    snap = metrics.snapshot()["counters"]
+    return (snap.get("scorer.embed_cache_hits", 0),
+            snap.get("scorer.embed_cache_misses", 0))
+
+
+def test_scorer_embed_cache_hits_repeated_answers(scorer):
+    """The /compute_score shape: the round's answer words repeat every
+    request — the second embed of the same texts must be all hits, with
+    rows identical to the first (content-addressed, never invalidated)."""
+    scorer._embed_cache.clear()
+    texts = ["breeze", "lantern"]
+    h0, m0 = _cache_counters()
+    first = scorer.embed(texts)
+    h1, m1 = _cache_counters()
+    assert (h1 - h0, m1 - m0) == (0, 2)
+    second = scorer.embed(texts)
+    h2, m2 = _cache_counters()
+    assert (h2 - h1, m2 - m1) == (2, 0)
+    np.testing.assert_array_equal(first, second)
+
+
+def test_scorer_embed_cache_dedups_within_one_batch(scorer):
+    """Duplicate texts in ONE call (many guesses against one answer)
+    collapse to a single device row: 1 miss, the rest hits."""
+    scorer._embed_cache.clear()
+    h0, m0 = _cache_counters()
+    emb = scorer.embed(["dune", "dune", "dune"])
+    h1, m1 = _cache_counters()
+    assert (h1 - h0, m1 - m0) == (2, 1)
+    np.testing.assert_array_equal(emb[0], emb[1])
+    np.testing.assert_array_equal(emb[0], emb[2])
+
+
+def test_scorer_embed_cache_lru_eviction(scorer):
+    """Capacity is enforced oldest-first; a re-embed after eviction is
+    a fresh miss whose value still matches the original embedding."""
+    scorer._embed_cache.clear()
+    size0 = scorer._embed_cache_size
+    scorer._embed_cache_size = 2
+    try:
+        first = scorer.embed(["ash", "bark", "cliff"])  # evicts "ash"
+        assert set(scorer._embed_cache) == {"bark", "cliff"}
+        h0, m0 = _cache_counters()
+        again = scorer.embed(["ash"])
+        h1, m1 = _cache_counters()
+        assert (h1 - h0, m1 - m0) == (0, 1)
+        np.testing.assert_allclose(again[0], first[0], atol=1e-5)
+    finally:
+        scorer._embed_cache_size = size0
+        scorer._embed_cache.clear()
 
 
 def test_sentencepiece_bpe_tokenizer(tmp_path):
