@@ -274,6 +274,152 @@ def bench_sd15_fusedconv(weights_dir: str) -> dict:
         weights_dir))
 
 
+def _poisson_mixed_schedule(n: int, rate_rps: float, seed: int = 0):
+    """Deterministic Poisson arrival offsets + mixed request sizes for
+    the staged-serving A/B: both arms replay the SAME schedule, so the
+    comparison isolates the serving discipline, not the load draw.
+    Sizes mix 2:1 single-image and two-image requests (the game's
+    round-generation shape vs. a player-pair burst)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n)
+    arrivals = np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
+    sizes = rng.choice([1, 1, 2], size=n)
+    return arrivals, sizes
+
+
+def _mixed_load_arm(pipe, arrivals, sizes):
+    """Replay one arm of the mixed-load A/B: request i enters at
+    ``arrivals[i]`` (open-loop — late completions do NOT delay later
+    arrivals, exactly how real traffic behaves) and its latency is
+    submit → uint8 batch. Returns (elapsed_s, latencies_s, images)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    n = len(arrivals)
+    lats = [0.0] * n
+    images = [0] * n
+    start = time.perf_counter()
+
+    def one(i: int) -> None:
+        delay = start + float(arrivals[i]) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        prompts = (PROMPTS * 2)[i % len(PROMPTS):][: int(sizes[i])]
+        t0 = time.perf_counter()
+        out = pipe.generate(prompts, seed=100 + i)
+        lats[i] = time.perf_counter() - t0
+        images[i] = out.shape[0]
+
+    with ThreadPoolExecutor(max_workers=n) as ex:
+        futs = [ex.submit(one, i) for i in range(n)]
+        for f in futs:
+            f.result()
+    return time.perf_counter() - start, lats, sum(images)
+
+
+def bench_sd15_staged(weights_dir: str) -> dict:
+    """Mixed-load A/B for stage-disaggregated serving
+    (serving/stages.py, config.staged_serving_config): Poisson arrivals
+    of mixed-size requests through ONE pipeline, staged vs monolithic.
+    The monolithic arm runs the SAME pipeline object with the
+    CASSMANTLE_NO_STAGED_SERVING kill switch set, so params, tokenizer,
+    and compiled monolithic jits are held constant — the A/B isolates
+    the serving discipline (step-boundary admission vs whole-image
+    dispatch-lock FIFO). Reports per-arm throughput and p50/p99
+    REQUEST latency plus the staged arm's mean denoise-slot occupancy
+    (slot_steps / steps x capacity). Solo outputs are bit-identical
+    between arms (tests/test_stages.py), so quality needs no re-gate.
+
+    Env: BENCH_STAGED_REQUESTS (default 12), BENCH_STAGED_RATE
+    (arrivals/sec; default 0.6 ≈ 0.85 img/s offered at the 1.4
+    images/request mix — ~70% of the measured v5e sd15 capacity, the
+    regime where queueing exists but neither arm saturates; raise it
+    toward capacity during the hardware window to map the knee),
+    BENCH_STAGED_SLOTS (smoke-geometry slot count), and
+    BENCH_STAGED_SMOKE_GEOMETRY=1 swaps in the 64px/4-step test
+    geometry so the CPU harness smoke finishes — those numbers exercise
+    the scheduler, not the MXU, and are NOT hardware evidence (the
+    BENCH_SUITE.json annotation records this)."""
+    import numpy as np
+
+    _setup_jax()
+    from cassmantle_tpu.config import staged_serving_config
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    n = int(os.environ.get("BENCH_STAGED_REQUESTS", "12"))
+    rate = float(os.environ.get("BENCH_STAGED_RATE", "0.6"))
+    if os.environ.get("BENCH_STAGED_SMOKE_GEOMETRY", "").lower() in (
+            "1", "true", "yes", "on"):
+        import dataclasses as _dc
+
+        from cassmantle_tpu.config import test_config
+
+        slots = int(os.environ.get("BENCH_STAGED_SLOTS", "4"))
+
+        def config_factory():
+            base = test_config()
+            return base.replace(serving=_dc.replace(
+                base.serving, staged_serving=True, denoise_slots=slots))
+    else:
+        config_factory = staged_serving_config
+
+    pipe = Text2ImagePipeline(config_factory(), weights_dir=weights_dir)
+    arrivals, sizes = _poisson_mixed_schedule(n, rate)
+
+    base_stats = {}
+
+    def run_arm(monolithic: bool):
+        key = "CASSMANTLE_NO_STAGED_SERVING"
+        prev = os.environ.pop(key, None)
+        if monolithic:
+            os.environ[key] = "1"
+        try:
+            # warmup compiles for both request sizes before timing
+            pipe.generate(PROMPTS[:1], seed=0)
+            pipe.generate(PROMPTS[:2], seed=0)
+            if not monolithic:
+                # snapshot AFTER warmup so the occupancy derivation
+                # covers only the loaded phase, not two solo warmups
+                base_stats.update(pipe._staged_server().stats)
+            return _mixed_load_arm(pipe, arrivals, sizes)
+        finally:
+            os.environ.pop(key, None)
+            if prev is not None:
+                os.environ[key] = prev
+
+    def arm_stats(elapsed, lats, images):
+        s = np.sort(np.asarray(lats))
+        return {
+            "images_per_sec": round(images / elapsed, 4),
+            "request_p50_s": round(float(s[len(s) // 2]), 3),
+            "request_p99_s": round(float(s[int(len(s) * 0.99)]), 3),
+        }
+
+    mono = arm_stats(*run_arm(monolithic=True))
+    staged = arm_stats(*run_arm(monolithic=False))
+    srv = pipe._staged_server()
+    d_steps = srv.stats["steps"] - base_stats["steps"]
+    d_slot_steps = srv.stats["slot_steps"] - base_stats["slot_steps"]
+    if d_steps > 0:
+        staged["mean_slot_occupancy"] = round(
+            d_slot_steps / (d_steps * srv.capacity), 4)
+    srv.stop()
+    return {
+        "metric": "sd15_512px_ddim50_staged_mixedload_images_per_sec",
+        "value": staged["images_per_sec"],
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "ab_versus": "monolithic (same pipeline, kill-switch arm)",
+        "requests": n,
+        "arrival_rate_rps": rate,
+        "mixed_sizes": {str(k): int(v) for k, v in
+                        zip(*np.unique(sizes, return_counts=True))},
+        "staged": staged,
+        "monolithic": mono,
+    }
+
+
 def bench_sd15_int8(weights_dir: str) -> dict:
     """A/B arm for weights-only int8 UNet on the fixed DDIM-50 config:
     same trajectory as `sd15`, int8 weight streaming (halved per-step
@@ -611,6 +757,7 @@ SUITE = {
     "sd15_deepcache": bench_sd15_deepcache,
     "sd15_fusedconv": bench_sd15_fusedconv,
     "sd15_int8": bench_sd15_int8,
+    "sd15_staged": bench_sd15_staged,
     "sd15_b8": bench_sd15_b8,
     "sdxl": bench_sdxl,
     "sdxl_turbo": bench_sdxl_turbo,

@@ -314,6 +314,33 @@ class ServingConfig:
     # Tightened admission bound while the supervisor reports degraded —
     # a sick device gets a short queue, not max_pending of doomed work.
     degraded_max_pending: int = 256
+    # -- stage-disaggregated image serving (serving/stages.py) -------------
+    # Split the image path into encode / denoise / decode stages, each
+    # independently batched, with the denoise stage running step-level
+    # continuous batching over a fixed-capacity slot tensor: a request
+    # arriving mid-denoise of another joins at the next STEP boundary
+    # instead of waiting a whole image's latency for the dispatch lock
+    # (ROADMAP item 1; SwiftDiffusion / LegoDiffusion, PAPERS.md). Solo
+    # output is bit-identical to the monolithic path
+    # (tests/test_stages.py); CASSMANTLE_NO_STAGED_SERVING=1 is the
+    # runtime kill switch (docs/DEPLOY.md §6). Configs the slot stepper
+    # cannot replay exactly (deepcache pairing, eta>0, a dp/sp mesh)
+    # fall back to the monolithic dispatch automatically.
+    staged_serving: bool = False
+    # Fixed denoise slot capacity. The slot tensor keeps this shape
+    # forever; each step gathers live slots into the smallest
+    # power-of-two width bucket ≥ occupancy, so the step function
+    # compiles once per bucket (never per admission/retirement) and
+    # per-step compute tracks load.
+    denoise_slots: int = 4
+    # Bucket ladders for the encode/decode stage queues (batch dims pad
+    # to the next bucket, shapes stay static across calls).
+    stage_encode_batch_sizes: Tuple[int, ...] = (1, 2, 4, 8)
+    stage_decode_batch_sizes: Tuple[int, ...] = (1, 2, 4)
+    # Coalescing window for the encode/decode stage queues. Short: the
+    # denoise stage's step-boundary admission does the real batching,
+    # so holding encode work to widen a batch only adds latency.
+    stage_max_delay_ms: float = 3.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -475,6 +502,21 @@ def spec_decode_serving_config() -> FrameworkConfig:
 
     return FrameworkConfig(
         spec_decode=SpecDecodeConfig(mode="ngram", gamma=4, ngram=3))
+
+
+def staged_serving_config() -> FrameworkConfig:
+    """The fixed DDIM-50 config served through the stage graph
+    (serving/stages.py): CLIP encode, denoise, and VAE decode run as
+    independently batched stages, and the denoise loop admits/retires
+    requests at STEP granularity over a fixed slot tensor — a request
+    landing one step after another's dispatch starts denoising at the
+    next step boundary instead of waiting a whole image's latency.
+    Same trajectory per request as the monolithic path (solo output is
+    bit-identical, tests/test_stages.py); this is the ON arm of the
+    `sd15_staged` mixed-load bench A/B. CASSMANTLE_NO_STAGED_SERVING=1
+    is the runtime kill switch."""
+
+    return FrameworkConfig(serving=ServingConfig(staged_serving=True))
 
 
 def deepcache_serving_config() -> FrameworkConfig:

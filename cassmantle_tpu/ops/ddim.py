@@ -215,6 +215,35 @@ def make_cfg_denoiser(
     return denoise
 
 
+def make_slot_denoiser(
+    unet_apply: Callable,
+    guidance_scale: float,
+) -> Callable:
+    """CFG denoiser for the staged step-level serving loop
+    (serving/stages.py): conditioning arrives as per-slot ARGUMENTS
+    (slot contents change between steps, so nothing can be closed over)
+    and the timestep is a per-slot ``(C,)`` vector — each slot sits at
+    its own schedule position. Otherwise the arithmetic is exactly
+    :func:`make_cfg_denoiser`'s 2C-batch CFG, so a solo slot's
+    trajectory matches the monolithic scan bit for bit (the rows of the
+    CFG batch are computation-independent)."""
+
+    def denoise(params, x, t, context, uncond_context,
+                addition_embeds=None, uncond_addition_embeds=None):
+        full_context, full_addition = _cfg_context(
+            context, uncond_context, addition_embeds,
+            uncond_addition_embeds)
+        x2 = jnp.concatenate([x, x], axis=0)
+        t2 = jnp.concatenate([t, t], axis=0)
+        if full_addition is None:
+            eps = unet_apply(params, x2, t2, full_context)
+        else:
+            eps = unet_apply(params, x2, t2, full_context, full_addition)
+        return _cfg_guide(eps, guidance_scale)
+
+    return denoise
+
+
 def make_cfg_denoiser_pair(
     unet_apply: Callable,
     params,

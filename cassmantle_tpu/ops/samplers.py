@@ -222,6 +222,110 @@ def dpmpp_2m_sample_deepcache(
     return x
 
 
+def make_slot_sampler(kind: str, num_steps: int, eta: float = 0.0):
+    """Step-granular counterpart of :func:`make_sampler` for the staged
+    serving path (serving/stages.py): instead of one ``lax.scan``
+    position shared by the whole batch, every slot carries its OWN step
+    index and the per-step coefficients gather per slot — so requests
+    can sit at different schedule positions inside one fixed-capacity
+    step dispatch.
+
+    Returns ``(prepare, slot_step, num_steps)``:
+
+    - ``prepare(latents) -> (x, aux)`` maps standard-normal latents to
+      the solver-space entry state (identity for DDIM/DPM++, the
+      sigma-max scale for Euler) plus the per-slot auxiliary state
+      (DPM++'s multistep history m1; zeros where the solver has none);
+    - ``slot_step(denoise, x, aux, idx) -> (x', aux')`` advances every
+      slot one step: ``x``/``aux`` are ``(C, H, W, Ch)``, ``idx`` is
+      ``(C,)`` int32 (each slot's current step), and ``denoise(x, t)``
+      receives the per-slot int timestep vector ``t``.
+
+    The per-slot arithmetic is EXACTLY the matching ``make_sampler``
+    scan body (same schedule arrays, same expressions), so a solo
+    staged trajectory is bit-identical to the monolithic scan — the
+    staged-vs-monolithic parity bar (tests/test_stages.py). Only
+    deterministic samplers qualify: ``eta > 0`` draws per-step noise
+    from a carried key chain that step-boundary admission cannot
+    replay, so it (and deepcache's paired steps) stays monolithic.
+    """
+    if eta != 0.0:
+        raise ValueError(
+            "staged serving needs a deterministic sampler (eta=0); "
+            "eta>0 carries a per-step noise key chain that step-level "
+            "admission cannot replay")
+
+    def _b(a):  # (C,) -> (C, 1, 1, 1) for latent broadcasting
+        return a[:, None, None, None]
+
+    if kind == "ddim":
+        schedule = DDIMSchedule.create(num_steps)
+
+        def prepare(latents):
+            return latents, jnp.zeros_like(latents)
+
+        def slot_step(denoise, x, aux, idx):
+            t = schedule.timesteps[idx]
+            a_t = _b(schedule.alpha_bars[idx])
+            a_prev = _b(schedule.alpha_bars_prev[idx])
+            eps = denoise(x, t)
+            # ddim_sample's step body with eta pinned to 0: sigma is
+            # exactly zero, so the stochastic term vanishes and the
+            # remaining expressions are kept verbatim for bit parity
+            x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+            sigma = 0.0 * jnp.sqrt(
+                (1.0 - a_prev) / (1.0 - a_t)
+            ) * jnp.sqrt(1.0 - a_t / a_prev)
+            dir_xt = jnp.sqrt(
+                jnp.maximum(1.0 - a_prev - sigma**2, 0.0)) * eps
+            return jnp.sqrt(a_prev) * x0 + dir_xt, aux
+
+        return prepare, slot_step, num_steps
+
+    if kind == "euler":
+        eschedule = EulerSchedule.create(num_steps)
+
+        def prepare(latents):
+            return latents * eschedule.sigmas[0], jnp.zeros_like(latents)
+
+        def slot_step(denoise, x, aux, idx):
+            t = eschedule.timesteps[idx]
+            sigma = _b(eschedule.sigmas[idx])
+            sigma_next = _b(eschedule.sigmas[idx + 1])
+            x_vp = x / jnp.sqrt(1.0 + sigma * sigma)
+            eps = denoise(x_vp, t)
+            return x + (sigma_next - sigma) * eps, aux
+
+        return prepare, slot_step, num_steps
+
+    if kind == "dpmpp_2m":
+        dschedule = DPMppSchedule.create(num_steps)
+
+        def prepare(latents):
+            # the multistep history m1 enters zero, exactly as
+            # dpmpp_2m_sample's scan carry initializes it
+            return latents, jnp.zeros_like(latents)
+
+        def slot_step(denoise, x, aux, idx):
+            t = dschedule.timesteps[idx]
+            alpha = _b(dschedule.alphas[idx])
+            sigma = _b(dschedule.sigmas[idx])
+            c_skip = _b(dschedule.c_skip[idx])
+            c_d0 = _b(dschedule.c_d0[idx])
+            c_d1 = _b(dschedule.c_d1[idx])
+            eps = denoise(x, t)
+            m0 = (x - sigma * eps) / alpha
+            # first/last-step first-order handling rides the
+            # precomputed coefficients (c_d1 = 0 there), so a slot
+            # admitted mid-flight warms up exactly like a fresh scan
+            return c_skip * x + c_d0 * m0 + c_d1 * aux, m0
+
+        return prepare, slot_step, num_steps
+
+    raise ValueError(f"unknown sampler kind {kind!r}; "
+                     f"choose from {SAMPLER_KINDS}")
+
+
 def make_img2img_sampler(kind: str, num_steps: int, start: int,
                          eta: float = 0.0):
     """Tail sampling from schedule position ``start`` (img2img).

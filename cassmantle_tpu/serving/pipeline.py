@@ -347,6 +347,72 @@ class Text2ImagePipeline:
         # Outermost hierarchy tier (docs/STATIC_ANALYSIS.md): held for
         # whole device dispatches, so nothing coarser may nest inside.
         self._dispatch_lock = OrderedLock("pipeline.t2i_dispatch", rank=10)
+        # stage-disaggregated serving (serving/stages.py): built lazily
+        # on the first staged generate; the supervisor is wired by
+        # InferenceService so per-stage watchdog health fuses into
+        # /readyz like every other dispatch path
+        self.supervisor = None
+        self._staged = None
+        # guards ONLY the lazy _staged construction (generate() is
+        # called from multiple executor threads; two racing builders
+        # would mean two denoise threads and duplicate jit graphs) —
+        # rank 13, docs/STATIC_ANALYSIS.md
+        self._staged_init_lock = OrderedLock("pipeline.staged_init",
+                                             rank=13)
+
+    # -- stage-disaggregated serving (serving/stages.py) -------------------
+
+    def _staged_enabled(self) -> bool:
+        """Per-call routing decision: the ServingConfig knob, minus the
+        runtime kill switch, minus configs the slot stepper cannot
+        replay exactly — deepcache's paired steps, eta>0's per-step
+        noise chain, non-stageable sampler kinds, and meshed (dp/sp)
+        serving all keep the proven monolithic dispatch."""
+        from cassmantle_tpu.serving.stages import (
+            STAGEABLE_KINDS,
+            staged_serving_disabled,
+        )
+
+        s = self.cfg.sampler
+        return (self.cfg.serving.staged_serving
+                and not staged_serving_disabled()
+                and self.mesh is None
+                and not s.deepcache
+                and s.eta == 0.0
+                and s.kind in STAGEABLE_KINDS)
+
+    def _encode_stage(self, params, ids, uncond_ids):
+        """Encode-stage computation: exactly the conditioning block of
+        ``_sample_impl`` (rows are batch-independent, so a staged row
+        matches its monolithic counterpart bit for bit)."""
+        return {
+            "ctx": self.clip.apply(params["clip"], ids)["hidden"],
+            "uctx": self.clip.apply(params["clip"], uncond_ids)["hidden"],
+        }
+
+    def _decode_stage(self, params, lat):
+        """Decode-stage computation: the VAE + uint8 tail of
+        ``_sample_impl``."""
+        return postprocess_images(self.vae.apply(params["vae"], lat))
+
+    def _staged_server(self):
+        if self._staged is None:
+            with self._staged_init_lock:
+                if self._staged is None:
+                    from cassmantle_tpu.serving.stages import (
+                        StagedImageServer,
+                    )
+
+                    self._staged = StagedImageServer(
+                        self.cfg, self._params,
+                        encode_fn=self._encode_stage,
+                        decode_fn=self._decode_stage,
+                        unet_apply=self.unet_apply,
+                        tokenize=self._tokenize,
+                        vae_scale=self.vae_scale,
+                        supervisor=self.supervisor,
+                    )
+        return self._staged
 
     def _sample_impl(self, params, ids, uncond_ids, rng):
         with annotate("clip_encode"):
@@ -370,8 +436,22 @@ class Text2ImagePipeline:
             self.cfg.models.clip_text.vocab_size,
         )
 
-    def generate(self, prompts: Sequence[str], seed: int = 0) -> np.ndarray:
-        """prompts -> (B, H, W, 3) uint8. One compiled graph per batch."""
+    def generate(self, prompts: Sequence[str], seed: int = 0,
+                 deadline_s: Optional[float] = None) -> np.ndarray:
+        """prompts -> (B, H, W, 3) uint8. One compiled graph per batch.
+
+        With ``serving.staged_serving`` on (and the kill switch clear)
+        the request rides the stage graph instead: encode/denoise/decode
+        batch independently and the denoise loop admits at step
+        granularity — same output bit for bit for a solo request.
+        ``deadline_s`` is honored at step boundaries on the staged path
+        (an expired request frees its denoise slot); the monolithic
+        dispatch is all-or-nothing and ignores it."""
+        if self._staged_enabled():
+            images = self._staged_server().generate(
+                list(prompts), seed, deadline_s=deadline_s)
+            metrics.inc("pipeline.images", len(prompts))
+            return images
         padded, n = pad_prompts_to_dp(prompts, self.dp)
         ids = jnp.asarray(self._tokenize(padded))
         uncond = jnp.asarray(self._tokenize(

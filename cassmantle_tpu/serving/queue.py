@@ -82,10 +82,17 @@ class _DispatchWorker:
     call ever returns), re-queues any jobs it hadn't started, and starts
     a fresh thread, without ever pinning process exit."""
 
-    def __init__(self) -> None:
+    def __init__(self, name: str = "queue.dispatch_worker",
+                 rank: int = 20) -> None:
         # docs/STATIC_ANALYSIS.md hierarchy: worker bookkeeping nests
-        # inside nothing and may (in principle) precede supervisor state
-        self._lock = OrderedLock("queue.dispatch_worker", rank=20)
+        # inside nothing and may (in principle) precede supervisor state.
+        # Stage-disaggregated serving (serving/stages.py) builds extra
+        # workers under their own names/ranks (stage.encode_dispatch 21,
+        # stage.decode_dispatch 22) so each stage dispatches devicework
+        # independently instead of serializing on the process-global
+        # worker.
+        self.name = name
+        self._lock = OrderedLock(name, rank=rank)
         self._jobs: Optional[_thread_queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -111,7 +118,7 @@ class _DispatchWorker:
             self._jobs = _thread_queue.Queue()
             self._thread = threading.Thread(
                 target=self._loop, args=(self._jobs,),
-                daemon=True, name="cassmantle-dispatch",
+                daemon=True, name=f"cassmantle-{self.name}",
             )
             self._thread.start()
 
@@ -146,7 +153,7 @@ class _DispatchWorker:
                 old_jobs.put(None)  # retire the old thread when it unwedges
             self._thread = threading.Thread(
                 target=self._loop, args=(self._jobs,),
-                daemon=True, name="cassmantle-dispatch",
+                daemon=True, name=f"cassmantle-{self.name}",
             )
             self._thread.start()
             metrics.inc("dispatch.thread_replacements")
@@ -179,7 +186,15 @@ class BatchingQueue(Generic[T, R]):
         hang_timeout_s: Optional[float] = None,
         supervisor=None,
         degraded_max_pending: Optional[int] = None,
+        dispatcher: Optional[_DispatchWorker] = None,
     ) -> None:
+        # ``dispatcher``: a dedicated _DispatchWorker for this queue.
+        # Default is the process-global worker (device work serializes
+        # there); the stage-disaggregated image path hands each stage
+        # its own so encode/decode batches dispatch concurrently with
+        # everything else (serving/stages.py).
+        self._dispatcher = dispatcher if dispatcher is not None \
+            else _dispatcher
         self.handler = handler
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1000.0
@@ -338,7 +353,7 @@ class BatchingQueue(Generic[T, R]):
             # the handler runs on the dispatch thread under the batch
             # span's context, so its block_timer stage spans land in the
             # batch's trace (contextvars don't cross threads on their own)
-            dispatch, started = _dispatcher.submit(
+            dispatch, started = self._dispatcher.submit(
                 run_with_ctx, batch_ctx, self.handler, items)
             wrapped = asyncio.wrap_future(dispatch)
             try:
@@ -376,7 +391,7 @@ class BatchingQueue(Generic[T, R]):
                     batch_size=len(items))
                 if self.supervisor is not None:
                     self.supervisor.note_dispatch_overrun(self.name)
-                _dispatcher.replace()
+                self._dispatcher.replace()
                 self._disown(wrapped)
                 exc = DispatchTimeout(
                     f"{self.name} dispatch exceeded {self.hang_timeout_s}s")

@@ -193,6 +193,12 @@ class SDXLPipeline:
         from cassmantle_tpu.utils.locks import OrderedLock
 
         self._dispatch_lock = OrderedLock("pipeline.sdxl_dispatch", rank=11)
+        # stage-disaggregated serving (serving/stages.py); supervisor is
+        # wired by InferenceService, same as the SD1.5 pipeline
+        self.supervisor = None
+        self._staged = None
+        self._staged_init_lock = OrderedLock("pipeline.staged_init",
+                                             rank=13)
 
     # -- conditioning ------------------------------------------------------
 
@@ -254,10 +260,64 @@ class SDXLPipeline:
             self.cfg.models.clip_text.vocab_size,
         )
 
-    def generate(self, prompts: Sequence[str], seed: int = 0) -> np.ndarray:
+    # -- stage-disaggregated serving (serving/stages.py) -------------------
+
+    def _staged_enabled(self) -> bool:
+        """Same routing decision as Text2ImagePipeline._staged_enabled
+        (one seam, two pipelines)."""
+        from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+        return Text2ImagePipeline._staged_enabled(self)
+
+    def _encode_stage(self, params, ids, uncond_ids):
+        """Encode-stage computation: exactly the dual-tower +
+        micro-conditioning block of ``_sample_impl`` (rows are
+        batch-independent, so staged rows match monolithic bit for
+        bit)."""
+        ctx, pooled = self._encode(params, ids)
+        uctx, uncond_pooled = self._encode(params, uncond_ids)
+        time_ids = self._time_ids(ids.shape[0])
+        return {
+            "ctx": ctx,
+            "uctx": uctx,
+            "add": jnp.concatenate([pooled, time_ids], axis=-1),
+            "uadd": jnp.concatenate([uncond_pooled, time_ids], axis=-1),
+        }
+
+    def _decode_stage(self, params, lat):
+        return postprocess_images(self.vae.apply(params["vae"], lat))
+
+    def _staged_server(self):
+        if self._staged is None:
+            with self._staged_init_lock:
+                if self._staged is None:
+                    from cassmantle_tpu.serving.stages import (
+                        StagedImageServer,
+                    )
+
+                    self._staged = StagedImageServer(
+                        self.cfg, self._params,
+                        encode_fn=self._encode_stage,
+                        decode_fn=self._decode_stage,
+                        unet_apply=self.unet_apply,
+                        tokenize=self._tokenize,
+                        vae_scale=self.vae_scale,
+                        supervisor=self.supervisor,
+                    )
+        return self._staged
+
+    def generate(self, prompts: Sequence[str], seed: int = 0,
+                 deadline_s: Optional[float] = None) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. Batch is padded to a multiple of
         the dp axis so every device holds an equal shard; pad rows are
-        dropped before returning."""
+        dropped before returning. With ``serving.staged_serving`` on the
+        request rides the stage graph (see Text2ImagePipeline.generate);
+        meshed serving stays monolithic."""
+        if self._staged_enabled():
+            images = self._staged_server().generate(
+                list(prompts), seed, deadline_s=deadline_s)
+            metrics.inc("pipeline.sdxl_images", len(prompts))
+            return images
         from cassmantle_tpu.serving.pipeline import pad_prompts_to_dp
 
         padded, n = pad_prompts_to_dp(prompts, self.dp)

@@ -62,6 +62,13 @@ class InferenceService:
         )
         self.backend = backend or TPUContentBackend(
             cfg, weights_dir=weights_dir, mesh=mesh)
+        # stage-disaggregated serving (serving/stages.py): the image
+        # pipeline's per-stage queues/watchdogs report into the SAME
+        # supervisor as the score/prompt queues, so stage dispatch
+        # health fuses into the one /readyz signal
+        t2i = getattr(self.backend, "t2i", None)
+        if t2i is not None and hasattr(t2i, "supervisor"):
+            t2i.supervisor = self.supervisor
         self.score_queue: BatchingQueue = BatchingQueue(
             handler=self._score_batch,
             max_batch=max(cfg.serving.score_batch_sizes),

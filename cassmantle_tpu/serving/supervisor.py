@@ -57,6 +57,13 @@ class ServingSupervisor:
         self._lock = OrderedLock("supervisor", rank=30)
         self._degraded_until = 0.0
         self._overruns = 0
+        # per-stage dispatch health (stage-disaggregated serving,
+        # serving/stages.py): last time each stage made observable
+        # progress (a batch completed / a slot retired). status()
+        # surfaces seconds-since-progress so a /readyz reader sees
+        # WHICH stage went dark; a wedged stage still flips degraded
+        # through note_dispatch_overrun like any other dispatch path.
+        self._stage_progress: Dict[str, float] = {}
 
     # -- watchdog ---------------------------------------------------------
     def note_dispatch_overrun(self, queue_name: str) -> None:
@@ -80,6 +87,22 @@ class ServingSupervisor:
     def watchdog_degraded(self) -> bool:
         with self._lock:
             return self.clock() < self._degraded_until
+
+    # -- per-stage health (serving/stages.py) ------------------------------
+    def note_stage_progress(self, stage: str) -> None:
+        """A serving stage (encode / denoise / decode) made observable
+        progress: a batch completed or a slot retired. Cheap enough for
+        every completion; feeds the ``stages`` block of status()."""
+        with self._lock:
+            self._stage_progress[stage] = self.clock()
+
+    def stage_health(self) -> Dict[str, float]:
+        """Seconds since each registered stage last made progress
+        (empty until staged serving has run)."""
+        with self._lock:
+            now = self.clock()
+            return {s: round(now - t, 3)
+                    for s, t in self._stage_progress.items()}
 
     # -- device -----------------------------------------------------------
     async def probe_device(self) -> Optional[bool]:
@@ -151,6 +174,9 @@ class ServingSupervisor:
             "watchdog": watchdog,
             "device": device_ok,
         }
+        stages = self.stage_health()
+        if stages:
+            status["stages"] = stages
         if not ready and include_events:
             # a degraded verdict carries the recent event history that
             # explains it — the flight-recorder tail (trip order,

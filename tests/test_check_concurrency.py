@@ -374,6 +374,115 @@ def test_lint_all_runs_every_pass_with_one_exit_code(tmp_path):
     assert main([str(tmp_path)]) == 1
 
 
+# -- stage-scheduler shape (serving/stages.py, ISSUE 6) ----------------------
+# Golden fixtures pinning the two structural invariants of the staged
+# denoise loop: NO host sync inside the step loop (control state lives
+# in host-side numpy mirrors; the only device→host transfer is the
+# decode stage's collect-once per batch), and NO lock held across a
+# stage boundary (the scheduler lock covers lifecycle only — a lock
+# held across a cross-stage .result() handoff serializes the graph and
+# is one wedged stage away from deadlock).
+
+def test_stage_step_loop_host_sync_shape():
+    """The violating shape: a denoise loop that reads a device value
+    back every step (sync-per-iteration serializes the whole step
+    pipeline). The clean shape is the shipped one: per-step dispatches
+    ride host-side mirrors, the one sync sits OUTSIDE the loop at the
+    decode boundary."""
+    findings = lint("""
+        import numpy as np
+
+        class Server:
+            def denoise_loop(self, steps):
+                for _ in range(steps):
+                    self.lat = self.step(self.lat)
+                    done = np.asarray(self.lat)   # sync per step
+                return done
+    """, HostSyncPass())
+    assert rules(findings) == ["host-sync"]
+
+    clean = lint("""
+        import numpy as np
+
+        class Server:
+            def denoise_loop(self, steps):
+                for _ in range(steps):
+                    self.lat = self.step(self.lat)
+                    self.steps_done += 1          # host mirror only
+                return self.lat
+
+            def decode_batch(self, rows):
+                images = self.decode(rows)
+                return np.asarray(images)         # collect-once boundary
+    """, HostSyncPass())
+    assert clean == []
+
+
+def test_stage_lock_across_stage_boundary_fails():
+    """A lock held across a cross-stage handoff (submit → .result())
+    is flagged as a blocking call under a lock; the shipped shape —
+    lifecycle-only critical section, handoff outside — is clean."""
+    findings = lint("""
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def process(self, unit):
+                with self._lock:
+                    fut = self.encode_q.submit(unit)
+                    cond = fut.result()
+                return cond
+    """, LockOrderPass())
+    assert rules(findings) == ["lock-blocking-call"]
+
+    clean = lint("""
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ensure_started(self):
+                with self._lock:
+                    if not self.started:
+                        self.start_threads()
+                        self.started = True
+
+            def process(self, unit):
+                self.ensure_started()
+                fut = self.encode_q.submit(unit)
+                return fut.result(timeout=30.0)
+    """, LockOrderPass())
+    assert clean == []
+
+
+def test_stage_locks_are_ranked():
+    """The stage graph's three locks carry the documented hierarchy
+    (docs/STATIC_ANALYSIS.md): scheduler lifecycle at 14 (between the
+    pipeline dispatch tier and the worker tier), each stage's dedicated
+    dispatch worker fanned out above the process-global worker's 20."""
+    import dataclasses
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.serving.queue import _DispatchWorker
+    from cassmantle_tpu.serving.stages import StagedImageServer
+
+    base = test_config()
+    cfg = base.replace(serving=dataclasses.replace(
+        base.serving, staged_serving=True))
+    srv = StagedImageServer(
+        cfg, None, encode_fn=lambda *a: None, decode_fn=lambda *a: None,
+        unet_apply=lambda *a: None, tokenize=lambda p: None, vae_scale=8)
+    assert isinstance(srv._lock, OrderedLock)
+    assert (srv._lock.name, srv._lock.rank) == ("stage.scheduler", 14)
+    enc = _DispatchWorker("stage.encode_dispatch", rank=21)._lock
+    dec = _DispatchWorker("stage.decode_dispatch", rank=22)._lock
+    assert (enc.name, enc.rank) == ("stage.encode_dispatch", 21)
+    assert (dec.name, dec.rank) == ("stage.decode_dispatch", 22)
+
+
 # -- OrderedLock runtime sentinel --------------------------------------------
 # (the autouse conftest fixture arms raising mode + resets the graph)
 
@@ -497,7 +606,9 @@ def test_lock_hierarchy_documented():
         "STATIC_ANALYSIS.md"
     text = doc.read_text()
     for name in ("pipeline.t2i_dispatch", "queue.dispatch_worker",
-                 "supervisor", "circuit.<name>", "health.device"):
+                 "supervisor", "circuit.<name>", "health.device",
+                 "stage.scheduler", "stage.encode_dispatch",
+                 "stage.decode_dispatch", "pipeline.staged_init"):
         assert name in text, f"lock {name} missing from hierarchy table"
     for rule in ("lock-order-cycle", "lock-across-await",
                  "lock-blocking-call", "async-blocking-call",
