@@ -10,8 +10,12 @@ rule catalog and the how-to-add-a-pass guide.
 
 Entry points: ``tools/check_concurrency.py`` (lock discipline,
 blocking-in-async, host-sync), ``tools/check_metrics.py`` (metric
-naming/catalog), ``tools/lint_all.py`` (everything, one exit code) —
-all gated as fast-tier tests.
+naming/catalog), ``tools/check_jax.py`` (recompile hazards, tracer
+leaks, host-buffer escapes, env-flag registry — jit-region discovery
+shared via ``jitregions``), ``tools/lint_all.py`` (everything, one
+exit code) — all gated as fast-tier tests. Runtime counterparts:
+``utils/locks.OrderedLock`` (lock discipline) and
+``utils/jit_sentinel`` (compile counts), both armed per test.
 """
 
 from cassmantle_tpu.analysis.core import (  # noqa: F401

@@ -1,0 +1,141 @@
+"""Host-buffer-escape pass: mutable numpy mirrors aliased into async
+device dispatch.
+
+The PR 6 silently-wrong-images bug, generalized: ``jnp.asarray`` (and
+``device_put``) may ZERO-COPY alias a numpy buffer on some backends,
+and dispatch is asynchronous — so a host mirror that is (a) mutated in
+place by its owning class and (b) handed without ``.copy()`` into an
+async dispatch sink (``jnp.asarray``/``jax.device_put``, an executor /
+``BatchingQueue`` ``submit``, a queue ``put``) can be rewritten by the
+next tick *while the in-flight computation is still reading it* —
+wrong schedule coefficients, silently wrong images; only e2e parity
+tests catch it. Rule ``buffer-escape`` flags the triple:
+
+1. the attribute is a numpy-allocated mirror
+   (``self.X = np.zeros/ones/empty/full/array/arange(...)``);
+2. the class mutates it in place somewhere (``self.X[...] = ...``,
+   ``self.X += ...``, ``self.X.fill(...)``);
+3. ``self.X`` is passed *directly* (no ``.copy()``) into a dispatch
+   sink.
+
+A ``.copy()`` at the sink (the shipped `_steps` fix) breaks the alias
+and is clean; host→host reads (``np.flatnonzero(self.X)``) are not
+sinks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    self_attr,
+)
+
+RULE = "buffer-escape"
+
+_NP_ALLOCATORS = {"zeros", "ones", "empty", "full", "array", "arange",
+                  "zeros_like", "ones_like", "empty_like", "full_like"}
+_NP_ROOTS = {"np", "numpy"}
+
+# async dispatch sinks: device placement (may zero-copy alias the host
+# buffer while dispatch is in flight) and cross-thread handoffs
+# (executor/queue submit — the receiving thread reads the buffer later)
+_SINK_NAMES = {"jnp.asarray", "jnp.array", "jax.device_put",
+               "device_put", "jax.numpy.asarray", "jax.numpy.array"}
+_SINK_METHODS = {"submit", "put", "put_nowait"}
+
+
+_is_self_attr = self_attr  # shared AST helper (analysis/core.py)
+
+
+def _np_allocation(value: ast.expr) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    name = call_name(value)
+    if name is None or "." not in name:
+        return False
+    root, last = name.split(".", 1)[0], name.rsplit(".", 1)[-1]
+    return root in _NP_ROOTS and last in _NP_ALLOCATORS
+
+
+def _sink_call(node: ast.Call) -> Optional[str]:
+    """A description of why this call is an async dispatch sink, or
+    None."""
+    name = call_name(node)
+    if name in _SINK_NAMES:
+        return f"{name}() (device placement may zero-copy alias it)"
+    if name is not None:
+        last = name.rsplit(".", 1)[-1]
+        if last in _SINK_METHODS and "." in name:
+            return (f"{name}() (cross-thread handoff reads it after "
+                    f"this method returns)")
+    return None
+
+
+class BufferEscapePass(LintPass):
+    name = "bufferescape"
+    description = ("mutable numpy host mirrors passed uncopied into "
+                   "async dispatch / device placement")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                yield from self._scan_class(module, node)
+
+    def _scan_class(self, module: Module,
+                    cls: ast.ClassDef) -> Iterator[Finding]:
+        mirrors: Set[str] = set()
+        mutated: Dict[str, int] = {}
+        for node in ast.walk(cls):
+            # (1) numpy-allocated mirror attributes
+            if isinstance(node, ast.Assign) and \
+                    _np_allocation(node.value):
+                for t in node.targets:
+                    attr = _is_self_attr(t)
+                    if attr is not None:
+                        mirrors.add(attr)
+            # (2) in-place mutation
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _is_self_attr(t.value)
+                    elif isinstance(node, ast.AugAssign):
+                        attr = _is_self_attr(t)
+                    else:
+                        attr = None
+                    if attr is not None:
+                        mutated.setdefault(attr, node.lineno)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("fill", "sort", "partition"):
+                attr = _is_self_attr(node.func.value)
+                if attr is not None:
+                    mutated.setdefault(attr, node.lineno)
+        hot = mirrors & set(mutated)
+        if not hot:
+            return
+        # (3) the uncopied escape into a sink
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            sink = _sink_call(node)
+            if sink is None:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                attr = _is_self_attr(arg)
+                if attr in hot:
+                    yield Finding(
+                        RULE, module.rel, arg.lineno,
+                        f"mutable host mirror self.{attr} (mutated in "
+                        f"place at line {mutated[attr]}) passed "
+                        f"uncopied into {sink}: an in-flight dispatch "
+                        f"can read the NEXT mutation's values — pass "
+                        f"self.{attr}.copy()",
+                        getattr(arg, "end_lineno", None))

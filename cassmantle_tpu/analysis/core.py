@@ -141,13 +141,22 @@ def iter_modules(root: pathlib.Path,
 
 class LintPass:
     """One named check. ``run`` yields raw findings; the runner applies
-    suppressions, so passes never need to know about them."""
+    suppressions, so passes never need to know about them.
+
+    A pass that needs the WHOLE module set before it can judge (e.g.
+    the env-flag registry's "documented but never read" direction) may
+    override ``finalize``: it runs once after every module has been
+    ``run``, and its findings bypass per-line suppressions (they
+    usually anchor to a docs file, not a linted module)."""
 
     name = "base"
     description = ""
 
     def run(self, module: Module) -> Iterator[Finding]:
         raise NotImplementedError
+
+    def finalize(self) -> Iterator[Finding]:
+        return iter(())
 
 
 def run_passes(modules: Iterable[Module],
@@ -159,6 +168,8 @@ def run_passes(modules: Iterable[Module],
                 if not module.suppressions.allows(
                         f.rule, f.lineno, f.end_lineno):
                     findings.append(f)
+    for p in passes:
+        findings.extend(p.finalize())
     findings.sort(key=lambda f: (f.path, f.lineno, f.rule, f.message))
     return findings
 
@@ -181,6 +192,14 @@ def call_name(node: ast.Call) -> Optional[str]:
     return dotted_name(node.func)
 
 
+def self_attr(expr: ast.expr) -> Optional[str]:
+    """``X`` for a ``self.X`` attribute expression; None otherwise."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
 # -- reporting -------------------------------------------------------------
 
 def format_human(findings: Sequence[Finding]) -> str:
@@ -196,10 +215,13 @@ def to_json(findings: Sequence[Finding]) -> str:
         indent=2, sort_keys=True)
 
 
-def main_for(passes: Sequence[LintPass], argv: Optional[Sequence[str]],
+def main_for(passes, argv: Optional[Sequence[str]],
              default_root: pathlib.Path = PACKAGE,
              prog: str = "lint") -> int:
-    """Shared CLI: ``<tool> [root] [--json]``; exit 1 on findings."""
+    """Shared CLI: ``<tool> [root] [--json]``; exit 1 on findings.
+    ``passes`` is a sequence, or a callable ``root -> sequence`` for
+    pass sets whose behavior depends on the walked root (the env-flag
+    registry only checks stale doc rows on a full-package walk)."""
     import argparse
 
     parser = argparse.ArgumentParser(prog=prog)
@@ -207,6 +229,8 @@ def main_for(passes: Sequence[LintPass], argv: Optional[Sequence[str]],
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     args = parser.parse_args(argv)
+    if callable(passes):
+        passes = passes(pathlib.Path(args.root))
     findings = run_passes(iter_modules(pathlib.Path(args.root)), passes)
     if args.json:
         print(to_json(findings))

@@ -1,0 +1,164 @@
+"""Env-flag registry pass: every ``CASSMANTLE_*`` read documented.
+
+The same contract the metric-name pass enforces against the
+``docs/OBSERVABILITY.md`` catalog, applied to operator kill switches:
+every ``CASSMANTLE_*`` environment variable the package reads must
+have a row in the docs/DEPLOY.md §6 lever table, and every row there
+must correspond to a real read — an undocumented flag is a lever the
+operator cannot find at 3 a.m., and a stale row is a lever that
+silently does nothing. Rule ``env-flag``, both directions:
+
+- per module: ``os.environ.get("CASSMANTLE_X")`` / ``os.getenv`` /
+  ``os.environ["CASSMANTLE_X"]`` reads whose flag has no §6 row;
+- finalize(): §6 rows whose flag is never read anywhere in the walked
+  module set (anchored at the docs line).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cassmantle_tpu.analysis.core import (
+    REPO,
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+)
+
+RULE = "env-flag"
+
+REGISTRY_DOC = REPO / "docs" / "DEPLOY.md"
+_SECTION = "## 6."
+_FLAG = re.compile(r"CASSMANTLE_[A-Z0-9_]+")
+
+
+def load_registry(doc: pathlib.Path = REGISTRY_DOC
+                  ) -> Dict[str, int]:
+    """flag -> line number for every ``CASSMANTLE_*`` token in the §6
+    lever table of docs/DEPLOY.md."""
+    if not doc.exists():
+        return {}
+    registry: Dict[str, int] = {}
+    in_section = False
+    for lineno, line in enumerate(doc.read_text().splitlines(), 1):
+        if line.startswith("## "):
+            in_section = line.startswith(_SECTION)
+            continue
+        if in_section:
+            for flag in _FLAG.findall(line):
+                registry.setdefault(flag, lineno)
+    return registry
+
+
+def _flag_const(expr: ast.expr, consts: Dict[str, str]) -> Optional[str]:
+    """A CASSMANTLE_* flag name from a string literal or a module-level
+    constant name (``_PROBE_ENV = "CASSMANTLE_..."``)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+            and expr.value.startswith("CASSMANTLE_"):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id)
+    return None
+
+
+def _module_consts(tree: ast.Module) -> Dict[str, str]:
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str) and \
+                node.value.value.startswith("CASSMANTLE_"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    consts[t.id] = node.value.value
+    return consts
+
+
+def _env_read(node: ast.Call, consts: Dict[str, str]) -> Optional[str]:
+    """The flag name of an env read call, or None. Besides
+    ``os.environ.get``/``os.getenv``, any helper whose name mentions
+    ``env`` taking the flag as its first argument counts (the repo's
+    ``_block_env(...)`` pattern)."""
+    name = call_name(node)
+    if name is None or not node.args:
+        return None
+    last = name.rsplit(".", 1)[-1].lower()
+    if not (name.endswith("environ.get") or "env" in last):
+        return None
+    return _flag_const(node.args[0], consts)
+
+
+def extract_reads(tree: ast.Module) -> List[Tuple[str, int]]:
+    """(flag, lineno) for every CASSMANTLE_* env read in a module:
+    ``os.environ.get(...)``, ``os.getenv(...)``, ``os.environ[...]``
+    subscripts, and ``*env*``-named helpers taking the flag literally —
+    with flag names resolvable through module-level string constants."""
+    consts = _module_consts(tree)
+    reads: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            flag = _env_read(node, consts)
+            if flag is not None:
+                reads.append((flag, node.lineno))
+        elif isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Attribute) and \
+                    base.attr == "environ" and \
+                    isinstance(node.ctx, ast.Load):
+                # Load only: a write (os.environ[FLAG] = ...) exports
+                # state and must not satisfy the registry's "some code
+                # actually reads this lever" direction
+                flag = _flag_const(node.slice, consts)
+                if flag is not None:
+                    reads.append((flag, node.lineno))
+    return reads
+
+
+class EnvFlagPass(LintPass):
+    name = "envflags"
+    description = ("CASSMANTLE_* env reads documented in the "
+                   "docs/DEPLOY.md §6 lever table, and vice versa")
+
+    def __init__(self, registry: Optional[Dict[str, int]] = None,
+                 check_orphans: bool = True) -> None:
+        self._registry = registry
+        self._check_orphans = check_orphans
+        self._seen: Set[str] = set()
+        self._warned_empty = False
+
+    @property
+    def registry(self) -> Dict[str, int]:
+        if self._registry is None:
+            self._registry = load_registry()
+        return self._registry
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        registry = self.registry
+        if not registry and not self._warned_empty:
+            self._warned_empty = True
+            yield Finding(RULE, str(REGISTRY_DOC), 1,
+                          "env-flag registry (§6 lever table) missing "
+                          "or empty")
+        for flag, lineno in extract_reads(module.tree):
+            self._seen.add(flag)
+            if registry and flag not in registry:
+                yield Finding(
+                    RULE, module.rel, lineno,
+                    f"{flag} is read here but has no row in the "
+                    f"docs/DEPLOY.md §6 lever table — document the "
+                    f"switch")
+
+    def finalize(self) -> Iterator[Finding]:
+        if not self._check_orphans:
+            return
+        for flag, lineno in sorted(self.registry.items()):
+            if flag not in self._seen:
+                yield Finding(
+                    RULE, "docs/DEPLOY.md", lineno,
+                    f"{flag} has a §6 lever-table row but is never "
+                    f"read in the package — stale switch (remove the "
+                    f"row or wire the flag)")

@@ -25,21 +25,20 @@ config/host reads, not array syncs.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Sequence, Set
+from typing import Iterator, List, Optional, Sequence, Set
 
 from cassmantle_tpu.analysis.core import (
     Finding,
     LintPass,
     Module,
     call_name,
-    dotted_name,
+)
+from cassmantle_tpu.analysis.jitregions import (
+    function_table,
+    jit_closure,
 )
 
 RULE = "host-sync"
-
-_JIT_NAMES = {"jax.jit", "jit"}
-_JIT_WRAPPERS = {"dp_sharded_sampler"}
-_PARTIAL_NAMES = {"partial", "functools.partial"}
 
 # the serving pipelines + device ops — where a stray sync serializes
 # the DDIM loop (engine/server host code syncs at will)
@@ -85,8 +84,8 @@ class HostSyncPass(LintPass):
         if self.dirs and not any(module.rel.startswith(d)
                                  for d in self.dirs):
             return
-        fns = self._function_table(module.tree)
-        jit_fns = self._jit_closure(module.tree, fns)
+        fns = function_table(module.tree)
+        jit_fns = jit_closure(module.tree, fns)
         seen: Set[int] = set()
         for qual, fn in fns.items():
             if id(fn) in seen:  # bare-name alias of a method entry
@@ -104,96 +103,8 @@ class HostSyncPass(LintPass):
                                       f"out of the loop)",
                                       loops_only=True)
 
-    # -- jit-region discovery ---------------------------------------------
-
-    @staticmethod
-    def _function_table(tree: ast.Module) -> Dict[str, ast.AST]:
-        """qual -> node for top-level functions and methods; bare method
-        names are also keyed (for ``self.X`` / ``jax.jit(self.X)``
-        resolution) when unambiguous enough — first definition wins."""
-        fns: Dict[str, ast.AST] = {}
-        for node in tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                fns.setdefault(node.name, node)
-            elif isinstance(node, ast.ClassDef):
-                for sub in node.body:
-                    if isinstance(sub, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef)):
-                        fns.setdefault(f"{node.name}.{sub.name}", sub)
-                        fns.setdefault(sub.name, sub)
-        return fns
-
-    @staticmethod
-    def _target_names(expr: ast.expr) -> List[str]:
-        """Function names referenced by a jit(...) argument: a bare
-        name, a ``self.X`` attribute, or either inside ``partial``."""
-        if isinstance(expr, ast.Name):
-            return [expr.id]
-        if isinstance(expr, ast.Attribute):
-            return [expr.attr]
-        if isinstance(expr, ast.Call) and \
-                call_name(expr) in _PARTIAL_NAMES and expr.args:
-            return HostSyncPass._target_names(expr.args[0])
-        return []
-
-    def _jit_entries(self, tree: ast.Module,
-                     fns: Dict[str, ast.AST]) -> Set[ast.AST]:
-        entries: Set[ast.AST] = set()
-        # decorated: @jax.jit / @jax.jit(...) / @partial(jax.jit, ...)
-        for fn in set(fns.values()):
-            for dec in getattr(fn, "decorator_list", ()):
-                names = []
-                if isinstance(dec, ast.Call):
-                    dec_name = call_name(dec)
-                    if dec_name in _JIT_NAMES:
-                        names = ["<self>"]
-                    elif dec_name in _PARTIAL_NAMES and dec.args and \
-                            dotted_name(dec.args[0]) in _JIT_NAMES:
-                        names = ["<self>"]
-                elif dotted_name(dec) in _JIT_NAMES:
-                    names = ["<self>"]
-                if names:
-                    entries.add(fn)
-        # passed: jax.jit(f) / jax.jit(partial(f, ...)) /
-        # dp_sharded_sampler(self._sample_impl, ...)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = call_name(node)
-            if name not in _JIT_NAMES and \
-                    (name or "").rsplit(".", 1)[-1] not in _JIT_WRAPPERS:
-                continue
-            if not node.args:
-                continue
-            for target in self._target_names(node.args[0]):
-                if target in fns:
-                    entries.add(fns[target])
-        return entries
-
-    def _jit_closure(self, tree: ast.Module,
-                     fns: Dict[str, ast.AST]) -> Set[ast.AST]:
-        """Entries plus same-module functions they (transitively) call
-        — a helper called from a jit body runs traced too."""
-        closure = set(self._jit_entries(tree, fns))
-        queue = list(closure)
-        while queue:
-            fn = queue.pop()
-            for node in ast.walk(fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                f = node.func
-                target = None
-                if isinstance(f, ast.Name) and f.id in fns:
-                    target = fns[f.id]
-                elif (isinstance(f, ast.Attribute)
-                      and isinstance(f.value, ast.Name)
-                      and f.value.id in ("self", "cls")
-                      and f.attr in fns):
-                    target = fns[f.attr]
-                if target is not None and target not in closure:
-                    closure.add(target)
-                    queue.append(target)
-        return closure
+    # jit-region discovery lives in analysis/jitregions.py (shared with
+    # the recompile/tracer-leak passes).
 
     # -- scanning ----------------------------------------------------------
 

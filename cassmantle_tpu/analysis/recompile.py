@@ -1,0 +1,362 @@
+"""Recompile-hazard pass: jit sites that silently defeat the cache.
+
+The serving stack's latency story depends on every jit site compiling
+ONCE per bucket (spec-decode batch buckets, staged-denoise width
+buckets, the per-strength img2img cache) — a hazard here doesn't crash,
+it ships as a 100x latency cliff that only shows up under real traffic.
+One rule (``recompile-hazard``), four statically-checkable shapes:
+
+1. **jit built in a loop** — ``jax.jit(f)`` evaluated inside a
+   ``for``/``while``/comprehension builds a fresh wrapper (and a fresh
+   empty cache) every iteration: every call compiles. Hoist the jit.
+2. **per-call / unhashable static arguments** — a call through a known
+   jitted callable passing a list/dict/set literal in a static
+   position (``TypeError: unhashable`` at dispatch) or an f-string
+   (hashable but unique per call → one compile per call).
+3. **mutable attribute captured at trace time** — a jitted function
+   reads ``self.X`` where ``self.X`` is *reassigned* outside
+   ``__init__``: the trace baked the old value in, so the mutation is
+   silently ignored until an unrelated retrace picks it up —
+   value-dependent behavior must enter as an argument. (Attributes
+   assigned once, lazily, outside ``__init__`` are exempt: lazy init
+   is a construction pattern, not mutation.)
+4. **unbucketed shapes fed to a jit inside a loop** — calling a jitted
+   function in a loop with a ``x[i:j]``-style slice whose bounds are
+   loop data: every distinct length is a fresh compile. Pad to a
+   bucket ladder like the serving paths do. Same hazard for ``len(x)``
+   / ``x.shape[i]`` scalars passed as *traced* args that the callee
+   branches on (``if``/``while``/``range``): that branch either fails
+   to trace or forces the author to mark it static — one compile per
+   distinct value.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    self_attr,
+)
+from cassmantle_tpu.analysis.jitregions import (
+    JIT_NAMES,
+    JitAlias,
+    JitEntry,
+    function_table,
+    jit_aliases,
+    jit_closure,
+    jit_entries,
+)
+
+RULE = "recompile-hazard"
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+          ast.DictComp, ast.GeneratorExp)
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+_is_self_attr = self_attr  # shared AST helper (analysis/core.py)
+
+
+def _branched_params(fn: ast.AST) -> Set[str]:
+    """Parameter names the function branches host control flow on:
+    used (directly or in a comparison/boolop) as an ``if``/``while``
+    test, or as an argument to ``range()``."""
+    params = {a.arg for a in fn.args.args}
+    hits: Set[str] = set()
+
+    def names_in(expr: ast.expr) -> Set[str]:
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            hits |= names_in(node.test) & params
+        elif isinstance(node, ast.Call) and call_name(node) == "range":
+            for arg in node.args:
+                hits |= names_in(arg) & params
+    return hits
+
+
+def _shape_derived(expr: ast.expr) -> Optional[str]:
+    """'len(x)' / 'x.shape[0]'-style host scalars, described; else
+    None."""
+    if isinstance(expr, ast.Call) and call_name(expr) == "len":
+        return "len(...)"
+    node = expr
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr == "shape":
+        return ".shape"
+    return None
+
+
+def _loose_slice(expr: ast.expr) -> bool:
+    """A subscript slice whose LENGTH can vary per iteration — the
+    per-iteration-shape hazard (``x[i:j]``, ``x[:n]``). A sliding
+    window of constant width (``x[off:off + 128]``) has one shape and
+    is exempt."""
+    if not (isinstance(expr, ast.Subscript)
+            and isinstance(expr.slice, ast.Slice)):
+        return False
+    lower, upper = expr.slice.lower, expr.slice.upper
+    if all(b is None or isinstance(b, ast.Constant)
+           for b in (lower, upper)):
+        return False
+    if isinstance(lower, ast.Name) and isinstance(upper, ast.BinOp) \
+            and isinstance(upper.op, ast.Add):
+        # off : off + CONST (either operand order) — constant width
+        operands = (upper.left, upper.right)
+        if any(isinstance(a, ast.Name) and a.id == lower.id
+               for a in operands) and \
+                any(isinstance(a, ast.Constant) for a in operands):
+            return False
+    return True
+
+
+class RecompilePass(LintPass):
+    name = "recompile"
+    description = ("jit-cache hazards: jit built in loops, per-call/"
+                   "unhashable statics, mutable attr capture, "
+                   "unbucketed shapes")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        fns = function_table(module.tree)
+        entries = jit_entries(module.tree, fns)
+        aliases = jit_aliases(module.tree, fns, entries)
+        mutated = self._mutated_attrs(module.tree)
+        yield from self._scan_jit_in_loop(module)
+        yield from self._scan_call_sites(module, fns, entries, aliases)
+        yield from self._scan_captures(module, fns, entries, mutated)
+
+    # -- (1) jit built inside a loop --------------------------------------
+
+    def _scan_jit_in_loop(self, module: Module) -> Iterator[Finding]:
+        findings: List[Finding] = []
+
+        def scan(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, _LOOPS):
+                in_loop = True
+            if in_loop and isinstance(node, ast.Call) and \
+                    call_name(node) in JIT_NAMES:
+                findings.append(Finding(
+                    RULE, module.rel, node.lineno,
+                    "jax.jit(...) evaluated inside a loop builds a "
+                    "fresh wrapper (and empty cache) per iteration — "
+                    "every call recompiles; hoist the jit out of the "
+                    "loop", getattr(node, "end_lineno", None)))
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_loop)
+
+        scan(module.tree, in_loop=False)
+        yield from findings
+
+    # -- (2) + (4) call sites of known jitted callables -------------------
+
+    def _static_positions(self, alias: JitAlias
+                          ) -> Tuple[Set[int], Set[str]]:
+        """(call-site static positions, static argnames): positions
+        are in CALL-SITE terms — partial-bound leading params are gone
+        from the callable's signature, so entry params map through
+        ``alias.bound`` (alias.static_argnums already index the
+        reduced signature). An alias whose own jit site declared
+        statics trusts ONLY those: the entry may merge several jit
+        sites of one function, and another alias's declarations must
+        not reclassify this one's traced positions."""
+        nums = set(alias.static_argnums)
+        names = set(alias.static_argnames)
+        if not alias.explicit and alias.entry is not None:
+            for i, p in enumerate(alias.entry.params[alias.bound:]):
+                if p in alias.entry.static_params:
+                    nums.add(i)
+                    names.add(p)
+        return nums, names
+
+    @staticmethod
+    def _param_at(alias: JitAlias, i: int) -> Optional[str]:
+        """The callee parameter a call-site positional ``i`` binds to,
+        through the partial-bound offset."""
+        if alias.entry is None:
+            return None
+        params = alias.entry.params
+        j = alias.bound + i
+        return params[j] if j < len(params) else None
+
+    def _resolve_alias(self, node: ast.Call,
+                       aliases: Dict[str, JitAlias]) -> Optional[JitAlias]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return aliases.get(f.id)
+        attr = _is_self_attr(f)
+        if attr is not None:
+            return aliases.get(attr)
+        return None
+
+    def _scan_call_sites(self, module: Module, fns, entries,
+                         aliases: Dict[str, JitAlias]
+                         ) -> Iterator[Finding]:
+        findings: List[Finding] = []
+
+        def check_call(node: ast.Call, in_loop: bool) -> None:
+            alias = self._resolve_alias(node, aliases)
+            if alias is None:
+                return
+            static_nums, static_names = self._static_positions(alias)
+            entry = alias.entry
+            branched = (_branched_params(entry.fn)
+                        if entry is not None else set())
+            for i, arg in enumerate(node.args):
+                param = self._param_at(alias, i)
+                is_static = i in static_nums or (
+                    param is not None and param in static_names)
+                if is_static:
+                    if isinstance(arg, _UNHASHABLE):
+                        findings.append(Finding(
+                            RULE, module.rel, arg.lineno,
+                            f"unhashable literal in static position "
+                            f"{i} of jitted {alias.key!r}: TypeError "
+                            f"at dispatch (statics key the jit cache "
+                            f"by hash)",
+                            getattr(arg, "end_lineno", None)))
+                    elif isinstance(arg, ast.JoinedStr):
+                        findings.append(Finding(
+                            RULE, module.rel, arg.lineno,
+                            f"f-string in static position {i} of "
+                            f"jitted {alias.key!r}: a per-call string "
+                            f"keys a fresh cache entry — one compile "
+                            f"per call",
+                            getattr(arg, "end_lineno", None)))
+                    continue
+                # traced positions
+                if in_loop and _loose_slice(arg):
+                    findings.append(Finding(
+                        RULE, module.rel, arg.lineno,
+                        f"unbucketed slice passed to jitted "
+                        f"{alias.key!r} inside a loop: every distinct "
+                        f"length is a fresh compile — pad to a bucket "
+                        f"ladder", getattr(arg, "end_lineno", None)))
+                desc = _shape_derived(arg)
+                if desc is not None and param is not None \
+                        and param in branched:
+                    findings.append(Finding(
+                        RULE, module.rel, arg.lineno,
+                        f"host scalar ({desc}) passed as traced arg "
+                        f"{param!r} of jitted {alias.key!r}, "
+                        f"which branches on it: the branch cannot "
+                        f"trace — and marking it static recompiles "
+                        f"per distinct value; bucket it or use "
+                        f"lax.cond/fori_loop",
+                        getattr(arg, "end_lineno", None)))
+            for kw in node.keywords:
+                if kw.arg in static_names and \
+                        isinstance(kw.value, _UNHASHABLE):
+                    findings.append(Finding(
+                        RULE, module.rel, kw.value.lineno,
+                        f"unhashable literal for static argname "
+                        f"{kw.arg!r} of jitted {alias.key!r}: "
+                        f"TypeError at dispatch",
+                        getattr(kw.value, "end_lineno", None)))
+                elif kw.arg in static_names and \
+                        isinstance(kw.value, ast.JoinedStr):
+                    findings.append(Finding(
+                        RULE, module.rel, kw.value.lineno,
+                        f"f-string for static argname {kw.arg!r} of "
+                        f"jitted {alias.key!r}: one compile per call",
+                        getattr(kw.value, "end_lineno", None)))
+
+        def scan(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, _LOOPS):
+                in_loop = True
+            if isinstance(node, ast.Call):
+                check_call(node, in_loop)
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_loop)
+
+        scan(module.tree, in_loop=False)
+        yield from findings
+
+    # -- (3) mutable attribute capture ------------------------------------
+
+    @staticmethod
+    def _mutated_attrs(tree: ast.Module) -> Dict[str, Set[str]]:
+        """class -> ``self.X`` attrs that are genuinely *mutated*:
+        AugAssigned anywhere, or plain-assigned outside ``__init__``
+        when ``__init__`` also assigns them (reassignment of
+        constructed state), or assigned across SEVERAL non-init
+        methods. One-shot lazy assignment outside __init__ — even a
+        branchy one inside a single ``_ensure``-style method — is
+        construction, not mutation."""
+        out: Dict[str, Set[str]] = {}
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            init_assigned: Set[str] = set()
+            later_methods: Dict[str, Set[str]] = {}
+            aug: Set[str] = set()
+            for sub in node.body:
+                if not isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    continue
+                for n in ast.walk(sub):
+                    targets: List[ast.expr] = []
+                    if isinstance(n, ast.Assign):
+                        targets = n.targets
+                    elif isinstance(n, ast.AugAssign):
+                        attr = _is_self_attr(n.target)
+                        if attr is not None:
+                            aug.add(attr)
+                        continue
+                    for t in targets:
+                        attr = _is_self_attr(t)
+                        if attr is None:
+                            continue
+                        if sub.name == "__init__":
+                            init_assigned.add(attr)
+                        else:
+                            later_methods.setdefault(
+                                attr, set()).add(sub.name)
+            mutated = aug | {a for a, ms in later_methods.items()
+                             if a in init_assigned or len(ms) > 1}
+            if mutated:
+                out[node.name] = mutated
+        return out
+
+    def _scan_captures(self, module: Module, fns,
+                       entries: Dict[ast.AST, JitEntry],
+                       mutated: Dict[str, Set[str]]) -> Iterator[Finding]:
+        if not mutated:
+            return
+        # map each method node to its class
+        cls_of: Dict[ast.AST, str] = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        cls_of[sub] = node.name
+        closure = jit_closure(module.tree, fns, set(entries))
+        for fn in closure:
+            cls = cls_of.get(fn)
+            if cls is None or cls not in mutated:
+                continue
+            reported: Set[str] = set()
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Attribute) or \
+                        not isinstance(n.ctx, ast.Load):
+                    continue
+                attr = _is_self_attr(n)
+                if attr in mutated[cls] and attr not in reported:
+                    reported.add(attr)
+                    yield Finding(
+                        RULE, module.rel, n.lineno,
+                        f"jit-traced {fn.name!r} captures mutable "
+                        f"attribute self.{attr} (reassigned elsewhere "
+                        f"in {cls}): the trace bakes the value at "
+                        f"compile time, so mutations are silently "
+                        f"stale — pass it as an argument",
+                        getattr(n, "end_lineno", None))

@@ -1,0 +1,251 @@
+"""Tracer-leak pass: traced values escaping (or steering) a jit region.
+
+Inside a jit trace every intermediate is a tracer. Two escape classes,
+one rule (``tracer-leak``):
+
+1. **Stores that outlive the trace** — assignments to ``self.*``,
+   module globals (``global``/``nonlocal`` writes), or mutations of
+   containers created *outside* the function (``outer.append(x)``,
+   ``outer[k] = x`` on a non-local name). The stored tracer is dead
+   the moment tracing finishes: later reads raise
+   ``UnexpectedTracerError`` — or worse, silently hold the value of
+   the FIRST trace forever (a stale-constant bug, the mirror of the
+   recompile pass's capture hazard).
+
+2. **Host control flow on traced values** — ``if``/``while`` whose
+   test involves a traced parameter or a ``jnp.*`` result:
+   ``TracerBoolConversionError`` at trace time. Caught statically so
+   the author reaches for ``lax.cond``/``jnp.where`` before the trace
+   explodes. Tests on statics, ``x is None`` guards, ``isinstance``,
+   and shape/dtype/ndim reads are concrete at trace time and exempt.
+
+Scope: functions in the module's jit closure (entries + same-module
+transitive callees, via ``analysis/jitregions.py``). The traced-branch
+check runs only on *entry* functions, where static/partial-bound
+parameters are known — helpers routinely take host config scalars, and
+flagging those would be noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    self_attr,
+)
+from cassmantle_tpu.analysis.jitregions import (
+    function_table,
+    jit_closure,
+    jit_entries,
+)
+
+RULE = "tracer-leak"
+
+_MUTATORS = {"append", "extend", "add", "insert", "update", "setdefault",
+             "appendleft"}
+
+
+_is_self_attr = self_attr  # shared AST helper (analysis/core.py)
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Names bound inside the function: params + assignment/loop/with
+    targets + comprehension targets + nested def/lambda names."""
+    names: Set[str] = set()
+    args = fn.args
+    for a in (args.args + args.kwonlyargs + args.posonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    declared: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            declared |= set(node.names)
+    # subtract AFTER the walk: ast.walk is breadth-first, so a Store
+    # nested under a later-visited Assign would re-add a name the
+    # Global statement already excluded
+    return names - declared
+
+
+def _concrete_test(test: ast.expr, traced: Set[str]) -> bool:
+    """True when a test is concrete at trace time even though it
+    mentions a traced name: ``x is None`` guards, ``isinstance``,
+    ``len()``/``.shape``/``.ndim``/``.dtype`` reads, or no traced name
+    at all."""
+    involved = {n.id for n in ast.walk(test)
+                if isinstance(n, ast.Name)} & traced
+    if not involved:
+        return True
+    # every traced-name occurrence must sit under a concrete extractor
+    concrete_spans: List[ast.expr] = []
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+            concrete_spans.append(node)
+        elif isinstance(node, ast.Call) and \
+                call_name(node) in ("len", "isinstance", "getattr",
+                                    "hasattr"):
+            concrete_spans.append(node)
+        elif isinstance(node, ast.Attribute) and \
+                node.attr in ("shape", "ndim", "dtype", "size"):
+            concrete_spans.append(node)
+
+    def covered(name_node: ast.Name) -> bool:
+        return any(name_node in ast.walk(span)
+                   for span in concrete_spans)
+
+    return all(covered(n) for n in ast.walk(test)
+               if isinstance(n, ast.Name) and n.id in traced)
+
+
+class TracerLeakPass(LintPass):
+    name = "tracerleak"
+    description = ("traced values stored outside jit regions; host "
+                   "control flow on traced values")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        fns = function_table(module.tree)
+        entries = jit_entries(module.tree, fns)
+        closure = jit_closure(module.tree, fns, set(entries))
+        seen: Set[int] = set()
+        for fn in closure:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            yield from self._scan_stores(module, fn)
+            entry = entries.get(fn)
+            if entry is not None:
+                yield from self._scan_branches(module, fn,
+                                               set(entry.traced_params))
+
+    # -- (1) escaping stores ----------------------------------------------
+
+    def _scan_stores(self, module: Module, fn: ast.AST
+                     ) -> Iterator[Finding]:
+        local = _local_names(fn)
+        declared_nonlocal: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared_nonlocal |= set(node.names)
+        # nested defs are NOT skipped: a closure built inside a jit
+        # body (a scan body, a denoiser fn) runs traced too — the same
+        # stance hostsync takes. Host-side callbacks nested in jit
+        # code (jax.debug.callback targets) are rare enough to carry a
+        # suppression with their reason.
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _is_self_attr(t)
+                    if attr is not None:
+                        yield Finding(
+                            RULE, module.rel, node.lineno,
+                            f"store to self.{attr} inside jit-traced "
+                            f"{fn.name!r}: the tracer escapes the "
+                            f"trace (UnexpectedTracerError on later "
+                            f"use, or a stale first-trace constant) — "
+                            f"return the value instead",
+                            getattr(node, "end_lineno", None))
+                    elif isinstance(t, ast.Name) and \
+                            t.id in declared_nonlocal:
+                        yield Finding(
+                            RULE, module.rel, node.lineno,
+                            f"store to global/nonlocal {t.id!r} inside "
+                            f"jit-traced {fn.name!r}: the tracer "
+                            f"escapes the trace — return the value "
+                            f"instead",
+                            getattr(node, "end_lineno", None))
+                    elif isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id not in local:
+                        yield Finding(
+                            RULE, module.rel, node.lineno,
+                            f"subscript store into outer container "
+                            f"{t.value.id!r} inside jit-traced "
+                            f"{fn.name!r}: the tracer escapes the "
+                            f"trace — return the value instead",
+                            getattr(node, "end_lineno", None))
+            elif isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr in _MUTATORS and node.value.args:
+                # only bare-statement calls: a used result
+                # (``updates, s = opt.update(...)``) is a pure
+                # functional API, not a container mutation
+                node = node.value
+                recv = node.func.value
+                escapes = (_is_self_attr(recv) is not None
+                           or (isinstance(recv, ast.Name)
+                               and recv.id not in local))
+                if escapes:
+                    where = (f"self.{_is_self_attr(recv)}"
+                             if _is_self_attr(recv) is not None
+                             else recv.id)
+                    yield Finding(
+                        RULE, module.rel, node.lineno,
+                        f".{node.func.attr}() into outer container "
+                        f"{where!r} inside jit-traced {fn.name!r}: "
+                        f"the tracer escapes the trace — return the "
+                        f"value instead",
+                        getattr(node, "end_lineno", None))
+
+    # -- (2) host control flow on traced values ----------------------------
+
+    def _scan_branches(self, module: Module, fn: ast.AST,
+                       traced: Set[str]) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            if test is None:
+                continue
+            # a jnp.* ARRAY result in a test is traced regardless of
+            # params; host-concrete jax APIs (jax.default_backend(),
+            # jax.devices()) are fine, so only the numpy namespace —
+            # the one producing arrays — trips this
+            jnp_call = next(
+                (n for n in ast.walk(test)
+                 if isinstance(n, ast.Call)
+                 and ((call_name(n) or "").startswith("jnp.")
+                      or (call_name(n) or "").startswith("jax.numpy."))),
+                None)
+            if jnp_call is not None:
+                yield Finding(
+                    RULE, module.rel, test.lineno,
+                    f"jnp/jax result used as a host "
+                    f"{'if' if not isinstance(node, ast.While) else 'while'} "
+                    f"condition inside jit-traced {fn.name!r}: "
+                    f"TracerBoolConversionError at trace time — use "
+                    f"lax.cond / jnp.where",
+                    getattr(test, "end_lineno", None))
+                continue
+            if traced and not _concrete_test(test, traced):
+                names = sorted({n.id for n in ast.walk(test)
+                                if isinstance(n, ast.Name)
+                                and n.id in traced})
+                yield Finding(
+                    RULE, module.rel, test.lineno,
+                    f"traced parameter(s) {names} drive a host "
+                    f"{'while' if isinstance(node, ast.While) else 'if'} "
+                    f"inside jit-traced {fn.name!r}: "
+                    f"TracerBoolConversionError at trace time — use "
+                    f"lax.cond / jnp.where, or declare the arg static "
+                    f"and bucket its values",
+                    getattr(test, "end_lineno", None))

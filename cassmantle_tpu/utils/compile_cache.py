@@ -25,6 +25,12 @@ _enabled = False
 
 def enable_compile_cache() -> None:
     global _enabled
+    # every pipeline/scorer boot passes through here: piggyback the
+    # opt-in jit compile-count sentinel (utils/jit_sentinel.py) so
+    # CASSMANTLE_JIT_SENTINEL=1 needs no per-pipeline wiring
+    from cassmantle_tpu.utils.jit_sentinel import maybe_enable_from_env
+
+    maybe_enable_from_env()
     if _enabled:
         return
     import jax

@@ -59,7 +59,7 @@ def pytest_configure(config):
 
 FAST_MODULES = frozenset({
     "test_aux", "test_bench_harness", "test_check_concurrency",
-    "test_check_metrics", "test_eval",
+    "test_check_jax", "test_check_metrics", "test_eval",
     "test_fault_injection",
     "test_flash_attention", "test_frontend", "test_fused_conv",
     "test_game", "test_js_runtime", "test_layers_norm", "test_masking",
@@ -119,6 +119,24 @@ def _lock_sentinel():
     yield
     locks.disable_sentinel()
     locks.reset_observations()
+
+
+@pytest.fixture(autouse=True)
+def _jit_sentinel():
+    """Arm the jit compile-count sentinel (utils/jit_sentinel.py) for
+    EVERY test, with per-test count reset — the compile-cache
+    counterpart of the lock sentinel above. Arming only counts; tests
+    on steady-state serving paths opt into the hard assertion with
+    ``with jit_sentinel.no_new_compiles():`` after their warmup
+    dispatch, so a recompile regression (a bucket key quietly becoming
+    per-call) fails tier-1 instead of shipping as a latency cliff."""
+    from cassmantle_tpu.utils import jit_sentinel
+
+    jit_sentinel.reset_counts()
+    jit_sentinel.enable_sentinel()
+    yield
+    jit_sentinel.disable_sentinel()
+    jit_sentinel.reset_counts()
 
 
 @pytest.fixture(scope="session")

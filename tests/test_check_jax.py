@@ -1,0 +1,717 @@
+"""JAX dispatch-discipline lint + jit compile-count sentinel gate
+(fast tier).
+
+Golden fixture snippets pin each rule of the four
+``cassmantle_tpu/analysis`` JAX passes (known violations must fail;
+suppressed / static-declared / copy-fixed variants must pass), the
+PR 6 ``_steps``-mirror aliasing bug is pinned as a golden pair for
+``buffer-escape`` (the way PR 4 pinned the PR 1 dispatch-deadlock
+shape for ``lock-order-cycle``), the repo itself must lint clean
+through the real entry points (``tools/check_jax.py``,
+``tools/lint_all.py``), and the ``utils/jit_sentinel`` runtime
+counterpart must raise on seeded post-warmup recompiles while leaving
+warmed cache hits alone.
+"""
+
+import textwrap
+
+import pytest
+
+from cassmantle_tpu.analysis.bufferescape import BufferEscapePass
+from cassmantle_tpu.analysis.core import parse_source, run_passes
+from cassmantle_tpu.analysis.envflags import EnvFlagPass
+from cassmantle_tpu.analysis.recompile import RecompilePass
+from cassmantle_tpu.analysis.tracerleak import TracerLeakPass
+from cassmantle_tpu.utils import jit_sentinel
+from cassmantle_tpu.utils.jit_sentinel import JitRecompileError
+
+
+def lint(src, *passes, rel="<fixture>"):
+    return run_passes([parse_source(textwrap.dedent(src), rel)],
+                      list(passes))
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- recompile-hazard pass ---------------------------------------------------
+
+def test_jit_built_in_loop_fails_and_suppression_passes():
+    src = """
+        import jax
+
+        def run(f, xs):
+            out = []
+            for x in xs:
+                out.append(jax.jit(f)(x)){sup}
+            return out
+    """
+    findings = lint(src.format(sup=""), RecompilePass())
+    assert rules(findings) == ["recompile-hazard"]
+    assert "inside a loop" in findings[0].message
+    sup = "  # lint: ignore[recompile-hazard] — fixture reason"
+    assert lint(src.format(sup=sup), RecompilePass()) == []
+
+
+def test_unhashable_and_fstring_statics_fail():
+    findings = lint("""
+        import jax
+
+        def f(x, mode, cfg):
+            return x
+
+        g = jax.jit(f, static_argnums=(2,), static_argnames=("mode",))
+
+        def call(x, i):
+            a = g(x, mode=f"bucket{i}")   # per-call string static
+            b = g(x, "m", [1, 2])         # unhashable static
+            return a, b
+    """, RecompilePass())
+    assert rules(findings) == ["recompile-hazard"] * 2
+    assert any("f-string" in f.message for f in findings)
+    assert any("unhashable" in f.message for f in findings)
+
+
+def test_plain_hashable_statics_are_clean():
+    assert lint("""
+        import jax
+
+        def f(x, mode):
+            return x
+
+        g = jax.jit(f, static_argnames=("mode",))
+
+        def call(x):
+            return g(x, mode="bucket8")
+    """, RecompilePass()) == []
+
+
+def test_mutable_attr_capture_fails_lazy_init_is_clean():
+    findings = lint("""
+        import jax
+
+        class P:
+            def __init__(self):
+                self._scale = 1.0
+                self._fn = jax.jit(self._impl)
+
+            def set_scale(self, s):
+                self._scale = s          # reassigns constructed state
+
+            def _impl(self, x):
+                return x * self._scale   # baked in at trace time
+    """, RecompilePass())
+    assert rules(findings) == ["recompile-hazard"]
+    assert "self._scale" in findings[0].message
+    # one-shot lazy init (assigned once, outside __init__, never in
+    # __init__) is a construction pattern, not mutation
+    assert lint("""
+        import jax
+
+        class P:
+            def _ensure(self):
+                self.enc = make_encoder()
+
+            def _impl(self, x):
+                return self.enc.apply(x)
+
+            def build(self):
+                self._fn = jax.jit(self._impl)
+    """, RecompilePass()) == []
+
+
+def test_unbucketed_slice_into_jit_in_loop_fails():
+    findings = lint("""
+        import jax
+
+        def f(x):
+            return x
+
+        g = jax.jit(f)
+
+        def run(xs, lens):
+            out = []
+            for i, n in enumerate(lens):
+                out.append(g(xs[i][:n]))
+            return out
+    """, RecompilePass())
+    assert rules(findings) == ["recompile-hazard"]
+    assert "bucket ladder" in findings[0].message
+
+
+def test_shape_scalar_branched_on_by_callee_fails():
+    findings = lint("""
+        import jax
+
+        def f(x, n):
+            if n:
+                return x
+            return x * 2
+
+        g = jax.jit(f)
+
+        def call(x):
+            return g(x, len(x))
+    """, RecompilePass())
+    assert rules(findings) == ["recompile-hazard"]
+    assert "branches on it" in findings[0].message
+
+
+def test_static_positions_map_through_partial_bound_args():
+    """A ``jax.jit(partial(self._impl, k), static_argnames=...)`` alias
+    offsets call-site positions past the partial-bound params: the
+    f-string landing in the declared-static slot is flagged, and a
+    traced arg at call position 0 is NOT mistaken for the bound
+    static."""
+    src = """
+        import jax
+        from functools import partial
+
+        class P:
+            def __init__(self, k):
+                self._fn = jax.jit(partial(self._impl, k),
+                                   static_argnames=("mode",))
+
+            def _impl(self, k, x, mode):
+                return x
+
+            def call(self, x, i):
+                return self._fn({args})
+    """
+    bad = lint(src.format(args='x, f"bucket{i}"'), RecompilePass())
+    assert rules(bad) == ["recompile-hazard"]
+    assert "f-string" in bad[0].message
+    # the traced call position 0 maps to param 'x', not the bound 'k'
+    assert lint(src.format(args="x, mode='m'"), RecompilePass()) == []
+
+
+def test_multi_site_statics_do_not_cross_contaminate_aliases():
+    """One function jitted at two sites with different statics: the
+    plain alias's traced positions must not inherit the other site's
+    static declarations (a traced list pytree is legal there)."""
+    src = """
+        import jax
+
+        def f(x, cfg):
+            return x
+
+        g1 = jax.jit(f)
+        g2 = jax.jit(f, static_argnums=(1,))
+
+        def call(x):
+            a = g1(x, [1, 2])    # traced pytree: legal
+            b = g2(x, {target})
+            return a, b
+    """
+    clean = lint(src.format(target='("t",)'), RecompilePass())
+    assert clean == []
+    bad = lint(src.format(target="[1, 2]"), RecompilePass())
+    assert rules(bad) == ["recompile-hazard"]
+    assert "'g2'" in bad[0].message
+
+
+def test_decorated_method_static_argnums_count_self():
+    """jax jits a DECORATED method unbound — ``self`` is position 0,
+    so ``static_argnums=(1,)`` names the first real parameter."""
+    src = """
+        import jax
+        from functools import partial
+
+        class P:
+            @partial(jax.jit, static_argnums=({idx},))
+            def f(self, n, x):
+                if n:
+                    return x
+                return -x
+    """
+    # index 1 == n: the branch is on a static — clean
+    assert lint(src.format(idx=1), TracerLeakPass()) == []
+    # index 2 == x: n stays traced, the branch is a trace error
+    findings = lint(src.format(idx=2), TracerLeakPass())
+    assert rules(findings) == ["tracer-leak"]
+    assert "'n'" in findings[0].message
+
+
+def test_false_positive_shapes_stay_clean():
+    """FP regression pins: (a) a constant-width sliding window in a
+    loop has ONE shape; (b) branchy one-shot lazy init inside a single
+    ``_ensure`` method is construction, not mutation; (c) two classes
+    sharing an attribute name with different jit signatures make the
+    alias ambiguous — dropped, not misattributed."""
+    assert lint("""
+        import jax
+
+        def f(x):
+            return x
+
+        g = jax.jit(f)
+
+        def run(xs, n):
+            out = []
+            for off in range(0, n, 128):
+                out.append(g(xs[off:off + 128]))
+            return out
+    """, RecompilePass()) == []
+    assert lint("""
+        import jax
+
+        class P:
+            def _ensure(self, use_flash):
+                if use_flash:
+                    self.enc = FlashEnc()
+                else:
+                    self.enc = XlaEnc()
+
+            def _impl(self, x):
+                return self.enc(x)
+
+            def build(self):
+                self._fn = jax.jit(self._impl)
+    """, RecompilePass()) == []
+    assert lint("""
+        import jax
+
+        def f(x, cfg):
+            return x
+
+        def h(x, y):
+            return x
+
+        class A:
+            def __init__(self):
+                self._fn = jax.jit(f, static_argnums=(1,))
+
+        class B:
+            def __init__(self):
+                self._fn = jax.jit(h)
+
+            def call(self, x):
+                return self._fn(x, [1, 2])   # h's traced pytree: legal
+    """, RecompilePass()) == []
+
+
+def test_host_concrete_jax_calls_in_conditions_are_clean():
+    """jax host APIs (default_backend, devices) are concrete at trace
+    time — only jnp.* array results trip the condition check."""
+    assert lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if jax.default_backend() == "cpu":
+                return x
+            return x * 2
+    """, TracerLeakPass()) == []
+
+
+# -- tracer-leak pass --------------------------------------------------------
+
+def test_store_to_self_in_jit_fails():
+    findings = lint("""
+        import jax
+
+        class P:
+            def build(self):
+                self._fn = jax.jit(self._impl)
+
+            def _impl(self, x):
+                self.last = x
+                return x
+    """, TracerLeakPass())
+    assert rules(findings) == ["tracer-leak"]
+    assert "self.last" in findings[0].message
+
+
+def test_append_to_outer_container_in_jit_fails():
+    findings = lint("""
+        import jax
+
+        acc = []
+
+        @jax.jit
+        def f(x):
+            acc.append(x)
+            return x
+    """, TracerLeakPass())
+    assert rules(findings) == ["tracer-leak"]
+    assert "acc" in findings[0].message
+
+
+def test_pure_update_result_used_is_clean():
+    """optax-style ``updates, s = opt.update(...)`` is a pure
+    functional API — only bare-statement mutator calls are container
+    mutations."""
+    assert lint("""
+        import jax
+
+        class T:
+            def build(self):
+                self._step = jax.jit(self._impl)
+
+            def _impl(self, params, opt_state, grads):
+                updates, new_opt = self.optimizer.update(
+                    grads, opt_state, params)
+                return updates, new_opt
+    """, TracerLeakPass()) == []
+
+
+def test_branch_on_traced_param_fails_static_is_clean():
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit{statics})
+        def f(x, mode):
+            if mode:
+                return x
+            return -x
+    """
+    bad = lint(src.format(statics=""), TracerLeakPass())
+    assert rules(bad) == ["tracer-leak"]
+    assert "mode" in bad[0].message
+    clean = lint(src.format(statics=", static_argnums=(1,)"),
+                 TracerLeakPass())
+    assert clean == []
+
+
+def test_concrete_guards_on_traced_params_are_clean():
+    assert lint("""
+        import jax
+
+        @jax.jit
+        def f(x, y):
+            if y is None:
+                return x
+            if x.shape[0] > 4:
+                return x + y
+            if len(x) > 2:
+                return x - y
+            return x
+    """, TracerLeakPass()) == []
+
+
+def test_jnp_result_in_while_condition_fails():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            while jnp.any(x > 0):
+                x = x - 1
+            return x
+    """, TracerLeakPass())
+    assert rules(findings) == ["tracer-leak"]
+    assert "lax.cond" in findings[0].message
+
+
+# -- buffer-escape pass: the PR 6 _steps aliasing bug, pinned ----------------
+
+_STEPS_MIRROR_SRC = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class StagedServer:
+        def __init__(self, capacity):
+            self._steps = np.zeros((capacity,), dtype=np.int32)
+
+        def _denoise_tick(self):
+            idx = jnp.asarray(self._steps{copy})
+            self._dispatch(idx)
+            self._note_step()
+
+        def _note_step(self):
+            self._steps[0] += 1
+"""
+
+
+def test_pr6_steps_mirror_aliasing_shape_is_caught():
+    """Regression fixture: the PR 6 silently-wrong-images bug — the
+    ``_steps`` numpy mirror handed to ``jnp.asarray`` (zero-copy alias
+    on the CPU backend) while ``_note_step`` mutates it in place right
+    after the async dispatch. The shipped ``.copy()`` fix is the clean
+    variant."""
+    findings = lint(_STEPS_MIRROR_SRC.format(copy=""),
+                    BufferEscapePass())
+    assert rules(findings) == ["buffer-escape"]
+    assert "self._steps" in findings[0].message
+    assert ".copy()" in findings[0].message
+
+
+def test_pr6_steps_mirror_copy_fix_is_clean():
+    assert lint(_STEPS_MIRROR_SRC.format(copy=".copy()"),
+                BufferEscapePass()) == []
+
+
+def test_mirror_into_executor_submit_fails_and_suppression_passes():
+    src = """
+        import numpy as np
+
+        class W:
+            def __init__(self, ex):
+                self._mask = np.zeros((8,), dtype=bool)
+                self._ex = ex
+
+            def kick(self):
+                fut = self._ex.submit(work, self._mask){sup}
+                self._mask[0] = True
+                return fut
+    """
+    findings = lint(src.format(sup=""), BufferEscapePass())
+    assert rules(findings) == ["buffer-escape"]
+    sup = "  # lint: ignore[buffer-escape] — fixture reason"
+    assert lint(src.format(sup=sup), BufferEscapePass()) == []
+
+
+def test_unmutated_mirror_and_host_reads_are_clean():
+    assert lint("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        class S:
+            def __init__(self):
+                self._alive = np.zeros((8,), dtype=bool)
+                self._consts = np.arange(8)
+
+            def tick(self):
+                live = np.flatnonzero(self._alive)   # host read: no sink
+                return jnp.asarray(self._consts)     # never mutated
+    """, BufferEscapePass()) == []
+
+
+# -- env-flag registry pass --------------------------------------------------
+
+_REG = {"CASSMANTLE_DOCUMENTED": 42}
+
+
+def test_undocumented_env_read_fails_documented_passes():
+    src = """
+        import os
+
+        A = os.environ.get("CASSMANTLE_DOCUMENTED", "")
+        B = os.environ.get("CASSMANTLE_MYSTERY", "")
+    """
+    findings = lint(src, EnvFlagPass(registry=dict(_REG),
+                                     check_orphans=False))
+    assert rules(findings) == ["env-flag"]
+    assert "CASSMANTLE_MYSTERY" in findings[0].message
+
+
+def test_env_reads_resolve_consts_helpers_and_subscripts():
+    src = """
+        import os
+
+        _PROBE = "CASSMANTLE_PROBE"
+
+        def _block_env(name, default):
+            return default
+
+        A = os.environ.get(_PROBE)
+        B = _block_env("CASSMANTLE_TILE", 1024)
+        C = os.environ["CASSMANTLE_RAW"]
+        os.environ[_PROBE] = "cached"
+    """
+    reg = {"CASSMANTLE_PROBE": 1, "CASSMANTLE_TILE": 2,
+           "CASSMANTLE_RAW": 3}
+    assert lint(src, EnvFlagPass(registry=reg,
+                                 check_orphans=False)) == []
+    # against a foreign registry every READ is undocumented
+    findings = lint(src, EnvFlagPass(registry={"CASSMANTLE_OTHER": 1},
+                                     check_orphans=False))
+    assert {f.message.split()[0] for f in findings} == \
+        {"CASSMANTLE_PROBE", "CASSMANTLE_TILE", "CASSMANTLE_RAW"}
+
+
+def test_env_write_alone_does_not_satisfy_the_registry():
+    """A flag that is only ever ASSIGNED (exported for children) is not
+    a read — its registry row stays reportable as stale."""
+    findings = lint("""
+        import os
+
+        os.environ["CASSMANTLE_EXPORTED"] = "1"
+    """, EnvFlagPass(registry={"CASSMANTLE_EXPORTED": 9}))
+    assert rules(findings) == ["env-flag"]
+    assert "never read" in findings[0].message
+
+
+def test_stale_registry_row_reported_by_finalize():
+    findings = lint("""
+        import os
+
+        A = os.environ.get("CASSMANTLE_DOCUMENTED", "")
+    """, EnvFlagPass(registry={"CASSMANTLE_DOCUMENTED": 1,
+                               "CASSMANTLE_GHOST": 7}))
+    assert rules(findings) == ["env-flag"]
+    assert "CASSMANTLE_GHOST" in findings[0].message
+    assert findings[0].path == "docs/DEPLOY.md"
+    assert findings[0].lineno == 7
+
+
+# -- the repo itself lints clean ---------------------------------------------
+
+def test_repo_is_jax_clean():
+    from tools.check_jax import check
+
+    assert check() == []
+
+
+def test_check_jax_cli_exits_zero():
+    from tools.check_jax import main
+
+    assert main([]) == 0
+
+
+def test_lint_all_includes_jax_passes(tmp_path):
+    """The aggregate gate stays green on the package and goes red on a
+    tree seeding a recompile hazard + a buffer escape — proving
+    lint_all actually runs the jax passes in its one walk."""
+    from tools.lint_all import main
+
+    assert main([]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+
+        def run(f, xs):
+            return [jax.jit(f)(x) for x in xs]
+
+        class S:
+            def __init__(self):
+                self._steps = np.zeros((4,), dtype=np.int32)
+
+            def tick(self):
+                idx = jnp.asarray(self._steps)
+                self._steps[0] += 1
+                return idx
+    """))
+    assert main([str(bad.parent)]) == 1
+
+
+def test_new_rules_documented():
+    import pathlib
+
+    doc = pathlib.Path(__file__).resolve().parents[1] / "docs" / \
+        "STATIC_ANALYSIS.md"
+    text = doc.read_text()
+    for rule in ("recompile-hazard", "tracer-leak", "buffer-escape",
+                 "env-flag"):
+        assert rule in text, f"rule {rule} missing from catalog"
+    assert "jit_sentinel" in text
+    assert "CASSMANTLE_JIT_SENTINEL" in text
+
+
+# -- jit compile-count sentinel (runtime counterpart) ------------------------
+# (the autouse conftest fixture arms the sentinel + resets counts)
+
+def test_seeded_post_warmup_recompile_raises():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(x):
+        return x * 2 + 1
+
+    fn(jnp.ones((3,)))                       # warmup compile
+    assert jit_sentinel.compiles("fn") == 1
+    with pytest.raises(JitRecompileError) as exc:
+        with jit_sentinel.no_new_compiles():
+            fn(jnp.ones((7,)))               # new shape: recompiles
+    assert "fn" in str(exc.value)
+
+
+def test_warmed_cache_hits_pass_the_assertion():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(x):
+        return x - 1
+
+    fn(jnp.ones((4,)))
+    with jit_sentinel.no_new_compiles():
+        for _ in range(3):
+            fn(jnp.ones((4,)))               # cache hits only
+    assert jit_sentinel.compiles("fn") == 1
+
+
+def test_only_and_allow_filters():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def watched(x):
+        return x + 2
+
+    @jax.jit
+    def unwatched(x):
+        return x + 3
+
+    watched(jnp.ones((2,)))
+    # an unrelated function may compile inside a window scoped to
+    # 'watched' names only
+    with jit_sentinel.no_new_compiles(only=("watched",)):
+        unwatched(jnp.ones((2,)))
+    # ...and allow= exempts an expected cold bucket by name
+    with jit_sentinel.no_new_compiles(allow=("unwatched",
+                                             "convert_element_type",
+                                             "broadcast_in_dim")):
+        unwatched(jnp.ones((6,)))
+
+
+def test_recompile_counts_metrics_and_flight_recorder():
+    import jax
+    import jax.numpy as jnp
+
+    from cassmantle_tpu.obs.recorder import flight_recorder
+    from cassmantle_tpu.utils.logging import metrics
+
+    @jax.jit
+    def fn(x):
+        return x * 5
+
+    before = metrics.snapshot()["counters"].get("jit.recompiles", 0)
+    fn(jnp.ones((2,)))
+    fn(jnp.ones((9,)))                       # recompile
+    after = metrics.snapshot()["counters"]["jit.recompiles"]
+    assert after >= before + 1
+    kinds = [e["kind"] for e in flight_recorder.tail(50)]
+    assert "jit.recompile" in kinds
+
+
+def test_disabled_sentinel_is_vacuous():
+    import jax
+    import jax.numpy as jnp
+
+    jit_sentinel.disable_sentinel()
+    try:
+        assert not jit_sentinel.sentinel_active()
+
+        @jax.jit
+        def fn(x):
+            return x / 2
+
+        with jit_sentinel.no_new_compiles():
+            fn(jnp.ones((3,)))               # compile, unobserved
+        assert jit_sentinel.compiles() == 0  # nothing counted either
+    finally:
+        jit_sentinel.enable_sentinel()       # autouse fixture disarms
+
+
+def test_env_arming_is_wired_through_compile_cache(monkeypatch):
+    """CASSMANTLE_JIT_SENTINEL=1 arms log-only counting on any
+    pipeline/scorer boot (they all call enable_compile_cache)."""
+    from cassmantle_tpu.utils.compile_cache import enable_compile_cache
+
+    jit_sentinel.disable_sentinel()
+    try:
+        monkeypatch.setenv("CASSMANTLE_JIT_SENTINEL", "0")
+        jit_sentinel.maybe_enable_from_env()
+        assert not jit_sentinel.sentinel_active()
+        monkeypatch.setenv("CASSMANTLE_JIT_SENTINEL", "1")
+        enable_compile_cache()
+        assert jit_sentinel.sentinel_active()
+    finally:
+        jit_sentinel.enable_sentinel()       # leave armed for fixture

@@ -232,6 +232,24 @@ def test_scorer_empty(scorer):
     assert scorer.similarity([]).shape == (0,)
 
 
+def test_scorer_encode_steady_state_zero_recompiles(scorer):
+    """The jit compile-count sentinel pinned on the scorer encode
+    path: after one warmup dispatch per batch bucket, fresh guess
+    traffic in the same buckets (cache cleared, so rows really reach
+    the device) compiles nothing — the /compute_score hot path cannot
+    silently regress into per-request recompiles."""
+    from cassmantle_tpu.utils import jit_sentinel
+
+    scorer._embed_cache.clear()
+    scorer.embed(["warm", "the", "four"])            # bucket 4
+    scorer.embed(["a", "b", "c", "d", "e", "f"])     # bucket 16
+    scorer._embed_cache.clear()
+    with jit_sentinel.no_new_compiles():
+        scorer.embed(["fresh", "guess", "words"])
+        scorer._embed_cache.clear()
+        scorer.embed(["one", "two", "three", "four", "five", "six"])
+
+
 def _cache_counters():
     from cassmantle_tpu.utils.logging import metrics
 

@@ -390,6 +390,49 @@ def test_promptgen_spec_reuses_compiled_buckets(ngram_gen):
     assert speculative_decode._cache_size() == misses
 
 
+def test_promptgen_steady_state_zero_recompiles(plain_gen, ngram_gen):
+    """The jit compile-count sentinel (utils/jit_sentinel.py), pinned
+    on the real prompt-decode serving path: after one warmup dispatch
+    per (prompt bucket, batch bucket) pair, further decode traffic in
+    the SAME buckets — different texts, different seeds, both the
+    greedy and the speculative path — compiles NOTHING. A bucket key
+    quietly becoming per-call (the recompile-hazard class) fails here
+    instead of shipping as a silent latency cliff."""
+    from cassmantle_tpu.utils import jit_sentinel
+
+    # warmup: one dispatch per (prompt 32, batch 4) and (32, 1) bucket
+    plain_gen.decode_ids_batch(["a storm", "a tide", "a dune"],
+                               max_new_tokens=4)
+    plain_gen.decode_ids_batch(["a solo warm dispatch"],
+                               max_new_tokens=4)
+    ngram_gen.decode_ids_batch(["a storm", "a tide", "a dune"],
+                               max_new_tokens=4)
+    with jit_sentinel.no_new_compiles():
+        plain_gen.decode_ids_batch(["new words", "другой", "third?"],
+                                   max_new_tokens=4)
+        plain_gen.decode_ids_batch(["and a fourth dispatch"],
+                                   max_new_tokens=4)
+        ngram_gen.decode_ids_batch(["fresh texts here", "again",
+                                    "and again"], max_new_tokens=4)
+
+
+def test_promptgen_seeded_recompile_fails_steady_state(ngram_gen):
+    """The sentinel actually ARMS the steady-state contract: traffic
+    that enters a cold batch bucket inside the assertion window (a
+    seeded recompile regression) raises JitRecompileError naming the
+    compiled function."""
+    from cassmantle_tpu.utils import jit_sentinel
+
+    ngram_gen.decode_ids_batch(["warm", "the", "bucket"],
+                               max_new_tokens=4)
+    with pytest.raises(jit_sentinel.JitRecompileError):
+        with jit_sentinel.no_new_compiles():
+            # 5 rows -> batch bucket 8: a bucket this module never
+            # warmed, so the spec graph must compile mid-window
+            ngram_gen.decode_ids_batch(
+                ["a", "b", "c", "d", "e"], max_new_tokens=4)
+
+
 def test_promptgen_spec_falls_back_when_bucket_lacks_scratch_room(
         ngram_gen, plain_gen):
     """A prompt whose bucket + budget + scratch tail exceeds the

@@ -260,6 +260,15 @@ def test_mid_flight_admission_and_compile_once(sd_pipe, monkeypatch):
     # admissions it implies grow the cache by nothing
     sd_pipe.generate(prompt_a, seed=23)
     assert srv._step._cache_size() == cache_after
+    # the jit compile-count sentinel pins the same steady-state claim
+    # across the WHOLE stage graph (encode/init/admit/step/take/
+    # decode), not just the step cache: admissions in warmed width
+    # buckets compile nothing anywhere
+    from cassmantle_tpu.utils import jit_sentinel
+
+    with jit_sentinel.no_new_compiles():
+        sd_pipe.generate(prompt_b, seed=24)
+    assert srv._step._cache_size() == cache_after
 
 
 # -- deadlines at step granularity -------------------------------------------

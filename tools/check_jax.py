@@ -1,0 +1,79 @@
+"""JAX dispatch-discipline lint gate: recompile hazards, tracer leaks,
+host-buffer escapes, env-flag registry.
+
+Runs the four ``cassmantle_tpu/analysis`` JAX passes over the package
+(rule catalog: ``docs/STATIC_ANALYSIS.md``):
+
+- ``recompile-hazard`` — jit sites that defeat the compile cache:
+  jit built inside loops, unhashable/per-call static arguments,
+  mutable-attribute capture at trace time, unbucketed shapes fed to a
+  jit from a loop;
+- ``tracer-leak`` — traced values escaping a jit region (stores to
+  ``self.*``/globals/outer containers) and host ``if``/``while`` on
+  traced values (TracerBoolConversion, caught statically);
+- ``buffer-escape`` — the PR 6 aliasing class: a mutable numpy host
+  mirror mutated in place AND passed uncopied into async dispatch /
+  device placement;
+- ``env-flag`` — every ``CASSMANTLE_*`` read has a docs/DEPLOY.md §6
+  lever-table row, and vice versa.
+
+The static half pairs with the runtime compile-count sentinel
+(``utils/jit_sentinel.py``), exactly how ``check_concurrency`` pairs
+with ``utils/locks.OrderedLock``.
+
+Run standalone: ``python tools/check_jax.py [cassmantle_tpu/]
+[--json]`` (exit 1 on violations). Gated as a fast-tier test in
+``tests/test_check_jax.py``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from cassmantle_tpu.analysis.core import (  # noqa: E402
+    PACKAGE,
+    iter_modules,
+    main_for,
+    run_passes,
+)
+
+
+def jax_passes(root: pathlib.Path = PACKAGE):
+    """The pass set this tool (and lint_all) runs, fresh instances —
+    EnvFlagPass accumulates seen flags across a walk, so instances must
+    not be shared between walks. The registry's stale-row direction
+    ("documented but never read") is only meaningful when the walk
+    covers the whole package, so scoped runs skip it."""
+    from cassmantle_tpu.analysis.bufferescape import BufferEscapePass
+    from cassmantle_tpu.analysis.envflags import EnvFlagPass
+    from cassmantle_tpu.analysis.recompile import RecompilePass
+    from cassmantle_tpu.analysis.tracerleak import TracerLeakPass
+
+    try:
+        covers_package = PACKAGE.resolve().is_relative_to(
+            pathlib.Path(root).resolve())
+    except AttributeError:  # pragma: no cover - py<3.9
+        covers_package = True
+    return [RecompilePass(), TracerLeakPass(), BufferEscapePass(),
+            EnvFlagPass(check_orphans=covers_package)]
+
+
+def check(root: pathlib.Path = PACKAGE) -> List[str]:
+    """All violations as human-readable strings; empty = clean."""
+    return [str(f) for f in
+            run_passes(iter_modules(root), jax_passes(root))]
+
+
+def main(argv=None) -> int:
+    return main_for(jax_passes, argv, default_root=PACKAGE,
+                    prog="check_jax")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,10 +1,14 @@
-"""All static passes, one exit code: metrics + concurrency.
+"""All static passes, one exit code: metrics + concurrency + jax +
+env flags.
 
 The single CI/pre-commit gate: runs the metric-name pass
-(``tools/check_metrics.py``) and the three concurrency passes
-(``tools/check_concurrency.py``) over the package in one module walk,
-and exits 1 if any pass finds anything. Gated as a fast-tier test via
-``tests/test_check_concurrency.py``.
+(``tools/check_metrics.py``), the three concurrency passes
+(``tools/check_concurrency.py``), and the four JAX dispatch-discipline
+passes (``tools/check_jax.py`` — recompile hazards, tracer leaks,
+buffer escapes, env-flag registry) over the package in one module
+walk, and exits 1 if any pass finds anything. Gated as a fast-tier
+test via ``tests/test_check_concurrency.py`` and
+``tests/test_check_jax.py``.
 
 Run standalone: ``python tools/lint_all.py [cassmantle_tpu/] [--json]``.
 """
@@ -21,14 +25,15 @@ if str(REPO) not in sys.path:
 from cassmantle_tpu.analysis.core import PACKAGE, main_for  # noqa: E402
 from cassmantle_tpu.analysis.lockorder import default_passes  # noqa: E402
 from cassmantle_tpu.analysis.metric_names import MetricNamePass  # noqa: E402
+from tools.check_jax import jax_passes  # noqa: E402
 
 
-def all_passes():
-    return [MetricNamePass(), *default_passes()]
+def all_passes(root=PACKAGE):
+    return [MetricNamePass(), *default_passes(), *jax_passes(root)]
 
 
 def main(argv=None) -> int:
-    return main_for(all_passes(), argv, default_root=PACKAGE,
+    return main_for(all_passes, argv, default_root=PACKAGE,
                     prog="lint_all")
 
 
