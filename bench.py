@@ -745,6 +745,256 @@ def bench_soak(weights_dir: str) -> dict:
     }
 
 
+def _rooms_worker_main(port: int, store_addr: str, num_rooms: int,
+                       worker_id: str, advertise: str,
+                       round_seconds: float) -> None:
+    """Child process for the rooms_load harness: one fabric worker
+    (fake content backend — the harness measures the GAME fabric, not
+    the diffusion path) over the shared native (or replicated) store."""
+    import dataclasses
+
+    from aiohttp import web
+
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.server.app import build_fabric, create_app
+
+    cfg = FrameworkConfig()
+    cfg = cfg.replace(
+        # rate limits effectively off: the harness IS the flood
+        game=dataclasses.replace(
+            cfg.game, time_per_prompt=round_seconds, lock_timeout=10.0,
+            acquire_timeout=0.5, rate_limit_default=1e6,
+            rate_limit_api=1e6),
+        fabric=dataclasses.replace(
+            cfg.fabric, num_rooms=num_rooms, heartbeat_s=0.5,
+            membership_ttl_s=2.5),
+    )
+    fabric = build_fabric(cfg, fake=True, store_addr=store_addr,
+                          worker_id=worker_id, advertise_addr=advertise)
+    web.run_app(create_app(fabric, cfg), host="127.0.0.1", port=port,
+                print=None)
+
+
+async def _rooms_load_drive(base_urls, sessions: int, seconds: float,
+                            ws_conns: int) -> dict:
+    """The synthetic load: N sessions in a sustained guess loop + M WS
+    /clock subscriptions, spread across every worker (cross-worker 307s
+    followed transparently); returns raw counters + latencies."""
+    import asyncio
+
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=15.0)
+    latencies: list = []
+    errors = [0]
+    ws_ticks = [0]
+    guesses = [0]
+    async with aiohttp.ClientSession(timeout=timeout) as http:
+        # the cluster map: room placement + advertised worker addresses
+        # straight from the fabric block of /readyz
+        async with http.get(base_urls[0] + "/readyz") as res:
+            fabric_block = (await res.json()).get("fabric", {})
+        placement = fabric_block.get("rooms", {})
+        workers = fabric_block.get("workers", {})
+
+        def owner_url(room: str) -> str:
+            info = workers.get(placement.get(room) or "", {})
+            return (info.get("addr") or base_urls[0]).rstrip("/")
+
+        deadline = time.monotonic() + seconds
+
+        async def player(i: int) -> None:
+            sid = f"load-{i}"
+            base = base_urls[i % len(base_urls)]
+            q = f"?session={sid}"
+            try:
+                async with http.get(base + "/init" + q) as res:
+                    await res.json()
+                async with http.get(base + "/fetch/contents" + q) as res:
+                    prompt = (await res.json())["prompt"]
+                masks = prompt["masks"] or [0]
+            except Exception:
+                errors[0] += 1
+                return
+            g = 0
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    async with http.post(
+                        base + "/compute_score" + q,
+                        json={"inputs": {str(masks[0]): f"guess{g}"}},
+                    ) as res:
+                        if res.status == 200:
+                            await res.json()
+                            latencies.append(time.perf_counter() - t0)
+                            guesses[0] += 1
+                        else:
+                            errors[0] += 1
+                except Exception:
+                    errors[0] += 1
+                    await asyncio.sleep(0.05)
+                g += 1
+
+        async def clock_watcher(i: int) -> None:
+            rooms = sorted(placement) or [""]
+            room = rooms[i % len(rooms)]
+            url = owner_url(room) + f"/clock?session=ws-{i}&room={room}"
+            try:
+                async with http.ws_connect(url) as ws:
+                    while time.monotonic() < deadline:
+                        msg = await asyncio.wait_for(
+                            ws.receive(), timeout=max(2.0, seconds))
+                        if msg.type != aiohttp.WSMsgType.TEXT:
+                            break
+                        ws_ticks[0] += 1
+            except Exception:
+                errors[0] += 1
+
+        tasks = [asyncio.ensure_future(player(i)) for i in range(sessions)]
+        tasks += [asyncio.ensure_future(clock_watcher(i))
+                  for i in range(ws_conns)]
+        t0 = time.perf_counter()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = time.perf_counter() - t0
+    return {
+        "elapsed": elapsed,
+        "latencies": latencies,
+        "guesses": guesses[0],
+        "ws_ticks": ws_ticks[0],
+        "errors": errors[0],
+    }
+
+
+def rooms_load_spawn_workers(workers: int, rooms: int, base_port: int,
+                             store_addr: str,
+                             round_seconds: float = 8.0) -> tuple:
+    """(procs, base_urls): N fabric worker processes over one shared
+    store address, each advertised for cross-worker redirects, all
+    confirmed /healthz-ready."""
+    import multiprocessing
+    import urllib.request
+
+    procs = []
+    base_urls = []
+    # spawn, not fork: the driver (pytest, bench suite) has jax loaded
+    # and multithreaded — forking that risks a child deadlock. Spawned
+    # workers import only the fake-backend server path (no jax at all),
+    # so the clean interpreter costs ~a second and buys determinism.
+    ctx = multiprocessing.get_context("spawn")
+    for w in range(workers):
+        port = base_port + w
+        url = f"http://127.0.0.1:{port}"
+        base_urls.append(url)
+        p = ctx.Process(
+            target=_rooms_worker_main,
+            args=(port, store_addr, rooms, f"bench-w{w}", url,
+                  round_seconds),
+            daemon=True)
+        p.start()
+        procs.append(p)
+    for url in base_urls:
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=2.0) as res:
+                    if res.status == 200:
+                        break
+            except Exception:
+                pass
+            if time.monotonic() >= deadline:
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(f"worker {url} never became healthy")
+            time.sleep(0.1)
+    return procs, base_urls
+
+
+def rooms_load_run(workers: int = 2, rooms: int = 4, sessions: int = 8,
+                   seconds: float = 6.0, ws_conns: int = 4,
+                   base_port: int = 8461, store_port: int = 7461,
+                   round_seconds: float = 8.0,
+                   store_addr: str = None) -> dict:
+    """Spawn one shared mantlestore + N fabric worker processes, drive
+    sustained guess + WS clock load across M rooms, return raw stats.
+    ``store_addr`` overrides the store (e.g. ``repl:...`` against an
+    externally spawned replicated cluster — the failover drill in
+    tests/test_fabric_cluster.py). Shared by ``bench.py rooms_load``
+    and the CPU smoke tests (tests/test_fabric.py)."""
+    import asyncio
+
+    from cassmantle_tpu.native.client import ensure_built, spawn_server
+
+    if ensure_built() is None:
+        raise RuntimeError("mantlestore toolchain unavailable")
+    store_proc = None
+    if store_addr is None:
+        store_proc = spawn_server(store_port)
+        store_addr = f"native:{store_port}"
+    procs = []
+    try:
+        procs, base_urls = rooms_load_spawn_workers(
+            workers, rooms, base_port, store_addr, round_seconds)
+        raw = asyncio.run(
+            _rooms_load_drive(base_urls, sessions, seconds, ws_conns))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=5.0)
+        if store_proc is not None:
+            store_proc.kill()
+            store_proc.wait()
+    raw.update(workers=workers, rooms=rooms, sessions=sessions,
+               ws_conns=ws_conns)
+    return raw
+
+
+def bench_rooms_load(weights_dir: str) -> dict:
+    """ROADMAP item 2's deliverable: the game-fabric load rung made
+    measurable. N worker processes × M rooms over one shared store,
+    sustained guesses/sec + WS clock fan-out, request p50/p99 against a
+    p99 SLO. Knobs: BENCH_ROOMS_WORKERS / BENCH_ROOMS_COUNT /
+    BENCH_ROOMS_SESSIONS / BENCH_ROOMS_SECONDS / BENCH_ROOMS_WS /
+    BENCH_ROOMS_P99_SLO_MS (env)."""
+    import numpy as np
+
+    env = os.environ.get
+    raw = rooms_load_run(
+        workers=int(env("BENCH_ROOMS_WORKERS", "2")),
+        rooms=int(env("BENCH_ROOMS_COUNT", "4")),
+        sessions=int(env("BENCH_ROOMS_SESSIONS", "8")),
+        seconds=float(env("BENCH_ROOMS_SECONDS", "6")),
+        ws_conns=int(env("BENCH_ROOMS_WS", "4")),
+        base_port=int(env("BENCH_ROOMS_BASE_PORT", "8461")),
+        store_port=int(env("BENCH_ROOMS_STORE_PORT", "7461")),
+    )
+    if not raw["latencies"]:
+        raise RuntimeError(
+            f"rooms_load produced no successful guesses "
+            f"({raw['errors']} errors)")
+    ms = np.sort(np.asarray(raw["latencies"])) * 1000.0
+    slo_ms = float(env("BENCH_ROOMS_P99_SLO_MS", "2000"))
+    p99 = float(ms[int(len(ms) * 0.99)])
+    return {
+        "metric": "rooms_load_guesses_per_sec_sustained",
+        "value": round(raw["guesses"] / raw["elapsed"], 1),
+        "unit": "guesses/sec",
+        "vs_baseline": None,
+        "workers": raw["workers"],
+        "rooms": raw["rooms"],
+        "sessions": raw["sessions"],
+        "duration_s": round(raw["elapsed"], 2),
+        "ws_conns": raw["ws_conns"],
+        "ws_ticks": raw["ws_ticks"],
+        "request_errors": raw["errors"],
+        "request_p50_ms": round(float(ms[len(ms) // 2]), 1),
+        "request_p99_ms": round(p99, 1),
+        "p99_slo_ms": slo_ms,
+        "slo_ok": bool(p99 <= slo_ms),
+    }
+
+
 # Ordered by evidence-per-minute-of-tunnel-uptime: the north-star config
 # and its fastest challenger run FIRST, so a tunnel that dies mid-suite
 # (rounds 1-4 all hit this) still lands the two numbers the perf case
@@ -767,6 +1017,7 @@ SUITE = {
     "gpt2_b4": bench_gpt2_b4,
     "e2e": bench_e2e_round,
     "soak": bench_soak,
+    "rooms_load": bench_rooms_load,
 }
 
 # ``--north-star-only`` measures exactly these, with BENCH_ROUNDS=1

@@ -44,7 +44,7 @@ _ASYNC_WRAPPERS = {
 # async handler/pipeline/engine layers — the dirs whose async defs feed
 # the serving event loop (ops/models are sync-only by construction)
 REPO_DIRS = ("cassmantle_tpu/server/", "cassmantle_tpu/serving/",
-             "cassmantle_tpu/engine/")
+             "cassmantle_tpu/engine/", "cassmantle_tpu/fabric/")
 
 
 def _blocking_reason(node: ast.Call) -> Optional[str]:

@@ -389,6 +389,47 @@ class GameConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FabricConfig:
+    """Room fabric: sharded multi-room game over the shared store
+    (cassmantle_tpu/fabric/). One worker with one room (the defaults)
+    is exactly the pre-fabric game — the default room lives at the
+    legacy un-prefixed store keys, so old stores resume and old
+    frontends keep working."""
+
+    # Concurrent rooms, each with its own round clock, content, and
+    # score state. Room ids are ``default_room`` plus room-1..room-N-1;
+    # sessions consistent-hash onto them (fabric/directory.py).
+    num_rooms: int = 1
+    # The room legacy un-roomed requests map to (empty key prefix).
+    default_room: str = "lobby"
+    # Stable worker identity for room placement; "" derives host:pid
+    # (CASSMANTLE_ROOM_WORKER_ID overrides at runtime).
+    worker_id: str = ""
+    # Address peers should redirect to for rooms this worker owns,
+    # e.g. "http://10.0.0.3:8000" (CASSMANTLE_ROOM_ADVERTISE overrides);
+    # "" means this worker cannot be redirected to (single-worker).
+    advertise_addr: str = ""
+    # Membership heartbeat cadence and staleness cutoff: a worker whose
+    # last heartbeat is older than ``membership_ttl_s`` leaves the ring
+    # and its rooms re-place onto the survivors.
+    heartbeat_s: float = 2.0
+    membership_ttl_s: float = 6.0
+    # Virtual nodes per worker on the consistent-hash ring (higher =
+    # smoother room distribution, slower ring rebuild).
+    vnodes: int = 64
+    # Replicated-store endpoints ("host:port", ...): when non-empty the
+    # worker talks to the mantlestore cluster through ReplicatedStore
+    # (leader writes, log-shipping pump, lease failover) instead of a
+    # single node. CASSMANTLE_REPL_ENDPOINTS overrides.
+    repl_endpoints: Tuple[str, ...] = ()
+    # Pump poll cadence (replication lag floor) and leader lease TTL
+    # (failover detection time); CASSMANTLE_REPL_POLL_MS /
+    # CASSMANTLE_REPL_LEASE_MS override.
+    repl_poll_s: float = 0.05
+    repl_lease_s: float = 3.0
+
+
+@dataclasses.dataclass(frozen=True)
 class QualityGateConfig:
     """CLIP-parity thresholds a fast preset must clear before its
     throughput counts as a win (BASELINE.md quality gate). Enforced by
@@ -423,6 +464,7 @@ class FrameworkConfig:
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     game: GameConfig = dataclasses.field(default_factory=GameConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    fabric: FabricConfig = dataclasses.field(default_factory=FabricConfig)
     spec_decode: SpecDecodeConfig = dataclasses.field(
         default_factory=SpecDecodeConfig)
     quality: QualityGateConfig = dataclasses.field(

@@ -1,0 +1,31 @@
+"""Room fabric: the sharded multi-room game layer (ROADMAP item 2).
+
+Scales the *game* the way serving/stages.py scales the *models*: many
+concurrent rooms — each with its own round clock, prompt/image content,
+and score state — consistent-hash-placed across workers over one
+replicated store, surviving worker death.
+
+- :mod:`fabric.directory` — session→room→worker placement (stable
+  hashing + a consistent-hash worker ring with minimal movement).
+- :mod:`fabric.membership` — store-backed worker heartbeats: the live
+  worker set the ring is built from, per-worker room counts for
+  `/readyz`.
+- :mod:`fabric.rooms` — :class:`RoomFabric`: per-room ``Game`` engines
+  over namespaced store views; room lifecycle (create / rotate /
+  drain) and ownership-change draining.
+
+Store replication itself lives one layer down
+(``engine/store.ReplicatedStore`` over ``native/mantlestore.cc``'s
+REPL verbs); the fabric consumes it like any other ``StateStore``.
+"""
+
+from cassmantle_tpu.fabric.directory import RoomDirectory
+from cassmantle_tpu.fabric.membership import ClusterMembership
+from cassmantle_tpu.fabric.rooms import NamespacedStore, RoomFabric
+
+__all__ = [
+    "ClusterMembership",
+    "NamespacedStore",
+    "RoomDirectory",
+    "RoomFabric",
+]

@@ -1,0 +1,90 @@
+"""Cluster membership: store-backed worker heartbeats.
+
+Each worker periodically writes one field of the ``fabric:workers``
+hash: ``{addr, rooms, t}`` with a wall-clock stamp. Liveness is
+stamp-based (a field older than the membership TTL is a dead worker)
+rather than per-field TTL because the store contract has no per-field
+expiry — and a dead worker's stale field costs a few bytes until its
+next overwrite, not correctness.
+
+The cached live-worker view feeds two consumers: the
+:class:`~cassmantle_tpu.fabric.directory.RoomDirectory` ring rebuild
+(room placement follows membership) and the `/readyz` ``fabric`` block
+(per-worker room counts, addresses — the operator's cluster map).
+
+Concurrency contract: the ``fabric.membership`` OrderedLock (rank 6)
+guards only the cached snapshot; store I/O happens outside it
+(refresh reads the hash first, then swaps the parsed view in under the
+lock) so a slow store round trip can never be held under a thread lock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, Optional
+
+from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.utils.locks import OrderedLock
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("fabric.membership")
+
+WORKERS_KEY = "fabric:workers"
+
+
+class ClusterMembership:
+    def __init__(self, store: StateStore, worker_id: str, *,
+                 addr: str = "", ttl_s: float = 6.0,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.store = store
+        self.worker_id = worker_id
+        self.addr = addr
+        self.ttl_s = ttl_s
+        # wall clock: stamps are compared ACROSS processes, so monotonic
+        # (per-process epoch) would read every peer as dead
+        self._clock = clock or time.time
+        self._lock = OrderedLock("fabric.membership", rank=6)
+        self._live: Dict[str, dict] = {}
+
+    async def heartbeat(self, room_count: int = 0) -> Dict[str, dict]:
+        """Announce this worker and refresh the live view."""
+        payload = json.dumps({
+            "addr": self.addr,
+            "rooms": int(room_count),
+            "t": self._clock(),
+        })
+        await self.store.hset(WORKERS_KEY, self.worker_id, payload)
+        return await self.refresh()
+
+    async def refresh(self) -> Dict[str, dict]:
+        """Re-read the membership table; returns live workers only."""
+        raw = await self.store.hgetall(WORKERS_KEY)
+        now = self._clock()
+        live: Dict[str, dict] = {}
+        for field, value in raw.items():
+            worker = field if isinstance(field, str) else field.decode()
+            try:
+                info = json.loads(value.decode())
+            except Exception:
+                continue  # torn/foreign field: not a live worker
+            if now - float(info.get("t", 0.0)) <= self.ttl_s:
+                live[worker] = info
+        with self._lock:
+            self._live = live
+        metrics.gauge("fabric.workers_live", float(len(live)))
+        return live
+
+    async def leave(self) -> None:
+        """Graceful departure: peers re-place our rooms on their next
+        refresh instead of waiting a full staleness TTL."""
+        await self.store.hdel(WORKERS_KEY, self.worker_id)
+
+    # -- sync snapshot (status reporting) ----------------------------------
+    def live_workers(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._live)
+
+    def addr_of(self, worker: str) -> Optional[str]:
+        info = self.live_workers().get(worker)
+        return (info or {}).get("addr") or None

@@ -1,0 +1,358 @@
+"""Room fabric: per-room game engines over namespaced store views.
+
+A **room** is a full game — its own round clock, prompt/image content,
+sessions, and score state — living under a per-room key prefix in the
+shared (replicated) store. :class:`RoomFabric` owns the set of rooms a
+worker serves: it lazily builds one :class:`~cassmantle_tpu.engine.game.Game`
+per owned room (all rooms share the worker's serving backend, so many
+rooms' round generation funnels into the same batched device through
+the round reserve and the staged serving path), heartbeats membership,
+and drains/adopts rooms when the consistent-hash ring moves.
+
+The **default room** maps to the *empty* prefix: legacy un-roomed
+requests, pre-fabric stores, and the unchanged frontend all keep
+working — a one-worker one-room fabric is byte-for-byte the old game.
+
+Concurrency contract: the fabric's own mutable state (the room→game
+map, startup tasks) is touched only from the serving event loop and
+holds no thread locks by design; the thread-locked pieces are the
+directory ring (rank 4), the replication status snapshot (rank 5), and
+the membership cache (rank 6) — see docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+from typing import AsyncIterator, Callable, Dict, List, Optional
+
+from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.engine.store import StateStore
+from cassmantle_tpu.fabric.directory import RoomDirectory
+from cassmantle_tpu.fabric.membership import ClusterMembership
+from cassmantle_tpu.obs.recorder import flight_recorder
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("fabric.rooms")
+
+
+class NamespacedStore(StateStore):
+    """A per-room view of a shared store: every key (and lock name)
+    carries the room prefix, so N rooms coexist in one store without
+    the engine knowing. ``close`` is a no-op — the underlying store is
+    shared and the fabric closes it exactly once at shutdown."""
+
+    def __init__(self, store: StateStore, prefix: str) -> None:
+        self._store = store
+        self.prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return self.prefix + key
+
+    async def set(self, key, value):
+        return await self._store.set(self._k(key), value)
+
+    async def get(self, key):
+        return await self._store.get(self._k(key))
+
+    async def setex(self, key, ttl, value):
+        return await self._store.setex(self._k(key), ttl, value)
+
+    async def delete(self, *keys):
+        return await self._store.delete(*[self._k(k) for k in keys])
+
+    async def exists(self, key):
+        return await self._store.exists(self._k(key))
+
+    async def expire(self, key, ttl):
+        return await self._store.expire(self._k(key), ttl)
+
+    async def ttl(self, key):
+        return await self._store.ttl(self._k(key))
+
+    async def hset(self, key, field=None, value=None, mapping=None):
+        return await self._store.hset(self._k(key), field=field,
+                                      value=value, mapping=mapping)
+
+    async def hget(self, key, field):
+        return await self._store.hget(self._k(key), field)
+
+    async def hgetall(self, key):
+        return await self._store.hgetall(self._k(key))
+
+    async def hdel(self, key, *fields):
+        return await self._store.hdel(self._k(key), *fields)
+
+    async def hincrby(self, key, field, amount: int = 1):
+        return await self._store.hincrby(self._k(key), field, amount)
+
+    async def sadd(self, key, *members):
+        return await self._store.sadd(self._k(key), *members)
+
+    async def srem(self, key, *members):
+        return await self._store.srem(self._k(key), *members)
+
+    async def smembers(self, key):
+        return await self._store.smembers(self._k(key))
+
+    async def sismember(self, key, member):
+        return await self._store.sismember(self._k(key), member)
+
+    def lock(self, name: str, timeout: float = 120.0,
+             blocking_timeout: float = 2.0):
+        # room-scoped locks: each room's startup/buffer/promotion
+        # lifecycle excludes per room, not globally
+        return self._store.lock(self._k(name), timeout=timeout,
+                                blocking_timeout=blocking_timeout)
+
+    async def close(self) -> None:
+        pass
+
+
+def room_prefix(room: str, default_room: str) -> str:
+    """Store key prefix for a room ('' = the legacy un-roomed keys)."""
+    return "" if room == default_room else f"room:{room}:"
+
+
+def room_ids(cfg: FrameworkConfig) -> List[str]:
+    fabric = cfg.fabric
+    return [fabric.default_room] + [
+        f"room-{i}" for i in range(1, max(1, fabric.num_rooms))
+    ]
+
+
+class RoomFabric:
+    """The per-worker fabric runtime: room→game map, membership
+    heartbeats, ownership-change draining."""
+
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        store: StateStore,
+        game_factory: Callable[[str, StateStore], Game],
+        *,
+        worker_id: str = "worker-0",
+        advertise_addr: str = "",
+        start_timers: bool = True,
+        heartbeat: bool = True,
+        supervisor=None,
+    ) -> None:
+        self.cfg = cfg
+        self.store = store
+        self.game_factory = game_factory
+        self.worker_id = worker_id
+        self.start_timers = start_timers
+        # ONE supervisor per worker, shared by every room's game (and
+        # by the inference service behind them): /readyz fuses a single
+        # worker-level verdict, not a per-room one
+        if supervisor is None:
+            from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+            supervisor = ServingSupervisor()
+        self.supervisor = supervisor
+        self.supervisor.fabric_status = self.status
+        self.default_room = cfg.fabric.default_room
+        self.directory = RoomDirectory(
+            room_ids(cfg), workers=[worker_id], vnodes=cfg.fabric.vnodes)
+        self.membership = ClusterMembership(
+            store, worker_id, addr=advertise_addr,
+            ttl_s=cfg.fabric.membership_ttl_s)
+        self._heartbeat_enabled = heartbeat
+        self._games: Dict[str, Game] = {}
+        self._startups: Dict[str, asyncio.Task] = {}
+        self._hb_task: Optional[asyncio.Task] = None
+
+    # -- legacy wrap -------------------------------------------------------
+    @classmethod
+    def for_game(cls, game: Game, cfg: FrameworkConfig,
+                 start_timers: bool = True) -> "RoomFabric":
+        """Wrap one pre-built Game as a single-room fabric — the shim
+        that keeps ``create_app(game, cfg)`` and every existing caller
+        working unchanged (the game IS the default room). The wrap is
+        pinned to ONE room regardless of ``cfg.fabric.num_rooms``:
+        multi-room serving must come through a per-room game factory
+        (build_fabric) — routing a second room id onto the one shared
+        Game would re-run its startup and stack a second round clock."""
+        import dataclasses
+
+        cfg = cfg.replace(fabric=dataclasses.replace(
+            cfg.fabric, num_rooms=1))
+        fabric = cls(cfg, game.store, lambda room, store: game,
+                     start_timers=start_timers, heartbeat=False,
+                     supervisor=game.supervisor)
+        fabric._games[fabric.default_room] = game
+        return fabric
+
+    # -- ownership ---------------------------------------------------------
+    def is_local(self, room: str) -> bool:
+        owner = self.directory.worker_for_room(room)
+        return owner is None or owner == self.worker_id
+
+    def owner_addr(self, room: str) -> Optional[str]:
+        """Advertised address of the room's owner (None when unknown or
+        local — callers redirect only on a real remote address)."""
+        owner = self.directory.worker_for_room(room)
+        if owner is None or owner == self.worker_id:
+            return None
+        return self.membership.addr_of(owner)
+
+    def owned_rooms(self) -> List[str]:
+        return self.directory.rooms_owned_by(self.worker_id)
+
+    # -- room lifecycle ----------------------------------------------------
+    async def game_for(self, room: str) -> Game:
+        """The room's engine, created + started on first use. Unknown
+        rooms raise KeyError (the HTTP layer answers 404)."""
+        if not self.directory.has_room(room):
+            raise KeyError(room)
+        game = self._games.get(room)
+        if game is None:
+            game = self._build_game(room)
+        startup = self._startups.get(room)
+        if startup is not None:
+            # single-flight startup: concurrent first requests share one
+            # content generation; shield keeps a canceled waiter (client
+            # disconnect) from killing the shared startup
+            await asyncio.shield(startup)
+        return game
+
+    def _build_game(self, room: str) -> Game:
+        view = NamespacedStore(
+            self.store, room_prefix(room, self.default_room))
+        game = self.game_factory(room, view)
+        # per-room deterministic seed stream: two rooms on one worker
+        # must hold DIFFERENT prompts (acceptance, tests/test_fabric.py),
+        # which starts with them picking different story seeds
+        game.rounds.rng = random.Random(f"{room}:{self.cfg.seed}")
+        self._games[room] = game
+        metrics.inc("fabric.rooms_created")
+        flight_recorder.record("fabric.room_created", room=room)
+
+        async def _start() -> None:
+            try:
+                await game.startup()
+                if self.start_timers:
+                    game.start_timer()
+            except BaseException:
+                # failed startup must not cache a half-built room: drop
+                # it so the next request retries from the store
+                self._games.pop(room, None)
+                raise
+            finally:
+                self._startups.pop(room, None)
+
+        self._startups[room] = asyncio.get_running_loop().create_task(
+            _start())
+        return game
+
+    async def rotate_room(self, room: str) -> None:
+        """Force the room onto fresh content now (promote + reset +
+        clock restart) — the operator lever behind room lifecycle."""
+        game = await self.game_for(room)
+        await game.rounds.rollover()
+        metrics.inc("fabric.room_rotations")
+        flight_recorder.record("fabric.room_rotated", room=room)
+
+    async def drain_room(self, room: str) -> None:
+        """Stop serving a room locally (ownership moved / shutdown):
+        its clock and buffer tasks stop, its state stays in the store
+        for the adopting worker to resume."""
+        game = self._games.pop(room, None)
+        startup = self._startups.pop(room, None)
+        if startup is not None:
+            startup.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await startup
+        if game is not None:
+            await game.rounds.stop()
+            metrics.inc("fabric.rooms_drained")
+            flight_recorder.record("fabric.room_drained", room=room)
+
+    # -- lifecycle ---------------------------------------------------------
+    async def startup(self) -> None:
+        """Announce membership, adopt owned rooms (the default room
+        eagerly — legacy clients expect content at boot), start the
+        heartbeat loop."""
+        starter = getattr(self.store, "start", None)
+        if callable(starter):
+            # ReplicatedStore: find/elect the leader and start the
+            # log-shipping pump on this worker's event loop
+            await starter()
+        if self._heartbeat_enabled:
+            live = await self.membership.heartbeat(len(self._games))
+            self._apply_membership(live)
+        # preinstalled games (the for_game legacy wrap) start the way
+        # create_app always started its one game
+        for room, game in list(self._games.items()):
+            if room not in self._startups:
+                await game.startup()
+                if self.start_timers:
+                    game.start_timer()
+        if self.is_local(self.default_room) \
+                and self.default_room not in self._games:
+            await self.game_for(self.default_room)
+        if self._heartbeat_enabled:
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeat_loop())
+
+    async def shutdown(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._hb_task
+            self._hb_task = None
+        if self._heartbeat_enabled:
+            with contextlib.suppress(Exception):
+                await self.membership.leave()
+        for room in list(self._games):
+            await self.drain_room(room)
+        await self.store.close()
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.cfg.fabric.heartbeat_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                live = await self.membership.heartbeat(len(self._games))
+                await self._handle_moves(self._apply_membership(live))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # membership is best-effort per tick: a store hiccup
+                # must not kill the loop (the next beat retries)
+                log.exception("membership heartbeat failed; continuing")
+                metrics.inc("fabric.heartbeat_failures")
+
+    def _apply_membership(self, live: Dict[str, dict]) -> Dict[str, tuple]:
+        workers = set(live) | {self.worker_id}
+        moves = self.directory.set_workers(sorted(workers))
+        for room, (old, new) in moves.items():
+            metrics.inc("fabric.room_moves")
+            flight_recorder.record("fabric.room_move", room=room,
+                                   src=old, dst=new)
+        metrics.gauge("fabric.rooms_owned", float(len(self.owned_rooms())))
+        return moves
+
+    async def _handle_moves(self, moves: Dict[str, tuple]) -> None:
+        for room, (old, new) in moves.items():
+            if old == self.worker_id and new != self.worker_id \
+                    and room in self._games:
+                await self.drain_room(room)
+
+    # -- status ------------------------------------------------------------
+    def status(self) -> Dict[str, object]:
+        """The `/readyz` fabric block: identity, placement, membership,
+        replication. Sync by contract — reads only cached snapshots."""
+        status: Dict[str, object] = {
+            "worker": self.worker_id,
+            "rooms": self.directory.placement(),
+            "owned": self.owned_rooms(),
+            "active": sorted(self._games),
+            "workers": self.membership.live_workers(),
+        }
+        repl_status = getattr(self.store, "status", None)
+        if callable(repl_status):
+            status["replication"] = repl_status()
+        return status

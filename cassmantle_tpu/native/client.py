@@ -14,16 +14,16 @@ import asyncio
 import contextlib
 import os
 import subprocess
-import time
-import uuid
-from typing import AsyncIterator, Dict, Optional, Set
+from typing import Dict, Optional, Set
 
 from cassmantle_tpu.engine.store import (
     LockTimeout,
     StateStore,
     Value,
-    _report_lock_hazard,
+    polled_store_lock,
 )
+
+__all__ = ["LockTimeout", "MantleStore", "ensure_built", "spawn_server"]
 from cassmantle_tpu.utils.logging import get_logger
 
 log = get_logger("native.store")
@@ -94,15 +94,34 @@ def ensure_built() -> Optional[str]:
 
 def spawn_server(port: int = 7070,
                  snapshot_path: Optional[str] = None,
-                 snapshot_interval_s: float = 30.0) -> subprocess.Popen:
+                 snapshot_interval_s: float = 30.0,
+                 repl: bool = False,
+                 follower: bool = False,
+                 repl_id: Optional[str] = None,
+                 lease_ms: Optional[int] = None) -> subprocess.Popen:
     """Spawn mantlestore. With ``snapshot_path`` the server restores that
     snapshot at boot and persists to it periodically and on SIGTERM —
-    the Redis-durability resume semantics of the reference (SURVEY §5.4)."""
+    the Redis-durability resume semantics of the reference (SURVEY §5.4).
+
+    ``repl=True`` enables the replication log + leader lease heartbeat
+    (the node boots as leader); ``follower=True`` boots it readonly,
+    waiting for a pump to ship it the leader's log (engine/store.py
+    ReplicatedStore). ``repl_id`` names the node in the lease;
+    ``lease_ms`` sizes the leader lease TTL (failover detection time)."""
     binary = ensure_built()
     assert binary, "mantlestore binary unavailable"
     cmd = [binary, str(port)]
     if snapshot_path:
         cmd += [snapshot_path, str(snapshot_interval_s)]
+    if repl or follower:
+        cmd.append("--follower" if follower else "--repl")
+        # ids must be UNIQUE per node: the PROMOTE lease fence skips the
+        # liveness refusal for the lease holder's own id, so two nodes
+        # sharing the binary's default id could promote past a live
+        # leader (split brain). Default to a per-port id.
+        cmd += ["--id", repl_id or f"node-{port}"]
+        if lease_ms is not None:
+            cmd += ["--lease-ms", str(int(lease_ms))]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
     )
@@ -152,6 +171,11 @@ class MantleStore(StateStore):
             self._writer.write(payload)
             await self._writer.drain()
             return await self._read_reply()
+
+    async def raw_command(self, *args: bytes):
+        """One command round trip — the public form of ``_cmd`` for
+        composition (the shared lock protocol, ReplicatedStore)."""
+        return await self._cmd(*args)
 
     async def _read_reply(self):
         line = await self._reader.readline()
@@ -262,35 +286,62 @@ class MantleStore(StateStore):
                                     member.encode()))
 
     # -- locks ------------------------------------------------------------
-    @contextlib.asynccontextmanager
-    async def lock(self, name: str, timeout: float = 120.0,
-                   blocking_timeout: float = 2.0) -> AsyncIterator[None]:
-        token = uuid.uuid4().hex.encode()
-        deadline = time.monotonic() + blocking_timeout
-        ttl_ms = str(int(timeout * 1000)).encode()
-        acquired = False
-        while True:
-            reply = await self._cmd(b"LOCK", name.encode(), token, ttl_ms)
-            if reply == b"OK":
-                acquired = True
-                break
-            if time.monotonic() >= deadline:
-                break
-            await asyncio.sleep(0.05)
-        if not acquired:
-            raise LockTimeout(name)
-        try:
-            yield
-        finally:
-            with contextlib.suppress(Exception):
-                released = await self._cmd(b"UNLOCK", name.encode(), token)
-                # same hazard taxonomy as MemoryStore: :2 = our token
-                # outlived its TTL unclaimed (overrun); :0 = gone
-                # entirely, possibly reacquired by another worker
-                if released == 2:
-                    _report_lock_hazard("overrun", name)
-                elif released == 0:
-                    _report_lock_hazard("expired_in_hold", name)
+    def lock(self, name: str, timeout: float = 120.0,
+             blocking_timeout: float = 2.0):
+        # the shared polled protocol (engine/store.py): one definition
+        # of the acquire loop and the :2/:0 hazard taxonomy for both
+        # the single-node and replicated transports
+        return polled_store_lock(self._cmd, name, timeout,
+                                 blocking_timeout)
 
     async def flushall(self) -> None:
         await self._cmd(b"FLUSHALL")
+
+    # -- replication (REPL verbs; see native/mantlestore.cc header) --------
+    async def repl_role(self) -> str:
+        return (await self._cmd(b"REPL", b"ROLE")).decode()
+
+    async def repl_offset(self) -> tuple:
+        """(log_start, log_end, applied). On a healthy node
+        applied == log_end; lag of a follower = leader log_end - this."""
+        start, end, applied = await self._cmd(b"REPL", b"OFFSET")
+        return start, end, applied
+
+    async def repl_tail(self, offset: int, max_commands: int = 256):
+        """(next_offset, raw command stream) from ``offset``; None when
+        the log was trimmed past it (caller must full-resync via
+        repl_dump/repl_reset)."""
+        reply = await self._cmd(b"REPL", b"TAIL", str(offset).encode(),
+                                str(max_commands).encode())
+        if len(reply) == 1:
+            return None
+        return reply[0], reply[1]
+
+    async def repl_apply(self, expected_offset: int, stream: bytes) -> int:
+        """Replay ``stream`` iff this follower's offset == expected;
+        returns the follower's applied offset either way (exactly-once
+        under racing pumps)."""
+        return await self._cmd(b"REPL", b"APPLY",
+                               str(expected_offset).encode(), stream)
+
+    async def repl_dump(self) -> tuple:
+        """(log_end, full-state command stream incl. live locks)."""
+        end, stream = await self._cmd(b"REPL", b"DUMP")
+        return end, stream
+
+    async def repl_reset(self, offset: int, stream: bytes) -> int:
+        """Full resync: flush, replay ``stream`` unlogged, set offsets."""
+        return await self._cmd(b"REPL", b"RESET", str(offset).encode(),
+                               stream)
+
+    async def repl_promote(self) -> bool:
+        """Ask a follower to take leadership; True when it did (False =
+        the replicated leader lease is still live — the leader was
+        heartbeating within its TTL)."""
+        return await self._cmd(b"REPL", b"PROMOTE") == b"OK"
+
+    async def repl_lease(self) -> tuple:
+        """(holder id or '', seconds remaining) of the leader lease as
+        this node sees it."""
+        holder, ms = await self._cmd(b"REPL", b"LEASE")
+        return holder.decode(), ms / 1000.0

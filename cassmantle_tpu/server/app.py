@@ -41,6 +41,7 @@ from aiohttp import WSMsgType, web
 
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.fabric.rooms import RoomFabric
 from cassmantle_tpu.obs import configure_observability, flight_recorder, tracer
 from cassmantle_tpu.obs.trace import current_marks
 from cassmantle_tpu.utils.logging import get_logger, metrics
@@ -53,7 +54,7 @@ STATIC_DIR = os.path.join(_ROOT, "static")
 DATA_DIR = os.path.join(_ROOT, "data")
 MEDIA_DIR = os.path.join(_ROOT, "media")
 
-_GAME = web.AppKey("game", Game)
+_FABRIC = web.AppKey("fabric", RoomFabric)
 _TRACE_STATE = web.AppKey("trace_state", dict)
 
 
@@ -63,7 +64,66 @@ def _client_ip(request: web.Request) -> str:
 
 
 def _session_id(request: web.Request) -> Optional[str]:
-    return request.cookies.get("session_id")
+    # the ?session= fallback keeps identity across a cross-worker 307:
+    # cookies are host-scoped, a query param rides the Location header
+    return request.cookies.get("session_id") or \
+        request.query.get("session")
+
+
+def _explicit_room(request: web.Request) -> Optional[str]:
+    return request.query.get("room") or request.headers.get("X-Room") \
+        or request.cookies.get("room")
+
+
+def _room_of(request: web.Request) -> str:
+    """The room this request belongs to: an explicit ?room= / X-Room /
+    cookie wins; otherwise the session (or client IP) consistent-hashes
+    onto the room list — the same room on every request, from any
+    worker, with no stored mapping (fabric/directory.py)."""
+    explicit = _explicit_room(request)
+    if explicit:
+        return explicit
+    fabric = request.app[_FABRIC]
+    principal = _session_id(request) or _client_ip(request)
+    return fabric.directory.room_for_session(principal)
+
+
+def _check_room_ownership(request: web.Request, fabric: RoomFabric,
+                          room: str) -> None:
+    """The ONE ownership gate for every room-scoped route: a room owned
+    by another worker answers 307 to the owner's advertised address;
+    with no advertised owner address the room serves locally — the
+    per-room store locks keep that merely suboptimal, never unsafe.
+
+    The Location pins the resolved room AND the session as query
+    params: cookies are host-scoped and do not survive the hop, so a
+    cookie-only client would otherwise re-resolve a DIFFERENT room on
+    the target worker (redirect ping-pong between owners)."""
+    if fabric.is_local(room):
+        return
+    addr = fabric.owner_addr(room)
+    if not addr:
+        metrics.inc("fabric.foreign_serves")
+        return
+    metrics.inc("fabric.redirects")
+    url = request.rel_url.update_query(room=room)
+    session = _session_id(request)
+    if session:
+        url = url.update_query(session=session)
+    raise web.HTTPTemporaryRedirect(location=addr.rstrip("/") + str(url))
+
+
+async def _resolve_game(request: web.Request):
+    """(room, game) for this request, after the ownership gate."""
+    fabric = request.app[_FABRIC]
+    room = _room_of(request)
+    if not fabric.directory.has_room(room):
+        raise web.HTTPNotFound(text=f"unknown room {room!r}")
+    _check_room_ownership(request, fabric, room)
+    try:
+        return room, await fabric.game_for(room)
+    except KeyError:
+        raise web.HTTPNotFound(text=f"unknown room {room!r}")
 
 
 def _is_loopback(request: web.Request) -> bool:
@@ -144,7 +204,21 @@ def make_ratelimit_middleware(cfg: FrameworkConfig):
             rate = cfg.game.rate_limit_api
         else:
             rate = cfg.game.rate_limit_default
-        if not limiter.allow(_client_ip(request), request.path, rate):
+        # (client IP, room): a noisy room drains only its own quota,
+        # not the same client's allowance in another room. The IP stays
+        # the identity half — session ids are client-minted and would
+        # let one abuser grow a fresh full-burst bucket per request —
+        # and the room half only honors rooms that EXIST, so ?room=
+        # can mint at most num_rooms buckets per client.
+        fabric = request.app[_FABRIC]
+        explicit = _explicit_room(request)
+        if explicit and fabric.directory.has_room(explicit):
+            room = explicit
+        else:
+            who = _session_id(request) or _client_ip(request)
+            room = fabric.directory.room_for_session(who)
+        principal = (_client_ip(request), room)
+        if not limiter.allow(principal, request.path, rate):
             metrics.inc("http.rate_limited")
             raise web.HTTPTooManyRequests(
                 text="rate limit exceeded",
@@ -159,24 +233,38 @@ async def handle_root(request: web.Request) -> web.StreamResponse:
 
 
 async def handle_init(request: web.Request) -> web.Response:
-    game = request.app[_GAME]
-    session_id = str(uuid.uuid4())
+    # a fresh session has no cookie yet: the room still resolves
+    # deterministically from the NEW session id, so the cookie pair
+    # (session_id, room) this response sets stays self-consistent
+    session_id = _session_id(request) or str(uuid.uuid4())
+    fabric = request.app[_FABRIC]
+    room = _explicit_room(request) or \
+        fabric.directory.room_for_session(session_id)
+    if not fabric.directory.has_room(room):
+        raise web.HTTPNotFound(text=f"unknown room {room!r}")
+    # same ownership discipline as every other room-scoped route: init
+    # on a non-owner must redirect, not quietly start a duplicate room
+    # engine (and a second round clock) on this worker
+    _check_room_ownership(request, fabric, room)
+    game = await fabric.game_for(room)
     await game.init_client(session_id)
     response = web.json_response(
-        {"message": "Session initialized", "session_id": session_id}
+        {"message": "Session initialized", "session_id": session_id,
+         "room": room}
     )
     response.set_cookie("session_id", session_id)
+    response.set_cookie("room", room)
     metrics.inc("http.init")
     return response
 
 
 async def handle_status(request: web.Request) -> web.Response:
-    game = request.app[_GAME]
+    _, game = await _resolve_game(request)
     return web.json_response(await game.client_status(_session_id(request)))
 
 
 async def handle_fetch_contents(request: web.Request) -> web.Response:
-    game = request.app[_GAME]
+    _, game = await _resolve_game(request)
     session = _session_id(request) or str(uuid.uuid4())
     await game.ensure_client(session)
     with metrics.timer("http.fetch_contents_s"):
@@ -194,7 +282,7 @@ async def handle_fetch_contents(request: web.Request) -> web.Response:
 
 
 async def handle_compute_score(request: web.Request) -> web.Response:
-    game = request.app[_GAME]
+    _, game = await _resolve_game(request)
     supervisor = game.supervisor
     if supervisor.shed_scores():
         # the scorer is provably dark (breaker open): shed with an
@@ -227,7 +315,11 @@ async def handle_compute_score(request: web.Request) -> web.Response:
 
 
 async def handle_clock(request: web.Request) -> web.WebSocketResponse:
-    game = request.app[_GAME]
+    # room-scoped BEFORE the handshake: a redirect (room owned
+    # elsewhere) must go out as a plain 307 while headers can still be
+    # sent — each room's WS feed carries that room's clock and player
+    # count only
+    _, game = await _resolve_game(request)
     session = _session_id(request)
     ws = web.WebSocketResponse(heartbeat=30.0)
     await ws.prepare(request)
@@ -310,9 +402,9 @@ async def handle_debugz(request: web.Request) -> web.Response:
     })
 
 
-async def _probe_store(game: Game) -> bool:
+async def _probe_store(fabric: RoomFabric) -> bool:
     try:
-        await asyncio.wait_for(game.store.exists("healthz"), timeout=2.0)
+        await asyncio.wait_for(fabric.store.exists("healthz"), timeout=2.0)
         return True
     except Exception:
         return False
@@ -325,16 +417,17 @@ async def handle_healthz(request: web.Request) -> web.Response:
     Carries the supervisor block for operators, but only store/device
     drive the status code — a degraded-but-serving worker must not be
     restarted by a liveness probe (that's `/readyz`'s job to report)."""
-    game = request.app[_GAME]
+    fabric = request.app[_FABRIC]
+    supervisor = fabric.supervisor
     store_ok, device_ok = await asyncio.gather(
-        _probe_store(game), game.supervisor.probe_device())
+        _probe_store(fabric), supervisor.probe_device())
     ok = store_ok and device_ok is not False
     return web.json_response(
         {
             "ok": ok,
             "store": store_ok,
             "device": device_ok is not False,
-            "supervisor": game.supervisor.status(
+            "supervisor": supervisor.status(
                 device_ok=device_ok, include_events=_is_loopback(request)),
         },
         status=200 if ok else 503,
@@ -344,16 +437,19 @@ async def handle_healthz(request: web.Request) -> web.Response:
 async def handle_readyz(request: web.Request) -> web.Response:
     """READINESS: can this worker produce fresh content and real scores
     right now? Fuses breaker states, the dispatch watchdog, and the
-    device probe (ServingSupervisor.status). Degraded -> 503 +
-    Retry-After so load balancers drain the worker while the game keeps
-    serving reserve rounds to players already on it."""
-    game = request.app[_GAME]
+    device probe (ServingSupervisor.status) — plus, on a fabric worker,
+    the cluster block (worker identity, room placement + per-worker
+    room counts, live membership, replication leader + lag). Degraded
+    -> 503 + Retry-After so load balancers drain the worker while the
+    game keeps serving reserve rounds to players already on it."""
+    fabric = request.app[_FABRIC]
+    supervisor = fabric.supervisor
     store_ok, device_ok = await asyncio.gather(
-        _probe_store(game), game.supervisor.probe_device())
+        _probe_store(fabric), supervisor.probe_device())
     # the embedded event tail is internal serving state: loopback
     # operators only (the /debugz boundary) — remote probes/players get
     # the verdict without the history
-    status = game.supervisor.status(
+    status = supervisor.status(
         device_ok=device_ok, include_events=_is_loopback(request))
     status["store"] = store_ok
     ready = bool(status["ready"]) and store_ok
@@ -361,7 +457,7 @@ async def handle_readyz(request: web.Request) -> web.Response:
     if ready:
         return web.json_response(status)
     status["state"] = "degraded"
-    retry_after = str(int(game.supervisor.retry_after_s()))
+    retry_after = str(int(supervisor.retry_after_s()))
     return web.json_response(
         status, status=503, headers={"Retry-After": retry_after})
 
@@ -466,18 +562,27 @@ async def handle_wordlist(request: web.Request) -> web.Response:
     )
 
 
-def create_app(game: Game, cfg: FrameworkConfig,
+def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
                start_timer: bool = True,
                device_health: bool = False) -> web.Application:
+    """Build the aiohttp app over a Game (legacy single-room callers)
+    or a RoomFabric (sharded multi-room serving). A bare Game wraps
+    into a one-room fabric whose default room is that game — identical
+    behavior to the pre-fabric server."""
     # apply the observability knobs before any route can record
     # (tracer/recorder/metrics are process globals; idempotent)
     configure_observability(cfg.obs)
+    if isinstance(game, RoomFabric):
+        fabric = game
+        fabric.start_timers = start_timer
+    else:
+        fabric = RoomFabric.for_game(game, cfg, start_timers=start_timer)
     # ratelimit OUTSIDE tracing: a client spamming to 429s must shed at
     # the limiter without minting root traces (ring-flush vector)
     app = web.Application(middlewares=[
         cors_middleware, make_ratelimit_middleware(cfg), tracing_middleware
     ])
-    app[_GAME] = game
+    app[_FABRIC] = fabric
     # mutable holder created before the app starts: flipping a field at
     # request time is legal where reassigning an app key is not (aiohttp
     # deprecates, and 4.x forbids, mutating a started app's keys)
@@ -487,7 +592,7 @@ def create_app(game: Game, cfg: FrameworkConfig,
 
         # the supervisor owns the prober and fuses its verdict into
         # /healthz and /readyz (supervisor.probe_device)
-        game.supervisor.device_health = DeviceHealth()
+        fabric.supervisor.device_health = DeviceHealth()
     app.router.add_get("/", handle_root)
     app.router.add_get("/init", handle_init)
     app.router.add_get("/client/status", handle_status)
@@ -510,34 +615,42 @@ def create_app(game: Game, cfg: FrameworkConfig,
         app.router.add_static("/media", MEDIA_DIR)
 
     async def on_startup(app_: web.Application) -> None:
-        await game.startup()
-        if start_timer:
-            game.start_timer()
+        await fabric.startup()
 
     async def on_cleanup(app_: web.Application) -> None:
-        await game.shutdown()
+        await fabric.shutdown()
 
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
 
 
-def build_game(cfg: FrameworkConfig, fake: bool = False,
-               weights_dir: Optional[str] = None,
-               store_addr: Optional[str] = None) -> Game:
-    """Assemble a Game with real TPU serving or the fake backend.
+def _build_store(store_addr: Optional[str], cfg: FrameworkConfig):
+    """The worker's shared store: MemoryStore (single process),
+    MantleStore (``native[:port]`` — one shared node), or
+    ReplicatedStore (``repl:host:port,host:port`` / the configured
+    ``fabric.repl_endpoints`` / CASSMANTLE_REPL_ENDPOINTS — a
+    leader+followers mantlestore cluster with lease failover)."""
+    from cassmantle_tpu.engine.store import MemoryStore, ReplicatedStore
 
-    ``store_addr`` like ``"native:7070"`` connects to a shared mantlestore
-    (multi-worker deployments, one store per host like the reference's
-    Redis); default is the in-process MemoryStore.
-    """
-    from cassmantle_tpu.engine.store import MemoryStore
-    from cassmantle_tpu.serving.supervisor import ServingSupervisor
-
-    # ONE supervisor per worker: the engine's content breaker and the
-    # inference service's score breaker + queue watchdogs must fuse into
-    # the same /readyz verdict
-    supervisor = ServingSupervisor()
+    endpoints = os.environ.get("CASSMANTLE_REPL_ENDPOINTS", "")
+    endpoints = tuple(e.strip() for e in endpoints.split(",") if e.strip()) \
+        or tuple(cfg.fabric.repl_endpoints)
+    if store_addr and store_addr.startswith("repl:"):
+        endpoints = tuple(
+            e.strip() for e in store_addr[len("repl:"):].split(",")
+            if e.strip())
+        store_addr = None
+    if endpoints:
+        lease_ms = os.environ.get("CASSMANTLE_REPL_LEASE_MS")
+        poll_ms = os.environ.get("CASSMANTLE_REPL_POLL_MS")
+        return ReplicatedStore(
+            list(endpoints),
+            poll_interval_s=(float(poll_ms) / 1000.0 if poll_ms
+                             else cfg.fabric.repl_poll_s),
+            lease_timeout_s=(float(lease_ms) / 1000.0 if lease_ms
+                             else cfg.fabric.repl_lease_s),
+        )
     if store_addr:
         import re
 
@@ -548,12 +661,19 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
             # multi-worker fleet
             raise ValueError(
                 f"unknown store address {store_addr!r} (expected "
-                f"'native[:port]')")
+                f"'native[:port]' or 'repl:host:port,host:port')")
         from cassmantle_tpu.native.client import MantleStore
 
-        store = MantleStore(port=int(m.group(1) or 7070))
-    else:
-        store = MemoryStore()
+        return MantleStore(port=int(m.group(1) or 7070))
+    return MemoryStore()
+
+
+def _serving_components(cfg: FrameworkConfig, fake: bool,
+                        weights_dir: Optional[str], supervisor):
+    """(backend, embed, similarity, blur_fn) — built ONCE per worker and
+    shared by every room's game, so N rooms' round generation funnels
+    into the same batched device path (the fabric scales the game, not
+    the model count)."""
     if fake:
         from cassmantle_tpu.engine.content import (
             FakeContentBackend,
@@ -561,19 +681,86 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
             hash_similarity,
         )
 
-        return Game(cfg, store, FakeContentBackend(image_size=256),
-                    hash_embed, hash_similarity, supervisor=supervisor)
+        return FakeContentBackend(image_size=256), hash_embed, \
+            hash_similarity, None
     from cassmantle_tpu.serving.service import InferenceService
 
     service = InferenceService(cfg, weights_dir=weights_dir,
                                supervisor=supervisor)
-    return Game(
-        cfg, store, service.content_backend,
-        embed=service.embed,
-        similarity=service.similarity,
-        blur_fn=service.blur,
-        supervisor=supervisor,
-    )
+    return service.content_backend, service.embed, service.similarity, \
+        service.blur
+
+
+def build_game(cfg: FrameworkConfig, fake: bool = False,
+               weights_dir: Optional[str] = None,
+               store_addr: Optional[str] = None) -> Game:
+    """Assemble a single Game with real TPU serving or the fake backend.
+
+    ``store_addr`` like ``"native:7070"`` connects to a shared mantlestore
+    (multi-worker deployments, one store per host like the reference's
+    Redis); default is the in-process MemoryStore. Multi-room serving
+    goes through :func:`build_fabric` instead.
+    """
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    # ONE supervisor per worker: the engine's content breaker and the
+    # inference service's score breaker + queue watchdogs must fuse into
+    # the same /readyz verdict
+    supervisor = ServingSupervisor()
+    store = _build_store(store_addr, cfg)
+    backend, embed, similarity, blur_fn = _serving_components(
+        cfg, fake, weights_dir, supervisor)
+    return Game(cfg, store, backend, embed=embed, similarity=similarity,
+                blur_fn=blur_fn, supervisor=supervisor)
+
+
+def apply_fabric_env(cfg: FrameworkConfig) -> FrameworkConfig:
+    """Fold runtime fabric env overrides into the config — applied by
+    build_fabric AND by the server entry before create_app, so every
+    consumer of cfg.fabric (room lists, middleware) sees ONE value."""
+    import dataclasses
+
+    rooms_env = os.environ.get("CASSMANTLE_ROOM_COUNT")
+    if rooms_env:
+        cfg = cfg.replace(fabric=dataclasses.replace(
+            cfg.fabric, num_rooms=int(rooms_env)))
+    return cfg
+
+
+def build_fabric(cfg: FrameworkConfig, fake: bool = False,
+                 weights_dir: Optional[str] = None,
+                 store_addr: Optional[str] = None,
+                 worker_id: Optional[str] = None,
+                 advertise_addr: Optional[str] = None) -> RoomFabric:
+    """Assemble the room fabric for one worker: a shared (possibly
+    replicated) store, one serving stack, and per-room Games created on
+    demand (fabric/rooms.py). Env overrides (docs/DEPLOY.md §6):
+    CASSMANTLE_ROOM_COUNT, CASSMANTLE_ROOM_WORKER_ID,
+    CASSMANTLE_ROOM_ADVERTISE, CASSMANTLE_REPL_ENDPOINTS,
+    CASSMANTLE_REPL_LEASE_MS, CASSMANTLE_REPL_POLL_MS."""
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    cfg = apply_fabric_env(cfg)
+    worker_id = (worker_id
+                 or os.environ.get("CASSMANTLE_ROOM_WORKER_ID")
+                 or cfg.fabric.worker_id
+                 or f"{os.uname().nodename}:{os.getpid()}")
+    advertise_addr = (advertise_addr
+                      or os.environ.get("CASSMANTLE_ROOM_ADVERTISE")
+                      or cfg.fabric.advertise_addr)
+    supervisor = ServingSupervisor()
+    store = _build_store(store_addr, cfg)
+    backend, embed, similarity, blur_fn = _serving_components(
+        cfg, fake, weights_dir, supervisor)
+
+    def game_factory(room: str, room_store) -> Game:
+        return Game(cfg, room_store, backend, embed=embed,
+                    similarity=similarity, blur_fn=blur_fn,
+                    supervisor=supervisor)
+
+    return RoomFabric(cfg, store, game_factory, worker_id=worker_id,
+                      advertise_addr=advertise_addr,
+                      supervisor=supervisor)
 
 
 def main() -> None:
@@ -592,7 +779,23 @@ def main() -> None:
                              "(spawn with native/build/mantlestore "
                              "[port] [snapshot_path [interval_s]]; a "
                              "snapshot path makes rounds survive store "
-                             "restarts)")
+                             "restarts); 'repl:host:port,host:port' = "
+                             "replicated mantlestore cluster (leader "
+                             "writes + log-shipping + lease failover — "
+                             "docs/DEPLOY.md multi-worker runbook)")
+    parser.add_argument("--rooms", type=int, default=None,
+                        help="concurrent game rooms (each with its own "
+                             "round clock/content/scores, sessions "
+                             "consistent-hashed across them; default 1 "
+                             "= the classic single global round)")
+    parser.add_argument("--worker-id", default=None,
+                        help="stable worker identity for room placement "
+                             "(default host:pid)")
+    parser.add_argument("--advertise", default=None,
+                        help="address peers redirect room traffic to, "
+                             "e.g. http://10.0.0.3:8000 (unset = no "
+                             "cross-worker redirects; foreign rooms "
+                             "serve locally)")
     parser.add_argument("--preset", default="sd15",
                         choices=("sd15", "sdxl", "fast", "deepcache",
                                  "turbo"),
@@ -671,11 +874,11 @@ def main() -> None:
         import signal
         import threading
 
-        if not (args.store and args.store.startswith("native")):
+        if not (args.store and args.store.startswith(("native", "repl:"))):
             parser.error("--workers > 1 requires --store native[:port] "
-                         "(a shared native store is the coordination "
-                         "plane; per-process MemoryStores would each "
-                         "run their own game)")
+                         "or repl:... (a shared native store is the "
+                         "coordination plane; per-process MemoryStores "
+                         "would each run their own game)")
         if not (args.fake or args.platform == "cpu"):
             parser.error("--workers > 1 needs --fake or --platform cpu: "
                          "one accelerator chip has one owning process — "
@@ -723,9 +926,19 @@ def main() -> None:
 
 
 def _run_worker(args, cfg: FrameworkConfig) -> None:
-    game = build_game(cfg, fake=args.fake, weights_dir=args.weights,
-                      store_addr=args.store)
-    web.run_app(create_app(game, cfg, device_health=not args.fake),
+    import dataclasses
+
+    if getattr(args, "rooms", None):
+        cfg = cfg.replace(fabric=dataclasses.replace(
+            cfg.fabric, num_rooms=args.rooms))
+    # one cfg for everything: the env override must reach create_app's
+    # consumers too, not just the fabric build
+    cfg = apply_fabric_env(cfg)
+    fabric = build_fabric(cfg, fake=args.fake, weights_dir=args.weights,
+                          store_addr=args.store,
+                          worker_id=getattr(args, "worker_id", None),
+                          advertise_addr=getattr(args, "advertise", None))
+    web.run_app(create_app(fabric, cfg, device_health=not args.fake),
                 host=args.host, port=args.port,
                 reuse_port=(args.workers > 1))
 

@@ -1,14 +1,28 @@
-"""Per-IP token-bucket rate limiting.
+"""Per-principal token-bucket rate limiting.
 
 The reference rate-limits with slowapi (3/s default, 2/s API routes;
 main.py:19, 43-48, 82, 96, 114). Same policy here, implemented as a small
 token bucket so there is no external dependency.
+
+Buckets are keyed by ``(principal, route_class)`` where the principal is
+``(client-ip, room)``: with the room fabric, one client can play in
+several rooms, and a noisy room (a hot round's guess storm) must drain
+only its own quota — client-only buckets would let room A's burst
+starve the same client's requests in room B (ISSUE 8 satellite;
+eviction behavior at this key shape is pinned in tests/test_server.py).
+The identity half stays the IP — session ids are client-minted and
+would let an abuser grow a fresh full-burst bucket per request — and
+the middleware only honors room values that exist, so ``?room=`` can
+mint at most num_rooms buckets per client.
 """
 
 from __future__ import annotations
 
 import time
 from typing import Dict, Tuple
+
+# (client-ip, room) — the unit that owns a quota
+Principal = Tuple[str, str]
 
 
 class TokenBucket:
@@ -31,7 +45,8 @@ class TokenBucket:
 
 
 class RateLimiter:
-    """Buckets keyed by (ip, class); stale buckets evicted on overflow.
+    """Buckets keyed by (principal, class); stale buckets evicted on
+    overflow.
 
     Eviction is targeted, never a flush: clearing the whole table when
     full would reset EVERY active client's bucket to a full burst at
@@ -43,7 +58,7 @@ class RateLimiter:
 
     def __init__(self, max_entries: int = 10000,
                  stale_s: float = 60.0) -> None:
-        self._buckets: Dict[Tuple[str, str], TokenBucket] = {}
+        self._buckets: Dict[Tuple[Principal, str], TokenBucket] = {}
         self.max_entries = max_entries
         self.stale_s = stale_s
 
@@ -59,8 +74,9 @@ class RateLimiter:
             for k in by_idle[:max(1, self.max_entries // 10)]:
                 del self._buckets[k]
 
-    def allow(self, ip: str, route_class: str, rate: float) -> bool:
-        key = (ip, route_class)
+    def allow(self, principal: Principal, route_class: str,
+              rate: float) -> bool:
+        key = (principal, route_class)
         bucket = self._buckets.get(key)
         if bucket is None:
             if len(self._buckets) >= self.max_entries:

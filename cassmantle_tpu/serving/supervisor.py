@@ -51,6 +51,11 @@ class ServingSupervisor:
             "score", clock=clock)
         # set by server/app.py when real-device serving wires DeviceHealth
         self.device_health = device_health
+        # set by server/app.py when a room fabric is serving: a sync
+        # callable returning the cluster block `/readyz` embeds — worker
+        # identity, room placement, live membership, replication
+        # leader + lag (fabric/rooms.py RoomFabric.status)
+        self.fabric_status: Optional[Callable[[], Dict[str, object]]] = None
         self.degraded_cooldown_s = degraded_cooldown_s
         # rank per the docs/STATIC_ANALYSIS.md lock hierarchy: supervisor
         # state is leaf-ward of the dispatch locks, outward of breakers
@@ -177,6 +182,14 @@ class ServingSupervisor:
         stages = self.stage_health()
         if stages:
             status["stages"] = stages
+        if self.fabric_status is not None:
+            try:
+                status["fabric"] = self.fabric_status()
+            except Exception:
+                # the cluster block is advisory: a torn membership
+                # snapshot must never break the readiness verdict
+                log.exception("fabric status failed")
+                status["fabric"] = {"error": "unavailable"}
         if not ready and include_events:
             # a degraded verdict carries the recent event history that
             # explains it — the flight-recorder tail (trip order,

@@ -24,8 +24,42 @@
 //   restarted worker resumes the in-flight round exactly the way the
 //   reference resumes from Redis durability (SURVEY.md §5.4).
 //
+// - replication (--repl / --follower): every mutating command appends to
+//   a bounded in-memory command log with monotonically increasing
+//   offsets. Followers are kept applied by a client-side pump
+//   (engine/store.py ReplicatedStore) through the REPL verbs:
+//     REPL OFFSET               -> [log_start, log_end, applied]
+//     REPL TAIL from max        -> [next_offset, raw command stream]
+//                                  ([-1] when `from` fell off the
+//                                  trimmed log: full resync required)
+//     REPL APPLY expected strm  -> new applied offset; the stream
+//                                  replays through normal dispatch ONLY
+//                                  when the local offset == expected, so
+//                                  racing pumps apply exactly once
+//                                  (HINCRBY and friends are not
+//                                  idempotent)
+//     REPL DUMP                 -> [log_end, full-state stream incl.
+//                                  live locks] for resync
+//     REPL RESET offset strm    -> flush + replay (unlogged) + set
+//                                  offsets; the resync landing
+//     REPL PROMOTE              -> +OK (follower becomes leader once the
+//                                  replicated leader lease expired in
+//                                  its local lock table) | +BUSY
+//     REPL ROLE / REPL LEASE    -> observability
+//   Replay is deterministic over the existing command set — a follower
+//   is exactly the leader's command history re-executed, so lock
+//   tombstone/overrun semantics carry over unchanged. The leader
+//   heartbeats its lease through the ordinary LOCK discipline (a
+//   logged `LOCK __repl:leader__ <id> <lease_ms>` refresh): followers
+//   see liveness as a replicated lock entry, and a dead leader (or a
+//   dead pump — indistinguishable, both mean the follower is blind)
+//   reads as lease expiry. Followers reject client writes with
+//   -READONLY; a demoted ex-leader that observes another holder on its
+//   own lease steps down rather than split-brain.
+//
 // Build: g++ -O2 -std=c++17 -o mantlestore mantlestore.cc
 // Run:   ./mantlestore [port] [snapshot_path [interval_s]]
+//                      [--repl] [--follower] [--id NAME] [--lease-ms N]
 //        (default port 7070, localhost only; no path = in-memory only)
 
 #include <arpa/inet.h>
@@ -88,7 +122,11 @@ class Store {
   }
 
   Entry& upsert(const std::string& key, Entry::Kind kind) {
-    if (!alive(key)) {
+    // wrong-type writes REPLACE the entry with a fresh one of the new
+    // kind (TTL cleared) — previously the entry kept its old kind, so
+    // e.g. HSET over a string key wrote fields no HGET could see.
+    // Pinned against MemoryStore in tests/test_store_parity.py.
+    if (!alive(key) || data_[key].kind != kind) {
       Entry e;
       e.kind = kind;
       data_[key] = std::move(e);
@@ -121,6 +159,50 @@ class Store {
   std::unordered_map<std::string, Entry> data_;
   std::unordered_map<std::string, LockEntry> locks_;
 };
+
+// ---------------------------------------------------------------------------
+// Replication state
+// ---------------------------------------------------------------------------
+
+static const char* kLeaderLease = "__repl:leader__";
+
+struct Repl {
+  bool enabled = false;
+  bool leader = true;        // standalone servers are implicit leaders
+  std::string id = "node";
+  long long lease_ms = 3000;
+  // Command log: serialized RESP commands, offsets [log_start,
+  // log_start + log.size()). Trimmed from the front past max_log —
+  // a follower that fell off the window does a full REPL DUMP resync.
+  std::deque<std::string> log;
+  long long log_start = 0;
+  size_t max_log = 65536;
+
+  long long log_end() const { return log_start + (long long)log.size(); }
+
+  void append(const std::string& serialized) {
+    log.push_back(serialized);
+    while (log.size() > max_log) {
+      log.pop_front();
+      log_start++;
+    }
+  }
+};
+
+static Repl g_repl;
+
+// Who is asking: a real client (readonly-checked on followers, logged),
+// the replication replay path (not readonly-checked — it IS how
+// follower state advances — but logged so the follower's log mirrors
+// the leader's), or a load path (snapshot boot / RESET: neither).
+enum Origin { ORIGIN_CLIENT, ORIGIN_REPLAY, ORIGIN_LOAD };
+
+static bool is_mutating(const std::string& cmd) {
+  static const std::unordered_set<std::string> kMutating = {
+      "SET", "SETEX", "DEL", "PEXPIRE", "HSET", "HDEL", "HINCRBY",
+      "SADD", "SREM", "LOCK", "UNLOCK", "FLUSHALL"};
+  return kMutating.count(cmd) > 0;
+}
 
 // ---------------------------------------------------------------------------
 // RESP protocol
@@ -191,15 +273,135 @@ static bool parse_command(const std::string& buf, size_t& pos,
 // Command dispatch
 // ---------------------------------------------------------------------------
 
+static void emit_command(std::string& out,
+                         const std::vector<std::string>& argv);
+static void serialize_state(Store& store, std::string& out,
+                            bool include_locks);
 static void execute(Store& store, const std::vector<std::string>& argv,
-                    std::string& out) {
-  if (argv.empty()) {
-    resp_error(out, "ERR empty command");
-    return;
-  }
-  std::string cmd = argv[0];
-  for (auto& c : cmd) c = toupper(c);
+                    std::string& out, Origin origin);
+static void heartbeat_lease(Store& store);
 
+static void repl_command(Store& store, const std::vector<std::string>& argv,
+                         std::string& out) {
+  std::string sub = argv.size() > 1 ? argv[1] : "";
+  for (auto& c : sub) c = toupper(c);
+
+  if (sub == "ROLE" && argv.size() == 2) {
+    // standalone (repl disabled) answers "leader": a single-endpoint
+    // ReplicatedStore degenerates to a plain client
+    resp_simple(out, g_repl.leader ? "leader" : "follower");
+  } else if (sub == "OFFSET" && argv.size() == 2) {
+    resp_array_header(out, 3);
+    resp_int(out, g_repl.log_start);
+    resp_int(out, g_repl.log_end());
+    resp_int(out, g_repl.log_end());  // applied == log_end by construction
+  } else if (sub == "TAIL" && argv.size() == 4) {
+    if (!g_repl.enabled) {
+      resp_error(out, "ERR replication disabled");
+      return;
+    }
+    long long from = strtoll(argv[2].c_str(), nullptr, 10);
+    long long maxn = strtoll(argv[3].c_str(), nullptr, 10);
+    if (from < g_repl.log_start) {
+      resp_array_header(out, 1);
+      resp_int(out, -1);  // trimmed past `from`: resync required
+      return;
+    }
+    long long n = g_repl.log_end() - from;
+    if (maxn >= 0 && n > maxn) n = maxn;
+    if (n < 0) n = 0;
+    std::string stream;
+    for (long long i = 0; i < n; i++)
+      stream += g_repl.log[(size_t)(from - g_repl.log_start + i)];
+    resp_array_header(out, 2);
+    resp_int(out, from + n);
+    resp_bulk(out, stream);
+  } else if (sub == "APPLY" && argv.size() == 4) {
+    if (!g_repl.enabled) {
+      resp_error(out, "ERR replication disabled");
+      return;
+    }
+    if (g_repl.leader) {
+      resp_error(out, "ERR leader does not APPLY");
+      return;
+    }
+    long long expected = strtoll(argv[2].c_str(), nullptr, 10);
+    if (expected != g_repl.log_end()) {
+      // precondition failed (a racing pump already applied this batch,
+      // or the caller is stale): apply nothing, report local truth
+      resp_int(out, g_repl.log_end());
+      return;
+    }
+    size_t pos = 0;
+    std::vector<std::string> cmd_args;
+    std::string discard;
+    while (parse_command(argv[3], pos, cmd_args)) {
+      execute(store, cmd_args, discard, ORIGIN_REPLAY);
+      discard.clear();
+    }
+    resp_int(out, g_repl.log_end());
+  } else if (sub == "DUMP" && argv.size() == 2) {
+    std::string stream;
+    serialize_state(store, stream, /*include_locks=*/true);
+    resp_array_header(out, 2);
+    resp_int(out, g_repl.log_end());
+    resp_bulk(out, stream);
+  } else if (sub == "RESET" && argv.size() == 4) {
+    if (!g_repl.enabled) {
+      resp_error(out, "ERR replication disabled");
+      return;
+    }
+    long long offset = strtoll(argv[2].c_str(), nullptr, 10);
+    store.data_.clear();
+    store.locks_.clear();
+    g_repl.log.clear();
+    g_repl.log_start = offset;
+    size_t pos = 0;
+    std::vector<std::string> cmd_args;
+    std::string discard;
+    while (parse_command(argv[3], pos, cmd_args)) {
+      execute(store, cmd_args, discard, ORIGIN_LOAD);
+      discard.clear();
+    }
+    resp_int(out, offset);
+  } else if (sub == "PROMOTE" && argv.size() == 2) {
+    if (!g_repl.enabled) {
+      resp_error(out, "ERR replication disabled");
+      return;
+    }
+    if (g_repl.leader) {
+      resp_simple(out, "OK");  // idempotent
+      return;
+    }
+    auto it = store.locks_.find(kLeaderLease);
+    if (it != store.locks_.end() && now_s() < it->second.deadline &&
+        it->second.token != g_repl.id) {
+      // the replicated lease is still live: the leader (and the pump
+      // feeding us) was heartbeating within the TTL — refusing here is
+      // what prevents a promotion racing a healthy leader
+      resp_simple(out, "BUSY");
+      return;
+    }
+    g_repl.leader = true;
+    heartbeat_lease(store);  // claim the lease in our own log NOW
+    fprintf(stderr, "mantlestore: promoted to leader (id=%s)\n",
+            g_repl.id.c_str());
+    resp_simple(out, "OK");
+  } else if (sub == "LEASE" && argv.size() == 2) {
+    auto it = store.locks_.find(kLeaderLease);
+    bool live = it != store.locks_.end() && now_s() < it->second.deadline;
+    resp_array_header(out, 2);
+    resp_bulk(out, live ? it->second.token : "");
+    resp_int(out, live
+                 ? (long long)((it->second.deadline - now_s()) * 1000.0)
+                 : 0);
+  } else {
+    resp_error(out, "ERR unknown REPL subcommand");
+  }
+}
+
+static void execute_core(Store& store, const std::vector<std::string>& argv,
+                         std::string& out, const std::string& cmd) {
   if (cmd == "PING") {
     resp_simple(out, "PONG");
   } else if (cmd == "SET" && argv.size() == 3) {
@@ -350,6 +552,80 @@ static void execute(Store& store, const std::vector<std::string>& argv,
   }
 }
 
+static void execute(Store& store, const std::vector<std::string>& argv,
+                    std::string& out, Origin origin = ORIGIN_CLIENT) {
+  if (argv.empty()) {
+    resp_error(out, "ERR empty command");
+    return;
+  }
+  std::string cmd = argv[0];
+  for (auto& c : cmd) c = toupper(c);
+
+  if (cmd == "REPL") {
+    repl_command(store, argv, out);
+    return;
+  }
+  bool mutating = is_mutating(cmd);
+  if (mutating && origin == ORIGIN_CLIENT && g_repl.enabled &&
+      !g_repl.leader) {
+    // redis-style fencing: after a failover, a stale worker still
+    // writing to this (now-follower) node must fail loudly, not fork
+    // the state — its ReplicatedStore treats READONLY as
+    // leadership-changed and re-elects
+    resp_error(out, "READONLY follower");
+    return;
+  }
+  size_t before = out.size();
+  execute_core(store, argv, out, cmd);
+  bool append = mutating && g_repl.enabled && origin != ORIGIN_LOAD;
+  if (append && origin == ORIGIN_CLIENT) {
+    // CLIENT commands append only when they actually mutated: errors,
+    // +BUSY LOCKs, and :0 UNLOCKs changed nothing — replaying a BUSY
+    // LOCK on a follower would ACQUIRE the lock there and fork the
+    // lock tables.
+    if (out.size() > before && out[before] == '-')
+      append = false;
+    else if (cmd == "LOCK")
+      append = out.compare(before, 3, "+OK") == 0;
+    else if (cmd == "UNLOCK")
+      append = out.compare(before, 2, ":0") != 0;
+  }
+  // REPLAY appends UNCONDITIONALLY: the follower's log must mirror the
+  // byte stream it was shipped, not its own re-derived verdicts — a
+  // replayed LOCK can locally answer +BUSY (its TTL was recomputed at
+  // apply time, so a lapsed-then-retaken lock can look still-live on a
+  // lagging follower) and verdict-gating the append would skew the
+  // offset bookkeeping and double-apply the next command (breaking
+  // exactly-once for HINCRBY and friends). The transient lock-table
+  // skew converges as TTLs expire and only ever DELAYS a promote.
+  if (append) {
+    std::string serialized;
+    emit_command(serialized, argv);
+    g_repl.append(serialized);
+  }
+}
+
+// Leader lease heartbeat: an ordinary logged LOCK refresh, so
+// followers observe leader liveness as a replicated lock entry and the
+// lease obeys the exact LOCK/TTL discipline everything else does. A
+// BUSY answer means ANOTHER id holds a live lease in our own table
+// (we were demoted and somehow kept running): step down.
+static void heartbeat_lease(Store& store) {
+  if (!g_repl.enabled || !g_repl.leader) return;
+  std::vector<std::string> cmd = {kLeaderLease, g_repl.id,
+                                  std::to_string(g_repl.lease_ms)};
+  cmd.insert(cmd.begin(), "LOCK");
+  std::string out;
+  // CLIENT origin: the leader's own command, so the append stays
+  // verdict-gated — a +BUSY refresh (the demote case) must never land
+  // in the log, where followers would replay it as an acquisition
+  execute(store, cmd, out, ORIGIN_CLIENT);
+  if (out.rfind("+BUSY", 0) == 0) {
+    g_repl.leader = false;
+    fprintf(stderr, "mantlestore: lease held by another id; demoting\n");
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Snapshot persistence (replayable RESP command stream)
 // ---------------------------------------------------------------------------
@@ -360,9 +636,9 @@ static void emit_command(std::string& out,
   for (const auto& a : argv) resp_bulk(out, a);
 }
 
-static bool save_snapshot(Store& store, const std::string& path) {
+static void serialize_state(Store& store, std::string& out,
+                            bool include_locks) {
   store.sweep();
-  std::string out;
   double t = now_s();
   // parse_command caps commands at 1024 args: chunk multi-member emits
   // well below that so replay never truncates.
@@ -400,8 +676,24 @@ static bool save_snapshot(Store& store, const std::string& path) {
     if (ms > 0)
       emit_command(out, {"PEXPIRE", key, std::to_string(ms)});
   }
-  // locks deliberately not persisted: they self-expire and a restarted
-  // holder must not believe it still owns one
+  if (include_locks) {
+    // the resync path (REPL DUMP) carries live locks so a fresh
+    // follower knows the leader lease and any round-lifecycle holder;
+    // expired tombstones are skipped (their only job is the owner's
+    // late-UNLOCK verdict, and the owner talks to the leader)
+    for (const auto& [name, lk] : store.locks_) {
+      long long ms = (long long)((lk.deadline - t) * 1000.0);
+      if (ms > 0)
+        emit_command(out, {"LOCK", name, lk.token, std::to_string(ms)});
+    }
+  }
+}
+
+static bool save_snapshot(Store& store, const std::string& path) {
+  std::string out;
+  // locks deliberately not persisted across restarts: they self-expire
+  // and a restarted holder must not believe it still owns one
+  serialize_state(store, out, /*include_locks=*/false);
   std::string tmp = path + ".tmp";
   FILE* f = fopen(tmp.c_str(), "wb");
   if (!f) return false;
@@ -429,7 +721,7 @@ static void load_snapshot(Store& store, const std::string& path) {
   std::string discard;
   size_t n = 0;
   while (parse_command(buf, pos, argv)) {
-    execute(store, argv, discard);
+    execute(store, argv, discard, ORIGIN_LOAD);
     discard.clear();
     n++;
   }
@@ -457,9 +749,34 @@ static volatile sig_atomic_t g_shutdown = 0;
 static void on_term(int) { g_shutdown = 1; }
 
 int main(int argc, char** argv) {
-  int port = argc > 1 ? atoi(argv[1]) : 7070;
-  std::string snapshot_path = argc > 2 ? argv[2] : "";
-  double snapshot_interval = argc > 3 ? strtod(argv[3], nullptr) : 30.0;
+  int port = 7070;
+  std::string snapshot_path;
+  double snapshot_interval = 30.0;
+  int positional = 0;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "--repl") {
+      g_repl.enabled = true;
+    } else if (arg == "--follower") {
+      g_repl.enabled = true;
+      g_repl.leader = false;
+    } else if (arg == "--id" && i + 1 < argc) {
+      g_repl.id = argv[++i];
+    } else if (arg == "--lease-ms" && i + 1 < argc) {
+      g_repl.lease_ms = strtoll(argv[++i], nullptr, 10);
+    } else if (arg == "--max-log" && i + 1 < argc) {
+      g_repl.max_log = (size_t)strtoll(argv[++i], nullptr, 10);
+    } else if (positional == 0) {
+      port = atoi(arg.c_str());
+      positional++;
+    } else if (positional == 1) {
+      snapshot_path = arg;
+      positional++;
+    } else if (positional == 2) {
+      snapshot_interval = strtod(arg.c_str(), nullptr);
+      positional++;
+    }
+  }
   signal(SIGPIPE, SIG_IGN);
   signal(SIGTERM, on_term);
   signal(SIGINT, on_term);
@@ -490,9 +807,21 @@ int main(int argc, char** argv) {
   std::vector<std::string> cmd_args;
   double last_sweep = now_s();
   double last_save = now_s();
+  // heartbeat well inside the lease (3 beats per TTL, ≥4 Hz ceiling
+  // from the 250 ms epoll timeout) so one dropped beat never lapses it
+  double hb_interval = g_repl.lease_ms / 3000.0;
+  if (hb_interval > 1.0) hb_interval = 1.0;
+  double last_hb = 0.0;
+  if (g_repl.enabled && g_repl.leader) {
+    heartbeat_lease(store);
+    last_hb = now_s();
+  }
 
-  fprintf(stderr, "mantlestore listening on 127.0.0.1:%d%s\n", port,
-          snapshot_path.empty() ? "" : " (durable)");
+  fprintf(stderr, "mantlestore listening on 127.0.0.1:%d%s%s\n", port,
+          snapshot_path.empty() ? "" : " (durable)",
+          !g_repl.enabled ? ""
+                          : (g_repl.leader ? " (repl leader)"
+                                           : " (repl follower)"));
   fflush(stderr);
 
   epoll_event events[64];
@@ -512,6 +841,11 @@ int main(int argc, char** argv) {
     if (now_s() - last_sweep > 1.0) {
       store.sweep();
       last_sweep = now_s();
+    }
+    if (g_repl.enabled && g_repl.leader &&
+        now_s() - last_hb > hb_interval) {
+      heartbeat_lease(store);
+      last_hb = now_s();
     }
     if (!snapshot_path.empty() &&
         now_s() - last_save > snapshot_interval) {

@@ -60,13 +60,13 @@ def pytest_configure(config):
 FAST_MODULES = frozenset({
     "test_aux", "test_bench_harness", "test_check_concurrency",
     "test_check_jax", "test_check_metrics", "test_eval",
-    "test_fault_injection",
+    "test_fabric", "test_fault_injection",
     "test_flash_attention", "test_frontend", "test_fused_conv",
     "test_game", "test_js_runtime", "test_layers_norm", "test_masking",
     "test_masking_agreement", "test_multihost",
     "test_native_store", "test_obs", "test_ops", "test_pipeline",
     "test_pipeline_parallel", "test_samplers", "test_scoring",
-    "test_server", "test_spell", "test_store",
+    "test_server", "test_spell", "test_store", "test_store_parity",
     "test_supervisor", "test_utils", "test_weights",
     # deliberately NOT fast (stay in the default tier): test_mistral,
     # test_torch_parity, test_spec_decode, and test_stages —
@@ -87,6 +87,11 @@ SLOW_MODULES = frozenset({
     "test_deepcache",  # paired full/shallow pipeline compiles: ~2 min
     "test_img2img",    # encoder + per-strength-bucket compiles: ~1.5 min
     "test_manifests",  # full converter grammars over manifests: ~1 min
+    # multi-process fabric cluster runs (worker subprocesses + sustained
+    # HTTP/WS load + the store-leader failover drill): ~15 s of pure
+    # wall clock that the per-component fast-tier coverage in
+    # test_fabric already smoke-tests in-process
+    "test_fabric_cluster",
 })
 
 

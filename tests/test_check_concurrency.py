@@ -599,6 +599,73 @@ def test_production_locks_are_ordered_and_ranked():
     assert ranks == sorted(ranks)
 
 
+def test_fabric_locks_are_ranked():
+    """The fabric's three snapshot locks (ISSUE 8) sit between the
+    store-TTL tier (level 0) and the pipeline dispatch tier (10):
+    directory 4 < replication 5 < membership 6 — short-hold in-memory
+    snapshot guards, never held across an await or a store round trip
+    (the golden fixtures below pin the violating shape)."""
+    from cassmantle_tpu.engine.store import MemoryStore, ReplicatedStore
+    from cassmantle_tpu.fabric.directory import RoomDirectory
+    from cassmantle_tpu.fabric.membership import ClusterMembership
+
+    directory = RoomDirectory(["r0"], workers=["w0"])._lock
+    replication = ReplicatedStore([7070])._state_lock
+    membership = ClusterMembership(MemoryStore(), "w0")._lock
+    ranked = [
+        (directory, "fabric.directory", 4),
+        (replication, "fabric.replication", 5),
+        (membership, "fabric.membership", 6),
+    ]
+    for lock, name, rank in ranked:
+        assert isinstance(lock, OrderedLock)
+        assert (lock.name, lock.rank) == (name, rank)
+    assert [r for _, _, r in ranked] == sorted(r for _, _, r in ranked)
+    assert max(r for _, _, r in ranked) < 10  # outermost of the ranked tiers
+
+
+def test_store_failover_under_directory_lock_shape():
+    """Golden fixture pair for the fabric's store-failover shape: a
+    blocking store round trip (the failover probe) under the directory
+    lock is a violation — a dead leader's connect timeout would stall
+    every routing lookup in the worker; the shipped shape computes
+    under the lock and does store I/O outside it."""
+    findings = lint("""
+        import threading
+
+        class Directory:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def owner_with_failover(self, room):
+                with self._lock:
+                    fut = self.pool.submit(self.probe_leader)
+                    leader = fut.result()
+                    return self.ring[leader][room]
+    """, LockOrderPass())
+    assert rules(findings) == ["lock-blocking-call"]
+
+    clean = lint("""
+        import threading
+
+        class Directory:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def owner(self, room):
+                with self._lock:
+                    ring = self.ring
+                return self._lookup(ring, room)
+
+            def failover(self):
+                fut = self.pool.submit(self.probe_leader)
+                leader = fut.result(timeout=5.0)
+                with self._lock:
+                    self.leader = leader
+    """, LockOrderPass())
+    assert clean == []
+
+
 def test_lock_hierarchy_documented():
     import pathlib
 
@@ -608,7 +675,9 @@ def test_lock_hierarchy_documented():
     for name in ("pipeline.t2i_dispatch", "queue.dispatch_worker",
                  "supervisor", "circuit.<name>", "health.device",
                  "stage.scheduler", "stage.encode_dispatch",
-                 "stage.decode_dispatch", "pipeline.staged_init"):
+                 "stage.decode_dispatch", "pipeline.staged_init",
+                 "fabric.directory", "fabric.replication",
+                 "fabric.membership"):
         assert name in text, f"lock {name} missing from hierarchy table"
     for rule in ("lock-order-cycle", "lock-across-await",
                  "lock-blocking-call", "async-blocking-call",

@@ -1,0 +1,87 @@
+"""Multi-process room-fabric cluster tests (slow tier).
+
+The heavyweight end of ISSUE 8's acceptance: real worker PROCESSES over
+a shared (and then replicated) mantlestore, real HTTP + WS load through
+the bench harness, and the full failover drill — the store leader dies
+under live multi-worker traffic and the fleet keeps serving guesses
+from the promoted follower. The per-component versions of these
+behaviors run in the fast tier (tests/test_fabric.py); this module
+buys the cross-process integration at multi-second cost, which is why
+it lives in ``slow`` (tests/conftest.py).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import bench
+from cassmantle_tpu.native.client import MantleStore, ensure_built, spawn_server
+
+pytestmark = pytest.mark.skipif(
+    ensure_built() is None, reason="no C++ toolchain"
+)
+
+
+def test_multiworker_rooms_load():
+    """2 workers × 4 rooms under sustained load: guesses flow on every
+    worker (cross-worker 307s followed transparently), the WS clock
+    fans out, and the room spread is real."""
+    raw = bench.rooms_load_run(workers=2, rooms=4, sessions=6,
+                               seconds=4.0, ws_conns=4,
+                               base_port=8501, store_port=7501)
+    assert raw["guesses"] > 20
+    assert raw["ws_ticks"] >= 4
+    # the flood is allowed a handful of stragglers (connection churn at
+    # the deadline) but not systematic failure
+    assert raw["errors"] <= raw["guesses"] * 0.05
+
+
+def test_cluster_survives_store_leader_kill_under_load():
+    """The failover drill end-to-end: two fabric workers over a
+    replicated store pair; the leader dies mid-load; the workers'
+    ReplicatedStores promote the follower and the SECOND load phase
+    still lands guesses."""
+    leader = spawn_server(7671, repl=True, repl_id="A", lease_ms=600)
+    follower = spawn_server(7672, follower=True, repl_id="B", lease_ms=600)
+    procs = []
+    try:
+        procs, base_urls = bench.rooms_load_spawn_workers(
+            workers=2, rooms=3, base_port=8511,
+            store_addr="repl:127.0.0.1:7671,127.0.0.1:7672")
+        phase1 = asyncio.run(bench._rooms_load_drive(
+            base_urls, sessions=4, seconds=2.0, ws_conns=0))
+        assert phase1["guesses"] > 0
+        leader.kill()
+        leader.wait()
+        # the workers' next store op fails over once the 600 ms lease
+        # lapses on the follower; give the drill a fresh load phase
+        phase2 = asyncio.run(bench._rooms_load_drive(
+            base_urls, sessions=4, seconds=4.0, ws_conns=0))
+        assert phase2["guesses"] > 0, (
+            f"no guesses landed after leader kill ({phase2['errors']} "
+            f"errors)")
+
+        async def check_promoted():
+            c = MantleStore(port=7672)
+            role = await c.repl_role()
+            await c.close()
+            return role
+
+        deadline = time.monotonic() + 5.0
+        role = asyncio.run(check_promoted())
+        while role != "leader" and time.monotonic() < deadline:
+            time.sleep(0.2)
+            role = asyncio.run(check_promoted())
+        assert role == "leader"
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=5.0)
+        for proc in (leader, follower):
+            try:
+                proc.kill()
+                proc.wait()
+            except Exception:
+                pass

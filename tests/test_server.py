@@ -296,6 +296,69 @@ def test_rate_limiter_evicts_stale_first():
     assert all(not k[0].startswith("old-") for k in limiter._buckets)
 
 
+def test_rate_limiter_session_room_key_shape():
+    """ISSUE 8 satellite: buckets are namespaced by (client, room), so
+    one noisy room drains only its own quota — the same client's
+    allowance in another room is untouched — and eviction at the new
+    key shape stays targeted (the active (client, room) pair survives
+    an overflow with its spent tokens)."""
+    from cassmantle_tpu.server.ratelimit import RateLimiter
+
+    limiter = RateLimiter(max_entries=100, stale_s=1000.0)
+    # room A's burst spends; room B (same session) is unaffected
+    assert limiter.allow(("s1", "lobby"), "/compute_score", rate=1.0)
+    assert not limiter.allow(("s1", "lobby"), "/compute_score", rate=1.0)
+    assert limiter.allow(("s1", "room-1"), "/compute_score", rate=1.0)
+    # same (session, room), different route class: its own bucket too
+    assert limiter.allow(("s1", "lobby"), "/init", rate=1.0)
+    # overflow eviction: the busy pair keeps its SPENT bucket while
+    # one-shot pairs overflow the table around it
+    for i in range(200):
+        limiter.allow((f"s-{i}", "room-1"), "/compute_score", rate=1.0)
+        limiter.allow(("s1", "lobby"), "/compute_score", rate=1.0)
+    assert len(limiter._buckets) <= 101
+    assert not limiter.allow(("s1", "lobby"), "/compute_score", rate=1.0)
+    assert (("s1", "lobby"), "/compute_score") in limiter._buckets
+
+
+@pytest.mark.asyncio
+async def test_rate_limit_keys_include_room_over_http():
+    """End-to-end at the middleware: the same client exhausting room A's
+    API quota still gets requests through in room B. Needs a real
+    multi-room fabric — the legacy one-Game wrap deliberately pins
+    itself to a single room."""
+    from cassmantle_tpu.fabric.rooms import RoomFabric
+
+    cfg = make_cfg()
+    cfg = cfg.replace(game=dataclasses.replace(
+        cfg.game, rate_limit_api=2.0, rate_limit_default=1000.0),
+        fabric=dataclasses.replace(cfg.fabric, num_rooms=2))
+    store = MemoryStore()
+
+    def factory(room, room_store):
+        return Game(cfg, room_store, FakeContentBackend(image_size=32),
+                    hash_embed, hash_similarity)
+
+    fabric = RoomFabric(cfg, store, factory, start_timers=False,
+                        heartbeat=False)
+    app = create_app(fabric, cfg, start_timer=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        statuses_a = []
+        for _ in range(5):
+            res = await client.get(
+                "/client/status", params={"room": "lobby",
+                                          "session": "s1"})
+            statuses_a.append(res.status)
+        assert 429 in statuses_a          # room A quota exhausted
+        res = await client.get(
+            "/client/status", params={"room": "room-1", "session": "s1"})
+        assert res.status == 200          # room B quota untouched
+    finally:
+        await client.close()
+
+
 def test_device_health_probe():
     from cassmantle_tpu.utils.health import DeviceHealth
 
