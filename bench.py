@@ -11,6 +11,13 @@ the full BASELINE.md workload ladder (MiniLM scorer, GPT-2 greedy decode,
 SD1.5-512, SDXL-1024 data-parallel, end-to-end round with 1k concurrent
 guesses) and writes all results to BENCH_SUITE.json; the north-star line
 is still the last stdout line.
+
+Every suite entry snapshots the metrics registry before/after and
+attaches the nonzero **counter deltas** of the diagnosis counters
+(jit (re)compiles — the sentinel is armed per entry — cache
+hits/misses, staged-serving preemptions, dispatch hangs/deadlines/
+rejections) to its record, so a BENCH_SUITE.json trajectory explains
+its own regressions without a rerun.
 """
 
 from __future__ import annotations
@@ -995,6 +1002,45 @@ def bench_rooms_load(weights_dir: str) -> dict:
     }
 
 
+# Counters whose per-entry deltas carry diagnostic weight: recompiles,
+# cache effectiveness, staged-serving churn, and every supervision
+# counter (suffix match). Attached to each BENCH_SUITE.json record so
+# the bench trajectory carries its own diagnosis — a throughput drop
+# that arrives with a jit.recompiles delta or a dispatch_hangs count
+# explains itself without a rerun.
+_DELTA_COUNTERS = {
+    "jit.compiles", "jit.recompiles",
+    "scorer.embed_cache_hits", "scorer.embed_cache_misses",
+    "game.image_cache_hits", "game.image_cache_misses",
+    "stage.denoise.admissions", "stage.denoise.preemptions",
+    "stage.denoise.steps", "dispatch.thread_replacements",
+}
+_DELTA_SUFFIXES = (".dispatch_hangs", ".deadline_expired", ".rejected",
+                   ".rejected_degraded", ".failures", ".loop_errors")
+
+
+def _counter_snapshot() -> dict:
+    from cassmantle_tpu.utils.logging import metrics
+
+    return dict(metrics.snapshot()["counters"])
+
+
+def _counter_deltas(before: dict, after: dict) -> dict:
+    """Nonzero deltas of the diagnosis counters between two /metrics
+    counter snapshots (labeled series keep their label suffix)."""
+    out = {}
+    for name, value in sorted(after.items()):
+        base = name.split("{", 1)[0]
+        if base not in _DELTA_COUNTERS and \
+                not base.endswith(_DELTA_SUFFIXES):
+            continue
+        delta = value - before.get(name, 0.0)
+        if delta:
+            out[name] = int(delta) if float(delta).is_integer() \
+                else delta
+    return out
+
+
 # Ordered by evidence-per-minute-of-tunnel-uptime: the north-star config
 # and its fastest challenger run FIRST, so a tunnel that dies mid-suite
 # (rounds 1-4 all hit this) still lands the two numbers the perf case
@@ -1153,9 +1199,18 @@ def main() -> None:
     weights_dir = args[0] if args else os.path.join(repo, "weights")
 
     if entry:  # child mode: one entry, one JSON line, no probe
+        # arm the jit compile sentinel (log-only) so the entry's delta
+        # record can say how many (re)compiles its wall clock hides
+        from cassmantle_tpu.utils import jit_sentinel
+
+        jit_sentinel.enable_sentinel()
+        before = _counter_snapshot()
         t0 = time.perf_counter()
         res = SUITE[entry](weights_dir)
         res["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+        deltas = _counter_deltas(before, _counter_snapshot())
+        if deltas:
+            res["counter_deltas"] = deltas
         print(json.dumps(res))
         return
 

@@ -17,6 +17,15 @@ wildcards) plus ``block_timer(...)`` stage names, and checks:
    placeholders for dynamic segments), so a new metric cannot ship
    without operator documentation. Drift fails tier-1
    (``tests/test_check_metrics.py``).
+3. **Type agreement** (ISSUE 9) — the call kind at the emission site
+   must match the catalog row's declared type column: ``inc`` is a
+   counter, ``gauge`` a gauge, ``observe``/``timer``/``block_timer`` a
+   histogram. A site that drifts (a counter quietly becoming a gauge,
+   an ``observe`` on a cataloged counter) changes the Prometheus
+   exposition shape (``_total`` vs ``_bucket``) and silently breaks
+   every recording rule built on it — now a lint error instead of a
+   dashboard surprise. Catalog entries whose row has no recognizable
+   type column (prose mentions) don't constrain.
 """
 
 from __future__ import annotations
@@ -24,7 +33,7 @@ from __future__ import annotations
 import ast
 import pathlib
 import re
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from cassmantle_tpu.analysis.core import (
     PACKAGE,
@@ -62,11 +71,30 @@ def _literal_name(node: ast.expr) -> Optional[str]:
     return None
 
 
+def _is_registry_receiver(expr: ast.expr) -> bool:
+    """Does this call receiver look like a Metrics registry? The plain
+    ``metrics`` global, any ``*metrics*``/``*registry*``-named variable
+    or attribute (``self._registry``, an injected ``registry=``) — so
+    modules that take the registry by injection (obs/slo.py,
+    obs/process.py) lint like direct emitters instead of escaping the
+    catalog."""
+    if isinstance(expr, ast.Name):
+        tail = expr.id
+    elif isinstance(expr, ast.Attribute):
+        tail = expr.attr
+    else:
+        return False
+    tail = tail.lower()
+    return "metrics" in tail or "registry" in tail
+
+
 def extract_sites(source: str, path: str) -> List[Tuple[str, str, int]]:
     """(name_pattern, method, lineno) for every literal metrics call —
-    ``metrics.inc/gauge/observe/timer(...)`` plus ``block_timer(...)``
-    (utils/profiling.py's metric-emitting stage timer, linted as an
-    ``observe`` so device-stage names can't drift off the catalog)."""
+    ``<registry>.inc/gauge/observe/timer(...)`` on any registry-shaped
+    receiver (the ``metrics`` global, ``self._registry``, …) plus
+    ``block_timer(...)`` (utils/profiling.py's metric-emitting stage
+    timer, linted as an ``observe`` so device-stage names can't drift
+    off the catalog)."""
     sites = []
     tree = ast.parse(source, filename=path)
     for node in ast.walk(tree):
@@ -74,8 +102,7 @@ def extract_sites(source: str, path: str) -> List[Tuple[str, str, int]]:
             continue
         if (isinstance(node.func, ast.Attribute)
                 and node.func.attr in _METHODS
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "metrics"):
+                and _is_registry_receiver(node.func.value)):
             method = node.func.attr
         elif (isinstance(node.func, ast.Name)
                 and node.func.id == "block_timer"):
@@ -119,13 +146,40 @@ def load_catalog() -> List[str]:
     return sorted(set(_CATALOG_NAME.findall(CATALOG_DOC.read_text())))
 
 
+_TYPES = ("counter", "gauge", "histogram")
+# the method -> declared-type contract the type-agreement rule enforces
+_TYPE_FOR_METHOD = {"inc": "counter", "gauge": "gauge",
+                    "observe": "histogram", "timer": "histogram"}
+
+
+def load_catalog_types() -> Dict[str, str]:
+    """``{entry: declared_type}`` from the catalog's markdown tables:
+    a row whose second cell is exactly counter/gauge/histogram types
+    every backticked name in its first cell. Names appearing only in
+    prose carry no type and don't constrain."""
+    if not CATALOG_DOC.exists():
+        return {}
+    types: Dict[str, str] = {}
+    for line in CATALOG_DOC.read_text().splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if len(cells) >= 2 and cells[1] in _TYPES:
+            for name in _CATALOG_NAME.findall(cells[0]):
+                types[name] = cells[1]
+    return types
+
+
 class MetricNamePass(LintPass):
     name = "metric-name"
     description = ("metric naming convention + docs/OBSERVABILITY.md "
                    "catalog coverage")
 
-    def __init__(self, catalog: Optional[List[str]] = None) -> None:
+    def __init__(self, catalog: Optional[List[str]] = None,
+                 catalog_types: Optional[Dict[str, str]] = None) -> None:
         self._catalog = catalog
+        self._catalog_types = catalog_types
         self._warned_empty = False
 
     @property
@@ -133,6 +187,12 @@ class MetricNamePass(LintPass):
         if self._catalog is None:
             self._catalog = load_catalog()
         return self._catalog
+
+    @property
+    def catalog_types(self) -> Dict[str, str]:
+        if self._catalog_types is None:
+            self._catalog_types = load_catalog_types()
+        return self._catalog_types
 
     def run(self, module: Module) -> Iterator[Finding]:
         catalog = self.catalog
@@ -162,12 +222,29 @@ class MetricNamePass(LintPass):
                     RULE, module.rel, lineno,
                     f"histogram {name!r} must end _s (seconds) or _size")
                 continue
-            if catalog and not any(_name_matches(name, entry)
-                                   for entry in catalog):
-                yield Finding(
-                    RULE, module.rel, lineno,
-                    f"{name!r} not in the docs/OBSERVABILITY.md metric "
-                    f"catalog")
+            if catalog:
+                matched = [entry for entry in catalog
+                           if _name_matches(name, entry)]
+                if not matched:
+                    yield Finding(
+                        RULE, module.rel, lineno,
+                        f"{name!r} not in the docs/OBSERVABILITY.md "
+                        f"metric catalog")
+                    continue
+                # type agreement: the site's call kind must match the
+                # declared type of at least one matching typed row —
+                # a wildcard site matching several rows is fine as long
+                # as one of them is the right kind
+                expected = _TYPE_FOR_METHOD[method]
+                declared = [self.catalog_types[e] for e in matched
+                            if e in self.catalog_types]
+                if declared and expected not in declared:
+                    yield Finding(
+                        RULE, module.rel, lineno,
+                        f"{name!r} emitted as a {expected} "
+                        f"(metrics.{method}) but cataloged as "
+                        f"{'/'.join(sorted(set(declared)))} — type "
+                        f"drift; fix the site or the catalog row")
 
 
 def check(root: pathlib.Path = PACKAGE) -> List[str]:

@@ -364,6 +364,29 @@ class ObsConfig:
     # the single definition lives in utils/logging.py so series created
     # before configure_observability runs get the SAME ladder.
     latency_buckets_s: Tuple[float, ...] = _DEFAULT_BUCKETS_S
+    # -- cluster observability (ISSUE 9) -----------------------------------
+    # Per-peer timeout for cluster fan-outs (/metrics?scope=cluster,
+    # /debugz?trace=&scope=cluster): a dark peer costs at most this per
+    # scrape and is marked, never silently dropped.
+    cluster_fanout_timeout_s: float = 2.0
+    # Background cadence of the process self-metrics sampler (uptime,
+    # rss, cpu, event-loop lag; obs/process.py).
+    process_sample_interval_s: float = 5.0
+    # -- SLO burn-rate engine (obs/slo.py) ---------------------------------
+    # Evaluation cadence of the background loop; /sloz also evaluates
+    # on scrape (rate-limited internally). CASSMANTLE_NO_SLO=1 disables
+    # the background loop (docs/DEPLOY.md §6).
+    slo_eval_interval_s: float = 10.0
+    # Multi-window burn rates: trip on the fast window, recover on the
+    # slow one (obs/slo.py module docstring).
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    # Default objective thresholds (obs/slo.py default_objectives):
+    # p99 bound for /compute_score, round-generation success ratio,
+    # replication-lag bound in log commands.
+    slo_score_p99_s: float = 2.0
+    slo_generation_ratio: float = 0.9
+    slo_repl_lag_max: float = 512.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -52,10 +52,20 @@ class Game:
         similarity: SimilarityFn,
         blur_fn: Optional[BlurFn] = None,
         supervisor: Optional[ServingSupervisor] = None,
+        room: Optional[str] = None,
     ) -> None:
         game_cfg = cfg.game
         self.cfg = cfg
         self.store = store
+        # per-room metric labels (ISSUE 9 satellite): a fabric-built
+        # game labels its engine series with its room so N rooms on one
+        # worker stay distinguishable series instead of blending into
+        # one. None (legacy single-game callers) keeps every series'
+        # exact historical unlabeled key.
+        self.room = room
+        self._metric_labels: Optional[Dict[str, str]] = (
+            {"room": room} if room else None
+        )
         # the degradation control plane: production shares one supervisor
         # between the InferenceService and the engine (server/app.py
         # build_game); standalone/fake games get their own
@@ -82,6 +92,7 @@ class Game:
             on_promote=self._reset_sessions,
             reserve=self.reserve,
             breaker=self.supervisor.content_breaker,
+            metric_labels=self._metric_labels,
         )
         self.blur_fn = blur_fn or _pil_blur
         # blur bucket -> base64 JPEG, all for one round image identified
@@ -147,7 +158,9 @@ class Game:
             # same off-loop rule as _render_bucket: blur is CPU/device
             # work that must not stall the event loop (to_thread copies
             # contextvars, so the span lands in the request trace)
-            with tracer.span("game.blur"), metrics.timer("game.blur_s"):
+            with tracer.span("game.blur"), \
+                    metrics.timer("game.blur_s",
+                                  labels=self._metric_labels):
                 return self.blur_fn(image, radius)
 
         return await asyncio.to_thread(render)
@@ -185,13 +198,16 @@ class Game:
             self._image_renders = {}
         cached = self._image_cache.get(bucket)
         if cached is not None:
-            metrics.inc("game.image_cache_hits")
+            metrics.inc("game.image_cache_hits",
+                        labels=self._metric_labels)
             return cached
         task = self._image_renders.get(bucket)
         if task is not None:
-            metrics.inc("game.image_cache_hits")
+            metrics.inc("game.image_cache_hits",
+                        labels=self._metric_labels)
         else:
-            metrics.inc("game.image_cache_misses")
+            metrics.inc("game.image_cache_misses",
+                        labels=self._metric_labels)
             # the render runs as its OWN task: a waiter's cancellation
             # (client disconnect) must not cancel the shared render or
             # propagate to the other coalesced waiters
@@ -223,7 +239,9 @@ class Game:
             # JPEG codecs release the GIL; the TPU blur op just blocks
             # this worker thread on device dispatch)
             image = decode_jpeg(raw)
-            with tracer.span("game.blur"), metrics.timer("game.blur_s"):
+            with tracer.span("game.blur"), \
+                    metrics.timer("game.blur_s",
+                                  labels=self._metric_labels):
                 blurred = self.blur_fn(image, bucket)
             return image_to_base64(np.asarray(blurred))
 
@@ -287,11 +305,13 @@ class Game:
         if not pairs:
             return {"won": 0}
         with tracer.span("game.score", attrs={"pairs": len(pairs)}), \
-                metrics.timer("game.score_s"):
+                metrics.timer("game.score_s",
+                              labels=self._metric_labels):
             scores = await self.scorer.score_pairs(pairs)
         result = await self.sessions.set_scores(session, scores)
         await self.sessions.increment_attempt(session)
-        metrics.inc("game.guesses", len(pairs))
+        metrics.inc("game.guesses", len(pairs),
+                    labels=self._metric_labels)
         return result
 
     # -- clock / presence -------------------------------------------------

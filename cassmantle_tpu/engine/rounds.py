@@ -92,6 +92,7 @@ class RoundManager:
         on_promote: Optional[Callable[[], object]] = None,
         reserve: Optional[RoundReserve] = None,
         breaker: Optional[CircuitBreaker] = None,
+        metric_labels: Optional[Dict[str, str]] = None,
     ) -> None:
         self.store = store
         self.backend = backend
@@ -115,6 +116,9 @@ class RoundManager:
         # rotates reserve content instead of replaying.
         self.reserve = reserve
         self.breaker = breaker
+        # per-room series labels (ISSUE 9 satellite): None = the exact
+        # historical unlabeled keys (legacy single-game callers)
+        self.metric_labels = metric_labels
         self._timer_task: Optional[asyncio.Task] = None
         self._buffer_task: Optional[asyncio.Task] = None
 
@@ -257,11 +261,12 @@ class RoundManager:
                     return
                 title = self.select_seed()
                 await self.init_story(title)
-                with metrics.timer("round.generate_s"):
+                with metrics.timer("round.generate_s",
+                                   labels=self.metric_labels):
                     content = await self._generate(title, is_seed=True)
                 await self._store_content("current", content)
                 await self.store.hincrby(STORY_KEY, "episode", 1)
-                metrics.inc("rounds.generated")
+                metrics.inc("rounds.generated", labels=self.metric_labels)
                 log.info("content initialization complete")
         except LockTimeout:
             log.info("startup lock held elsewhere; waiting for content")
@@ -279,16 +284,17 @@ class RoundManager:
                 if is_seed:
                     log.info("restarting storyline")
                     await self.store.hset(STORY_KEY, "next", seed)
-                with metrics.timer("round.generate_s"):
+                with metrics.timer("round.generate_s",
+                                   labels=self.metric_labels):
                     content = await self._generate(seed, is_seed)
                 await self._store_content("next", content)
-                metrics.inc("rounds.buffered")
+                metrics.inc("rounds.buffered", labels=self.metric_labels)
                 log.info("content buffering complete")
         except LockTimeout:
             log.info("buffer lock held elsewhere; skipping")
         except Exception as exc:
             log.exception("buffering failed; old round will replay")
-            metrics.inc("rounds.buffer_failures")
+            metrics.inc("rounds.buffer_failures", labels=self.metric_labels)
             flight_recorder.record("round.buffer_failed",
                                    error=type(exc).__name__)
 
@@ -308,7 +314,7 @@ class RoundManager:
                     if await self._promote_from_reserve():
                         return
                     log.warning("no buffered content; replaying round")
-                    metrics.inc("rounds.replays")
+                    metrics.inc("rounds.replays", labels=self.metric_labels)
                     flight_recorder.record("round.replayed")
                     return
                 prompt_prev = await self.store.hget(PROMPT_KEY, "current")
@@ -339,7 +345,7 @@ class RoundManager:
                     await self.init_story(next_story.decode())
                     await self.store.hdel(STORY_KEY, "next")
                 await self.store.hincrby(STORY_KEY, "episode", 1)
-                metrics.inc("rounds.promoted")
+                metrics.inc("rounds.promoted", labels=self.metric_labels)
                 flight_recorder.record("round.promoted")
                 log.info("buffer promotion complete")
         except LockTimeout:
@@ -348,7 +354,7 @@ class RoundManager:
             # reference semantics: promotion failures log and abandon the
             # round update (backend.py:236-238); the old round replays
             log.exception("promotion failed; old round will replay")
-            metrics.inc("rounds.promote_failures")
+            metrics.inc("rounds.promote_failures", labels=self.metric_labels)
 
     async def _promote_from_reserve(self) -> bool:
         """Degraded promotion (runs under the promotion lock): pull the
@@ -378,7 +384,7 @@ class RoundManager:
         # the reserve round becomes the story-so-far: when the backend
         # heals, the next episode continues from what players last saw
         await self.store.hset(PROMPT_KEY, "seed", text)
-        metrics.inc("rounds.reserve_promotions")
+        metrics.inc("rounds.reserve_promotions", labels=self.metric_labels)
         flight_recorder.record("round.reserve_promotion")
         log.warning("generation dark; promoted reserve round "
                     "(fresh-content degraded mode)")
@@ -411,7 +417,8 @@ class RoundManager:
             await asyncio.sleep(tick)
             try:
                 remaining = await self.store.ttl(COUNTDOWN_KEY)
-                metrics.gauge("round.remaining_s", remaining)
+                metrics.gauge("round.remaining_s", remaining,
+                              labels=self.metric_labels)
                 if remaining <= 0:
                     # clear BEFORE rollover: if rollover partially fails
                     # (clock restarted, reset flag lost), the new round
@@ -431,7 +438,8 @@ class RoundManager:
                 # the clock is the one task that must never die: a store
                 # hiccup skips this tick and the next tick retries
                 log.exception("timer tick failed; continuing")
-                metrics.inc("rounds.timer_tick_failures")
+                metrics.inc("rounds.timer_tick_failures",
+                            labels=self.metric_labels)
 
     def start(self, tick: float = 1.0) -> asyncio.Task:
         self._timer_task = asyncio.ensure_future(self.global_timer(tick))

@@ -58,22 +58,41 @@ class ClusterMembership:
         return await self.refresh()
 
     async def refresh(self) -> Dict[str, dict]:
-        """Re-read the membership table; returns live workers only."""
+        """Re-read the membership table; caches and returns live
+        workers only (one parser — :meth:`table` — decides liveness)."""
+        table = await self.table()
+        live = {worker: row["info"] for worker, row in table.items()
+                if not row["stale"]}
+        with self._lock:
+            self._live = live
+        metrics.gauge("fabric.workers_live", float(len(live)))
+        return live
+
+    async def table(self) -> Dict[str, dict]:
+        """The FULL membership table with staleness marked per entry:
+        ``{worker: {"info", "stale", "age_s"}}`` — the ONE place the
+        hash is parsed and liveness judged (``refresh`` derives from
+        it). The cluster observability fan-outs
+        (`/metrics?scope=cluster`, `/debugz?trace=&scope=cluster`)
+        read this instead of the live view so a dead/stale peer is
+        *marked* in the merged output rather than silently vanishing
+        from it."""
         raw = await self.store.hgetall(WORKERS_KEY)
         now = self._clock()
-        live: Dict[str, dict] = {}
+        table: Dict[str, dict] = {}
         for field, value in raw.items():
             worker = field if isinstance(field, str) else field.decode()
             try:
                 info = json.loads(value.decode())
             except Exception:
-                continue  # torn/foreign field: not a live worker
-            if now - float(info.get("t", 0.0)) <= self.ttl_s:
-                live[worker] = info
-        with self._lock:
-            self._live = live
-        metrics.gauge("fabric.workers_live", float(len(live)))
-        return live
+                continue  # torn/foreign field, same rule as refresh()
+            age = now - float(info.get("t", 0.0))
+            table[worker] = {
+                "info": info,
+                "stale": age > self.ttl_s,
+                "age_s": round(age, 3),
+            }
+        return table
 
     async def leave(self) -> None:
         """Graceful departure: peers re-place our rooms on their next

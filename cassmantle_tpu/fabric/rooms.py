@@ -160,6 +160,7 @@ class RoomFabric:
             store, worker_id, addr=advertise_addr,
             ttl_s=cfg.fabric.membership_ttl_s)
         self._heartbeat_enabled = heartbeat
+        self._cluster_key: Optional[bytes] = None
         self._games: Dict[str, Game] = {}
         self._startups: Dict[str, asyncio.Task] = {}
         self._hb_task: Optional[asyncio.Task] = None
@@ -200,6 +201,95 @@ class RoomFabric:
 
     def owned_rooms(self) -> List[str]:
         return self.directory.rooms_owned_by(self.worker_id)
+
+    def peer_hosts(self) -> set:
+        """Hostnames of every live member's advertised address (plus
+        our own advertise) — one leg of the trust set for inbound
+        cross-worker observability (server/app.py ``_is_cluster_peer``;
+        exact-match only, so fleets advertising DNS names or NATed
+        egress rely on the cluster-secret leg below instead).
+        Membership rows come from the shared store, which cluster
+        workers already trust for round state itself."""
+        from urllib.parse import urlsplit
+
+        addrs = [info.get("addr")
+                 for info in self.membership.live_workers().values()]
+        addrs.append(self.membership.addr)
+        hosts = set()
+        for addr in addrs:
+            if not addr:
+                continue
+            try:
+                host = urlsplit(addr).hostname
+            except ValueError:
+                continue
+            if host:
+                hosts.add(host)
+        return hosts
+
+    # -- cluster secret (cross-worker observability trust) -----------------
+    # The store distributes one random secret per cluster: a cross-
+    # worker 307 pins tracesig=HMAC(secret, traceparent) next to the
+    # trace context, so the owner worker can honor a context carried
+    # BACK by an untrusted client (the redirect channel — the bearer's
+    # IP proves nothing), and peer fan-outs authenticate with a
+    # secret-derived bearer token instead of IP matching (which breaks
+    # under DNS-advertised addresses or NATed egress). Trust anchor =
+    # the shared store, exactly the thing cluster workers already
+    # trust for round state.
+    CLUSTER_KEY_STORE_KEY = "fabric:cluster_key"
+
+    async def _ensure_cluster_key(self) -> None:
+        import secrets
+
+        try:
+            raw = await self.store.get(self.CLUSTER_KEY_STORE_KEY)
+            if raw is None:
+                await self.store.set(self.CLUSTER_KEY_STORE_KEY,
+                                     secrets.token_hex(32))
+                # re-read: two workers racing the first boot both keep
+                # whichever write won (last-write store semantics)
+                raw = await self.store.get(self.CLUSTER_KEY_STORE_KEY)
+            self._cluster_key = raw
+        except Exception:
+            # READONLY follower mid-election / store hiccup: no key
+            # means signature trust is simply unavailable this beat
+            # (loopback/host legs still work); the next heartbeat
+            # retries
+            log.exception("cluster key fetch failed; retrying next beat")
+            self._cluster_key = None
+
+    def _hmac(self, payload: str) -> Optional[str]:
+        import hashlib
+        import hmac
+
+        key = getattr(self, "_cluster_key", None)
+        if not key:
+            return None
+        return hmac.new(key, payload.encode(), hashlib.sha256) \
+            .hexdigest()[:32]
+
+    def sign_trace(self, traceparent: str) -> Optional[str]:
+        """The ``tracesig`` a redirect pins next to ``traceparent``
+        (None while the key is unavailable)."""
+        return self._hmac("trace:" + traceparent)
+
+    def verify_trace_sig(self, traceparent: str, sig: str) -> bool:
+        import hmac
+
+        want = self.sign_trace(traceparent)
+        return want is not None and hmac.compare_digest(want, sig)
+
+    def cluster_token(self) -> Optional[str]:
+        """The bearer token peer fan-outs send as ``X-Cluster-Auth``
+        (a fixed derivation, NOT the key itself)."""
+        return self._hmac("peer-auth")
+
+    def verify_cluster_token(self, token: str) -> bool:
+        import hmac
+
+        want = self.cluster_token()
+        return want is not None and hmac.compare_digest(want, token)
 
     # -- room lifecycle ----------------------------------------------------
     async def game_for(self, room: str) -> Game:
@@ -281,6 +371,7 @@ class RoomFabric:
             # log-shipping pump on this worker's event loop
             await starter()
         if self._heartbeat_enabled:
+            await self._ensure_cluster_key()
             live = await self.membership.heartbeat(len(self._games))
             self._apply_membership(live)
         # preinstalled games (the for_game legacy wrap) start the way
@@ -315,6 +406,12 @@ class RoomFabric:
         while True:
             await asyncio.sleep(interval)
             try:
+                # EVERY beat re-reads the store key: a worker that lost
+                # the first-boot set race (or cached a key the store
+                # later replaced) must converge on the winning value,
+                # not hold its loser forever and mint signatures no
+                # peer verifies
+                await self._ensure_cluster_key()
                 live = await self.membership.heartbeat(len(self._games))
                 await self._handle_moves(self._apply_membership(live))
             except asyncio.CancelledError:

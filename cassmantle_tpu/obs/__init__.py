@@ -1,6 +1,6 @@
 """Observability subsystem: request tracing, flight recorder, wiring.
 
-Three pieces, one package (ISSUE 3):
+The per-worker pieces (ISSUE 3):
 
 - :mod:`cassmantle_tpu.obs.trace` — contextvar-propagated per-request
   trace/span IDs with a bounded in-process span sink. The HTTP layer
@@ -13,8 +13,20 @@ Three pieces, one package (ISSUE 3):
   reserve rotations, round promotions) surfaced at ``/debugz`` and
   embedded in a degraded ``/readyz`` verdict.
 - The metrics registry itself stays in :mod:`cassmantle_tpu.utils.logging`
-  (histograms + Prometheus exposition) so the low-level layers keep
-  their one import; this package depends on utils, never the reverse.
+  (histograms + Prometheus exposition + the federation state
+  dump/merge) so the low-level layers keep their one import; this
+  package depends on utils, never the reverse.
+
+And the cluster-wide pieces (ISSUE 9):
+
+- cross-worker trace propagation — ``traceparent`` format/parse in
+  :mod:`cassmantle_tpu.obs.trace`, the HTTP acceptance/peer gate and
+  the cluster-merged ``/debugz?trace=`` view in ``server/app.py``;
+- :mod:`cassmantle_tpu.obs.slo` — the SLO burn-rate engine
+  (declarative objectives, fast/slow windows, ``/sloz``, the
+  non-gating ``/readyz`` advisory block);
+- :mod:`cassmantle_tpu.obs.process` — process self-metrics
+  (uptime/rss/cpu + event-loop lag), every worker's federation floor.
 
 ``configure_observability(cfg.obs)`` applies the config knobs to the
 process-global instances; server startup calls it (server/app.py).

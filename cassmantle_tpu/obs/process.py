@@ -1,0 +1,86 @@
+"""Process self-metrics: the per-worker health floor of federation.
+
+A cluster `/metrics` view is only as useful as each worker's baseline:
+before any serving-specific signal, an operator needs to see that every
+process is up (``process.uptime_s``), how much resident memory it holds
+(``process.rss_bytes`` — the param/compile caches dominate), how much
+CPU it has burned (``process.cpu_s``, user+system, cumulative), and
+whether its asyncio event loop is keeping up (``server.loop_lag_s`` —
+the 1 Hz WS clock and every handler share that loop, so sustained lag
+IS user-visible latency).
+
+All four are gauges refreshed by a background sampler task
+(``ObsConfig.process_sample_interval_s``) and, for the three process
+gauges, opportunistically on every `/metrics` scrape — a scrape always
+sees fresh values without waiting out the sampler interval. Loop lag is
+measured only by the sampler (sleep-overshoot of its own interval: the
+probe needs the loop to actually schedule it).
+
+RSS comes from ``/proc/self/statm`` (current resident set); on hosts
+without procfs it falls back to ``resource.getrusage`` peak RSS —
+documented as a ceiling, not a current value, but monotone enough to
+alert on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Callable
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("obs.process")
+
+try:
+    _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+except (ValueError, OSError, AttributeError):
+    _PAGE_SIZE = 4096
+
+
+class ProcessMetrics:
+    def __init__(self, registry=None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._registry = registry if registry is not None else metrics
+        self._clock = clock
+        self._start = clock()
+
+    def rss_bytes(self) -> float:
+        try:
+            with open("/proc/self/statm") as f:
+                return float(f.read().split()[1]) * _PAGE_SIZE
+        except Exception:
+            import resource
+
+            # ru_maxrss is PEAK rss in KiB on linux — a ceiling, used
+            # only where procfs is absent
+            return float(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            ) * 1024.0
+
+    def cpu_seconds(self) -> float:
+        t = os.times()
+        return float(t.user + t.system)
+
+    def sample(self) -> None:
+        """Refresh the three process gauges (cheap: two syscalls and a
+        procfs read — safe on every scrape)."""
+        self._registry.gauge("process.uptime_s",
+                             self._clock() - self._start)
+        self._registry.gauge("process.rss_bytes", self.rss_bytes())
+        self._registry.gauge("process.cpu_s", self.cpu_seconds())
+
+    async def run(self, interval_s: float = 5.0) -> None:
+        """Background sampler: process gauges plus the event-loop lag
+        probe — the overshoot of our own sleep is exactly how long a
+        ready callback waited behind whatever clogged the loop."""
+        loop = asyncio.get_running_loop()
+        self._registry.gauge("server.loop_lag_s", 0.0)
+        self.sample()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(interval_s)
+            lag = max(0.0, (loop.time() - t0) - interval_s)
+            self._registry.gauge("server.loop_lag_s", lag)
+            self.sample()

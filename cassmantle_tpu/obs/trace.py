@@ -27,12 +27,25 @@ Each root context also carries a small mutable ``marks`` dict shared by
 the whole request: the queue writes ``queue_wait_s`` / ``service_s``
 into it so the HTTP layer can return ``X-Queue-Wait`` /
 ``X-Service-Time`` headers without re-walking the trace.
+
+**Cross-worker propagation (ISSUE 9):** a trace crosses the fabric's
+worker boundary as a W3C-style ``traceparent`` token
+(``00-<trace_id>-<span_id>-<flags>``): the HTTP layer accepts it as a
+header (service mesh / peer fan-out) or a query parameter (the one
+channel a 307 ``Location`` can carry through the redirecting client),
+peer-gated to cluster members and loopback (server/app.py). A span
+opened with ``tracer.span(..., parent=remote_ctx)`` continues the
+remote trace — same trace id, the remote span as parent — so the
+redirect hop, the owner worker's handling, and its device-stage spans
+all land in ONE trace, merged across workers by
+``/debugz?trace=<id>&scope=cluster``.
 """
 
 from __future__ import annotations
 
 import contextvars
 import random
+import re
 import threading
 import time
 import uuid
@@ -89,6 +102,36 @@ def run_with_ctx(ctx: Optional[SpanContext], fn, *args):
 
 def _new_id(nbytes: int) -> str:
     return uuid.uuid4().hex[: 2 * nbytes]
+
+
+# W3C trace-context shape, version 00: 16-byte trace id, 8-byte span id
+# (exactly the widths this tracer already mints), 1 flag byte whose low
+# bit is "sampled".
+_TRACEPARENT = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    """The outbound wire form of a context — what the fabric pins onto
+    a cross-worker 307 ``Location`` (query param) and what a peer
+    fan-out sends as a header."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-" \
+           f"{'01' if ctx.sampled else '00'}"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
+    """A :class:`SpanContext` from an inbound ``traceparent`` token, or
+    None for anything malformed (malformed input is DROPPED, never a
+    fresh trace — the caller decides what an absent context means). The
+    marks blackboard is fresh: it is per-request local state, never
+    shared across the worker boundary."""
+    if not value:
+        return None
+    m = _TRACEPARENT.match(value.strip().lower())
+    if not m:
+        return None
+    return SpanContext(m.group(1), m.group(2), m.group(3) != "00",
+                       marks={})
 
 
 class _SpanHandle:
@@ -218,13 +261,20 @@ class Tracer:
 
     @contextmanager
     def span(self, name: str, *, root: bool = False,
+             parent: Optional[SpanContext] = None,
              attrs: Optional[dict] = None):
         """Open a span as the new ambient context, child of the ambient
-        parent. ``root=True`` forces a fresh trace. The body may mutate
+        parent. ``root=True`` forces a fresh trace; ``parent=`` CONTINUES
+        an explicit (typically remote, traceparent-parsed) context
+        instead — same trace id, that span as parent — which is how a
+        cross-worker hop stays one trace. The body may mutate
         ``handle.attrs``; exceptions mark status=error and propagate.
         (Spans with an explicit non-ambient parent — the queue's batch
         split — go through :meth:`record_span` directly.)"""
-        if root:
+        if parent is not None:
+            ctx = self.child_ctx(parent)
+            parent_id = parent.span_id
+        elif root:
             ctx = self.new_root_ctx()
             parent_id = None
         else:

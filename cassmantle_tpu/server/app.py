@@ -11,11 +11,18 @@ Route-for-route parity with the reference (SURVEY.md §1 L4, §3.3-3.5):
                             (main.py:113-120)
 - ``WS   /clock``          1 Hz {time, reset, conns} push (main.py:55-79)
 - ``GET  /metrics``        JSON snapshot by default; Prometheus text
-                           exposition under ``Accept: text/plain``
-                           (new; SURVEY.md §5.5, ISSUE 3)
+                           exposition under ``Accept: text/plain``;
+                           ``?scope=cluster`` federates every live
+                           member's registry into one view and
+                           ``?format=state`` is the peer wire format
+                           (new; SURVEY.md §5.5, ISSUES 3+9)
 - ``GET  /debugz``         flight-recorder event ring + trace lookup
-                           (``?trace=<X-Trace-Id>``) — the serving
-                           black box (new; ISSUE 3)
+                           (``?trace=<X-Trace-Id>``; ``&scope=cluster``
+                           merges the trace across workers) — the
+                           serving black box (new; ISSUES 3+9)
+- ``GET  /sloz``           SLO burn-rate verdicts per objective
+                           (obs/slo.py; advisory in /readyz) (new;
+                           ISSUE 9)
 - ``GET  /healthz``        liveness: process + store + device (new)
 - ``GET  /readyz``         readiness: supervisor verdict — breakers,
                            dispatch watchdog, device health fused; 503 +
@@ -39,12 +46,19 @@ from typing import Optional
 
 from aiohttp import WSMsgType, web
 
-from cassmantle_tpu.config import FrameworkConfig
+from cassmantle_tpu.config import FrameworkConfig, ObsConfig
 from cassmantle_tpu.engine.game import Game
 from cassmantle_tpu.fabric.rooms import RoomFabric
 from cassmantle_tpu.obs import configure_observability, flight_recorder, tracer
-from cassmantle_tpu.obs.trace import current_marks
-from cassmantle_tpu.utils.logging import get_logger, metrics
+from cassmantle_tpu.obs.process import ProcessMetrics
+from cassmantle_tpu.obs.slo import SloEngine, default_objectives
+from cassmantle_tpu.obs.trace import (
+    current_ctx,
+    current_marks,
+    format_traceparent,
+    parse_traceparent,
+)
+from cassmantle_tpu.utils.logging import get_logger, merge_states, metrics
 
 log = get_logger("app")
 
@@ -56,6 +70,27 @@ MEDIA_DIR = os.path.join(_ROOT, "media")
 
 _FABRIC = web.AppKey("fabric", RoomFabric)
 _TRACE_STATE = web.AppKey("trace_state", dict)
+_OBS_CFG = web.AppKey("obs_cfg", ObsConfig)
+_SLO = web.AppKey("slo_engine", SloEngine)
+_PROCESS = web.AppKey("process_metrics", ProcessMetrics)
+# mutable holders (aiohttp freezes app keys at startup): the lazy peer
+# ClientSession for cluster fan-outs, and the background obs tasks
+_PEER_HTTP = web.AppKey("peer_http", dict)
+_OBS_TASKS = web.AppKey("obs_tasks", list)
+
+
+def _env_flag_set(name: str) -> bool:
+    """One truthy-parse for the obs kill switches (1/true/yes/on)."""
+    return os.environ.get(name, "").lower() in ("1", "true", "yes",
+                                                "on")
+
+
+def _cluster_obs_enabled() -> bool:
+    """CASSMANTLE_NO_CLUSTER_OBS=1 turns off the cross-worker surface:
+    inbound trace contexts are ignored and cluster fan-outs answer
+    worker-local — the kill switch for a fleet where the peer trust
+    set (membership-advertised hosts) cannot be relied on."""
+    return not _env_flag_set("CASSMANTLE_NO_CLUSTER_OBS")
 
 
 def _client_ip(request: web.Request) -> str:
@@ -110,6 +145,21 @@ def _check_room_ownership(request: web.Request, fabric: RoomFabric,
     session = _session_id(request)
     if session:
         url = url.update_query(session=session)
+    # the Location also pins the ACTIVE trace context (ISSUE 9): headers
+    # don't survive a redirect, a query param does — the owner worker
+    # continues this trace instead of starting a fresh one, so the hop
+    # and the owner's device stages read as ONE trace. The redirect is
+    # carried BACK by the (untrusted) client, whose IP proves nothing,
+    # so the param travels with an HMAC signature under the store-
+    # distributed cluster secret: the owner honors the signature, not
+    # the bearer.
+    ctx = current_ctx()
+    if ctx is not None:
+        tp = format_traceparent(ctx)
+        url = url.update_query(traceparent=tp)
+        sig = fabric.sign_trace(tp)
+        if sig:
+            url = url.update_query(tracesig=sig)
     raise web.HTTPTemporaryRedirect(location=addr.rstrip("/") + str(url))
 
 
@@ -130,6 +180,24 @@ def _is_loopback(request: web.Request) -> bool:
     """Fail closed: an unresolvable peer (unix socket behind a proxy)
     is NOT local — same rule as /debug/trace."""
     return request.remote in ("127.0.0.1", "::1")
+
+
+def _is_cluster_peer(request: web.Request, fabric: RoomFabric) -> bool:
+    """The cluster trust gate, three legs: loopback; the connecting
+    host exactly matches a live member's advertised address
+    (fabric.peer_hosts); or the request bears the cluster-secret
+    token (``X-Cluster-Auth``, fabric.cluster_token — what peer
+    fan-outs send, and the leg that works when advertised addresses
+    are DNS names or egress is NATed). All three anchor in state the
+    fleet already trusts (the process, the shared store). Guards the
+    /debugz and cluster-federation surfaces; an outsider is counted
+    and refused, never honored."""
+    if _is_loopback(request):
+        return True
+    if request.remote in fabric.peer_hosts():
+        return True
+    token = request.headers.get("X-Cluster-Auth")
+    return bool(token) and fabric.verify_cluster_token(token)
 
 
 @web.middleware
@@ -159,10 +227,43 @@ async def tracing_middleware(request: web.Request, handler):
     # per flap
     if request.path.startswith(("/static", "/data", "/media")) or \
             request.path in ("/healthz", "/readyz", "/metrics",
-                             "/debugz", "/debug/trace", "/clock"):
+                             "/debugz", "/debug/trace", "/clock",
+                             "/sloz"):
         return await handler(request)
+    fabric = request.app[_FABRIC]
+    # inbound trace context (ISSUE 9): a traceparent header (peer
+    # fan-out, mesh) or query param (rides a cross-worker 307 Location
+    # through the redirecting client) CONTINUES that trace — honored
+    # from cluster members/loopback, or via the QUERY param when it
+    # carries a valid ``tracesig`` (the redirecting worker's HMAC under
+    # the cluster secret — an external player following a 307 keeps one
+    # trace). The two channels are judged independently: an
+    # OTel-instrumented client auto-injecting its own traceparent
+    # HEADER must not shadow the signed query context the redirect
+    # pinned. Anything that passes no leg is counted and ignored: a
+    # client-minted context must not join foreign traces or pollute
+    # the ring.
+    remote_ctx = None
+    header_tp = request.headers.get("traceparent")
+    query_tp = request.query.get("traceparent")
+    if (header_tp or query_tp) and _cluster_obs_enabled():
+        chosen = None
+        sig = request.query.get("tracesig")
+        if query_tp and sig and fabric.verify_trace_sig(query_tp, sig):
+            # a validly SIGNED query context wins over everything: the
+            # signature binds it to this exact hop, where a header is
+            # just ambient client instrumentation
+            chosen = query_tp
+        elif _is_cluster_peer(request, fabric):
+            chosen = header_tp or query_tp
+        remote_ctx = parse_traceparent(chosen) if chosen else None
+        if remote_ctx is not None:
+            metrics.inc("obs.trace_joins")
+        else:
+            metrics.inc("obs.trace_ctx_rejected")
     name = f"http.{request.method.lower()} {request.path}"
-    with tracer.span(name, root=True) as span:
+    with tracer.span(name, root=remote_ctx is None, parent=remote_ctx,
+                     attrs={"worker": fabric.worker_id}) as span:
         try:
             response = await handler(request)
         except web.HTTPException as exc:
@@ -354,17 +455,150 @@ async def handle_clock(request: web.Request) -> web.WebSocketResponse:
     return ws
 
 
+def _peer_session(request: web.Request):
+    """Lazy per-app aiohttp ClientSession for cluster fan-outs (created
+    on first use so it binds the serving loop; closed at app cleanup)."""
+    import aiohttp
+
+    holder = request.app[_PEER_HTTP]
+    if holder.get("session") is None:
+        obs_cfg = request.app[_OBS_CFG]
+        holder["session"] = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=obs_cfg.cluster_fanout_timeout_s))
+    return holder["session"]
+
+
+async def _peer_fanout(request: web.Request, path: str, params: dict):
+    """Fan one GET out to every live member CONCURRENTLY, self
+    excluded (the whole fan-out costs ~one ``cluster_fanout_timeout_s``
+    even with several dark peers, not one per). Returns ``(worker,
+    row)`` pairs where row is ``{"status": ...}`` plus the decoded JSON
+    body under ``data`` on success. Stale/dead/addressless peers are
+    MARKED (status stale/no_addr/error/http_<code>) rather than
+    silently dropped — the merged view must say who is missing from
+    it. Requests carry the cluster token so the peer's gate admits us
+    regardless of how its membership addresses resolve."""
+    fabric = request.app[_FABRIC]
+    session = _peer_session(request)
+    headers = {}
+    token = fabric.cluster_token()
+    if token:
+        headers["X-Cluster-Auth"] = token
+
+    async def fetch(worker: str, addr: str):
+        try:
+            async with session.get(addr.rstrip("/") + path,
+                                   params=params,
+                                   headers=headers) as res:
+                if res.status != 200:
+                    return worker, {"status": f"http_{res.status}"}
+                data = await res.json()
+            return worker, {"status": "ok", "data": data}
+        except Exception as exc:
+            metrics.inc("obs.federation_peer_errors")
+            return worker, {"status": "error",
+                            "error": type(exc).__name__}
+
+    results = []
+    fetches = []
+    table = await fabric.membership.table()
+    for worker, row in sorted(table.items()):
+        if worker == fabric.worker_id:
+            continue
+        if row["stale"]:
+            results.append((worker, {"status": "stale",
+                                     "age_s": row["age_s"]}))
+            continue
+        addr = row["info"].get("addr")
+        if not addr:
+            results.append((worker, {"status": "no_addr"}))
+            continue
+        fetches.append(fetch(worker, addr))
+    results.extend(await asyncio.gather(*fetches))
+    return results
+
+
+async def _federated_metrics(request: web.Request):
+    """(merged registry, federation block): this worker's full registry
+    state plus every reachable peer's, merged per utils/logging.py
+    merge_states — counters sum, gauges get a ``worker`` label,
+    fixed-bucket histograms merge exactly. ``federation.peer_up``
+    gauges in the merged registry mark each peer's reachability so a
+    Prometheus scrape of the cluster view carries its own coverage."""
+    fabric = request.app[_FABRIC]
+    states = [(fabric.worker_id, metrics.dump_state())]
+    federation = {fabric.worker_id: {"status": "self"}}
+    for worker, row in await _peer_fanout(request, "/metrics",
+                                          {"format": "state"}):
+        state = row.get("data", {}).get("state") \
+            if row["status"] == "ok" else None
+        if state is not None:
+            states.append((worker, state))
+            federation[worker] = {"status": "ok"}
+        elif row["status"] == "ok":
+            # a 200 without the state payload (mid-rollout peer still
+            # serving the legacy snapshot): mark it, don't 500 the
+            # whole cluster scrape
+            federation[worker] = {"status": "bad_payload"}
+        else:
+            federation[worker] = row
+    cluster_metrics = merge_states(states)
+    for worker, row in federation.items():
+        cluster_metrics.gauge(
+            "federation.peer_up",
+            1.0 if row["status"] in ("self", "ok") else 0.0,
+            labels={"worker": worker})
+    return cluster_metrics, federation
+
+
 async def handle_metrics(request: web.Request) -> web.Response:
     """Content-negotiated: Prometheus text exposition when the client
     asks for text/plain (a scraper's Accept header), the historical
-    JSON snapshot otherwise — existing dashboards keep their shape."""
+    JSON snapshot otherwise — existing dashboards keep their shape.
+
+    ``?scope=cluster`` federates: one scrape (or one curl) answers for
+    the whole cluster — peers discovered via membership, counters
+    summed, gauges worker-labeled, histogram buckets merged exactly,
+    unreachable peers marked (``federation`` block / the
+    ``federation.peer_up`` gauge). ``?format=state`` serves this
+    worker's full-fidelity registry state — the peer-to-peer wire
+    format the federation rides (and always worker-local: a peer's
+    federation request must never recurse into a second fan-out).
+
+    The plain per-worker scrape stays public (status quo); the two
+    CLUSTER forms are gated like /debugz (loopback/members/token) —
+    an open ``scope=cluster`` would hand any client an N-fold request
+    amplifier against the whole fleet."""
+    proc = request.app[_PROCESS]
+    proc.sample()            # scrapes always see fresh process gauges
+    fabric = request.app[_FABRIC]
+    fmt_state = request.query.get("format") == "state"
+    cluster = request.query.get("scope") == "cluster"
+    if (fmt_state or cluster) and \
+            not _is_cluster_peer(request, fabric):
+        raise web.HTTPForbidden(
+            text="cluster metrics: loopback or cluster peers only")
+    if fmt_state:
+        return web.json_response({"worker": fabric.worker_id,
+                                  "state": metrics.dump_state()})
+    federation = None
+    registry = metrics
+    if cluster:
+        if _cluster_obs_enabled():
+            registry, federation = await _federated_metrics(request)
+        else:
+            federation = {"disabled": True}
     accept = request.headers.get("Accept", "")
     if "text/plain" in accept or "openmetrics" in accept:
         return web.Response(
-            body=metrics.prometheus().encode(),
+            body=registry.prometheus().encode(),
             headers={"Content-Type":
                      "text/plain; version=0.0.4; charset=utf-8"})
-    return web.json_response(metrics.snapshot())
+    snap = registry.snapshot()
+    if federation is not None:
+        snap["federation"] = federation
+    return web.json_response(snap)
 
 
 async def handle_debugz(request: web.Request) -> web.Response:
@@ -374,13 +608,24 @@ async def handle_debugz(request: web.Request) -> web.Response:
     deadline expiries, reserve rotations, round promotions — in causal
     order (``?n=`` limits, ``?kind=`` filters by kind or ``prefix.``).
 
-    Loopback-only like ``/debug/trace``: an operator surface. Trace
-    spans carry other players' request timings and the event ring
-    exposes internal serving state — not a player-facing page."""
-    if not _is_loopback(request):
-        raise web.HTTPForbidden(text="loopback only")
+    Operator surface, gated to loopback OR cluster members (the peer
+    gate lets `?scope=cluster` fan-outs read each other): trace spans
+    carry other players' request timings and the event ring exposes
+    internal serving state — not a player-facing page.
+
+    ``?trace=<id>&scope=cluster`` merges the trace across the fleet: a
+    request that 307'd between workers leaves its spans split across
+    their per-process rings; the cluster mode fans out to every live
+    member (membership discovery), dedupes by span id, and returns one
+    time-ordered view with a per-peer coverage block — the full story,
+    readable from any worker."""
+    if not _is_cluster_peer(request, request.app[_FABRIC]):
+        raise web.HTTPForbidden(text="loopback or cluster peers only")
     trace_id = request.query.get("trace")
     if trace_id:
+        if request.query.get("scope") == "cluster" and \
+                _cluster_obs_enabled():
+            return await _cluster_trace(request, trace_id)
         spans = tracer.get_trace(trace_id)
         if spans is None:
             raise web.HTTPNotFound(
@@ -400,6 +645,47 @@ async def handle_debugz(request: web.Request) -> web.Response:
         # newest last; each id is fetchable via ?trace=
         "recent_traces": tracer.trace_ids()[-25:],
     })
+
+
+async def _cluster_trace(request: web.Request,
+                         trace_id: str) -> web.Response:
+    """The merged cross-worker trace view behind
+    ``/debugz?trace=<id>&scope=cluster``. Peers answer their LOCAL
+    trace lookup (never another fan-out); a peer without the trace is a
+    ``miss`` (evicted or never sampled there), a dark peer is marked —
+    partial coverage is reported, not hidden."""
+    fabric = request.app[_FABRIC]
+    merged = {s["span_id"]: s
+              for s in (tracer.get_trace(trace_id) or [])}
+    peers = {fabric.worker_id: {"status": "self", "spans": len(merged)}}
+    for worker, row in await _peer_fanout(request, "/debugz",
+                                          {"trace": trace_id}):
+        if row["status"] == "ok":
+            remote = row["data"].get("spans", [])
+            for span in remote:
+                merged.setdefault(span["span_id"], span)
+            peers[worker] = {"status": "ok", "spans": len(remote)}
+        elif row["status"] == "http_404":
+            peers[worker] = {"status": "miss"}
+        else:
+            peers[worker] = row
+    if not merged:
+        raise web.HTTPNotFound(
+            text=f"trace {trace_id!r} not resident on any reachable "
+                 f"worker")
+    spans = sorted(merged.values(), key=lambda s: s["start_ts"])
+    return web.json_response({"trace_id": trace_id, "scope": "cluster",
+                              "spans": spans, "peers": peers})
+
+
+async def handle_sloz(request: web.Request) -> web.Response:
+    """The SLO page: every objective's state (ok/burning), fast/slow
+    burn rates, and targets — evaluated fresh on each hit (internally
+    rate-limited) from the same registry `/metrics` serves. Advisory by
+    design: `/readyz` embeds the same block without gating on it."""
+    engine = request.app[_SLO]
+    engine.evaluate()
+    return web.json_response(engine.status())
 
 
 async def _probe_store(fabric: RoomFabric) -> bool:
@@ -454,6 +740,14 @@ async def handle_readyz(request: web.Request) -> web.Response:
     status["store"] = store_ok
     ready = bool(status["ready"]) and store_ok
     status["ready"] = ready
+    # the SLO block is ADVISORY, never gating: burn rates tell the
+    # operator where the error budget goes; draining a worker stays a
+    # supervisor decision made on direct evidence (obs/slo.py).
+    # Evaluate-on-read (internally rate-limited) so the block stays
+    # live even with the background loop disabled (CASSMANTLE_NO_SLO)
+    engine = request.app[_SLO]
+    engine.evaluate()
+    status["slo"] = engine.status()
     if ready:
         return web.json_response(status)
     status["state"] = "degraded"
@@ -587,6 +881,14 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
     # request time is legal where reassigning an app key is not (aiohttp
     # deprecates, and 4.x forbids, mutating a started app's keys)
     app[_TRACE_STATE] = {"active": False}
+    app[_OBS_CFG] = cfg.obs
+    app[_PEER_HTTP] = {"session": None}
+    app[_OBS_TASKS] = []
+    app[_SLO] = SloEngine(
+        default_objectives(cfg),
+        fast_window_s=cfg.obs.slo_fast_window_s,
+        slow_window_s=cfg.obs.slo_slow_window_s)
+    app[_PROCESS] = ProcessMetrics()
     if device_health:
         from cassmantle_tpu.utils.health import DeviceHealth
 
@@ -601,6 +903,7 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
     app.router.add_get("/clock", handle_clock)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/debugz", handle_debugz)
+    app.router.add_get("/sloz", handle_sloz)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/wordlist", handle_wordlist)
@@ -614,10 +917,37 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
         # (main.py:25-27); all files here are original SVGs
         app.router.add_static("/media", MEDIA_DIR)
 
+    async def _slo_loop(engine: SloEngine, interval_s: float) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            try:
+                engine.evaluate()
+            except Exception:
+                # advisory machinery: an evaluation bug must never take
+                # the loop (or anything else) down with it
+                log.exception("slo evaluation failed; continuing")
+
     async def on_startup(app_: web.Application) -> None:
         await fabric.startup()
+        loop = asyncio.get_running_loop()
+        tasks = app_[_OBS_TASKS]
+        tasks.append(loop.create_task(
+            app_[_PROCESS].run(cfg.obs.process_sample_interval_s)))
+        if not _env_flag_set("CASSMANTLE_NO_SLO"):
+            tasks.append(loop.create_task(
+                _slo_loop(app_[_SLO], cfg.obs.slo_eval_interval_s)))
 
     async def on_cleanup(app_: web.Application) -> None:
+        for task in app_[_OBS_TASKS]:
+            task.cancel()
+        for task in app_[_OBS_TASKS]:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        session = app_[_PEER_HTTP].get("session")
+        if session is not None:
+            await session.close()
         await fabric.shutdown()
 
     app.on_startup.append(on_startup)
@@ -754,9 +1084,12 @@ def build_fabric(cfg: FrameworkConfig, fake: bool = False,
         cfg, fake, weights_dir, supervisor)
 
     def game_factory(room: str, room_store) -> Game:
+        # room= labels the game's engine metric series (game.guesses,
+        # round.generate_s, ...) so N rooms on this worker stay
+        # distinguishable on /metrics (docs/OBSERVABILITY.md)
         return Game(cfg, room_store, backend, embed=embed,
                     similarity=similarity, blur_fn=blur_fn,
-                    supervisor=supervisor)
+                    supervisor=supervisor, room=room)
 
     return RoomFabric(cfg, store, game_factory, worker_id=worker_id,
                       advertise_addr=advertise_addr,

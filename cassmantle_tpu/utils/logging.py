@@ -31,7 +31,7 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 # Latency-shaped default bounds: sub-ms host work through cold-compile
 # minutes. Overridable per-process via ObsConfig.latency_buckets_s
@@ -224,6 +224,84 @@ class Metrics:
         finally:
             self.observe(name, time.perf_counter() - start, labels=labels)
 
+    # -- registry reads (SLO engine, obs/slo.py) ---------------------------
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across ALL its label sets (per-room labels
+        must aggregate to worker truth for SLO ratios)."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
+    def gauge_values(self, name: str) -> List[float]:
+        """Every label set's current value for a gauge (callers pick
+        max/min as the conservative aggregate)."""
+        with self._lock:
+            return [v for (n, _), v in self._gauges.items() if n == name]
+
+    def hist_totals(self, name: str
+                    ) -> Optional[Tuple[Tuple[float, ...],
+                                        Tuple[int, ...], int]]:
+        """(bounds, bucket counts, total) for a histogram, summed across
+        label sets sharing the first-seen bounds (one process = one
+        bucket ladder per name by construction); None when the series
+        has never been observed."""
+        with self._lock:
+            bounds = None
+            counts: List[int] = []
+            total = 0
+            for (n, _), h in self._hists.items():
+                if n != name:
+                    continue
+                if bounds is None:
+                    bounds = h.bounds
+                    counts = list(h.counts)
+                    total = h.total
+                elif h.bounds == bounds:
+                    counts = [a + b for a, b in zip(counts, h.counts)]
+                    total += h.total
+            if bounds is None:
+                return None
+            return bounds, tuple(counts), total
+
+    # -- federation (cluster /metrics, server/app.py) ----------------------
+    def dump_state(self) -> Dict[str, list]:
+        """Full-fidelity JSON-serializable registry state — what a peer
+        ships for cluster federation. Unlike :meth:`snapshot`, histogram
+        BUCKETS survive, so a merge is exact, not re-estimated."""
+        with self._lock:
+            return {
+                "counters": [[k[0], [list(p) for p in k[1]], v]
+                             for k, v in self._counters.items()],
+                "gauges": [[k[0], [list(p) for p in k[1]], v]
+                           for k, v in self._gauges.items()],
+                "hists": [[k[0], [list(p) for p in k[1]],
+                           list(h.bounds), list(h.counts), h.sum, h.total]
+                          for k, h in self._hists.items()],
+            }
+
+    def merge_hist_state(self, name: str, labels: Optional[Dict[str, str]],
+                         bounds: Sequence[float], counts: Sequence[int],
+                         total_sum: float, total: int) -> bool:
+        """Fold one shipped histogram into this registry. Same bounds →
+        bucket counts add elementwise (the EXACT merge — every worker
+        runs the same fixed ladders by construction); returns False on a
+        bounds mismatch so the caller can fall back to a per-worker
+        labeled series instead of silently mis-binning."""
+        bounds = tuple(float(b) for b in bounds)
+        key = _series_key(name, labels)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = Histogram(bounds)
+                self._hists[key] = hist
+            if hist.bounds != bounds:
+                return False
+            hist.counts = [a + int(b)
+                           for a, b in zip(hist.counts, counts)]
+            hist.total += int(total)
+            hist.sum += float(total_sum)
+            return True
+
     # -- exposition -------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
         """The backward-compatible JSON shape: flat counters/gauges plus
@@ -294,6 +372,45 @@ class Metrics:
             lines.append(f"{pname}_sum{suffix} {repr(float(total_sum))}")
             lines.append(f"{pname}_count{suffix} {total}")
         return "\n".join(lines) + "\n"
+
+
+def _parse_labels(raw) -> Optional[Dict[str, str]]:
+    if not raw:
+        return None
+    return {str(k): str(v) for k, v in raw}
+
+
+def merge_states(states: Sequence[Tuple[str, Dict[str, list]]]
+                 ) -> "Metrics":
+    """Fold per-worker :meth:`Metrics.dump_state` payloads into one
+    registry — the cluster view (`/metrics?scope=cluster`):
+
+    - **counters sum** exactly (they are deltas of the same events);
+    - **gauges get a ``worker`` label** — a point-in-time value per
+      process has no meaningful sum, but the per-worker spread is
+      exactly what an operator reads (which worker's loop is lagging);
+    - **histograms merge exactly**: every worker runs the same fixed
+      bucket ladders by construction, so bucket counts add elementwise;
+      a bounds mismatch (a mid-rollout config skew) falls back to a
+      per-worker labeled series rather than mis-binning.
+    """
+    merged = Metrics()
+    for worker, state in states:
+        for name, labels, value in state.get("counters", []):
+            merged.inc(name, value, labels=_parse_labels(labels))
+        for name, labels, value in state.get("gauges", []):
+            lbl = dict(_parse_labels(labels) or {})
+            lbl["worker"] = worker
+            merged.gauge(name, value, labels=lbl)
+        for name, labels, bounds, counts, hsum, total in \
+                state.get("hists", []):
+            if not merged.merge_hist_state(name, _parse_labels(labels),
+                                           bounds, counts, hsum, total):
+                lbl = dict(_parse_labels(labels) or {})
+                lbl["worker"] = worker
+                merged.merge_hist_state(name, lbl, bounds, counts,
+                                        hsum, total)
+    return merged
 
 
 metrics = Metrics()

@@ -64,20 +64,22 @@ FAST_MODULES = frozenset({
     "test_flash_attention", "test_frontend", "test_fused_conv",
     "test_game", "test_js_runtime", "test_layers_norm", "test_masking",
     "test_masking_agreement", "test_multihost",
-    "test_native_store", "test_obs", "test_ops", "test_pipeline",
+    "test_native_store", "test_obs", "test_obs_cluster", "test_ops",
+    "test_pipeline",
     "test_pipeline_parallel", "test_samplers", "test_scoring",
     "test_server", "test_spell", "test_store", "test_store_parity",
     "test_supervisor", "test_utils", "test_weights",
-    # deliberately NOT fast (stay in the default tier): test_mistral,
-    # test_torch_parity, test_spec_decode, and test_stages —
-    # heavyweight parity suites whose coverage the fast smoke doesn't
-    # need twice (test_weights pins the converters; test_pipeline
+    # deliberately NOT fast (stay in the default tier):
+    # test_spec_decode and test_stages — heavyweight parity suites
+    # whose coverage the fast smoke doesn't need twice (test_pipeline
     # smokes the decode path). test_stages compiles three
     # pipeline-sized jits (staged encode/step/decode + the monolithic
     # reference) but MUST stay in tier-1: staged-vs-monolithic
     # bit-parity is an acceptance bar, and the autouse lock sentinel
     # only guards the stage scheduler's lock hierarchy if the module
-    # actually runs in the default sweep.
+    # actually runs in the default sweep. test_spec_decode stays for
+    # the same reason: greedy/spec bit-parity + the jit-sentinel
+    # steady-state assertions are tier-1 acceptance bars (PR 5/7).
 })
 
 SLOW_MODULES = frozenset({
@@ -92,6 +94,22 @@ SLOW_MODULES = frozenset({
     # wall clock that the per-component fast-tier coverage in
     # test_fabric already smoke-tests in-process
     "test_fabric_cluster",
+    # moved to slow at round 14: the default tier outgrew its tier-1
+    # window on a 2-core host (the fabric + cluster-obs suites grew it
+    # past ~900s vs the 870s budget) and was alphabetically truncating
+    # its own tail — exactly what this split exists to prevent. Their
+    # tier-1 coverage is duplicated: test_weights pins every torch
+    # converter; test_spec_decode pins mistral decode_chunk/greedy
+    # parity. Both still run in the full tier (~92s together).
+    "test_torch_parity",  # torch cross-checks of the jax zoo
+    "test_mistral",       # RoPE/GQA/sliding-window reference parity
+    # ~75s of compile-bound distributed LM TRAINING steps — serving-
+    # independent; the multi-device path keeps tier-1 smoke coverage
+    # via test_multihost (fast) and full coverage via test_parallel
+    # (slow). Moved with the round-14 pair above for timing margin:
+    # the default tier was landing within run-to-run variance of the
+    # 870s window (777s pass / ~880s miss on the same tree).
+    "test_lm_train",
 })
 
 

@@ -8,6 +8,7 @@ from tools.check_metrics import (
     check,
     extract_sites,
     load_catalog,
+    load_catalog_types,
 )
 
 
@@ -74,3 +75,51 @@ def test_violations_are_detected():
 
     assert not _SEGMENT.match("UPPER")
     assert _SEGMENT.match("lower_case_1")
+
+
+def test_extractor_covers_injected_registry_receivers():
+    """Modules taking the registry by injection (obs/slo.py,
+    obs/process.py use ``self._registry``) must lint like direct
+    ``metrics.`` emitters — the receiver rule is name-shaped, not
+    import-shaped."""
+    sites = extract_sites(
+        "self._registry.gauge('slo.burning', 1.0)\n"
+        "registry.inc('a.b')\n"
+        "cluster_metrics.gauge('federation.peer_up', 1.0)\n"
+        "unrelated.gauge('not.linted', 1.0)\n",
+        "<t>")
+    assert ("slo.burning", "gauge", 1) in sites
+    assert ("a.b", "inc", 2) in sites
+    assert ("federation.peer_up", "gauge", 3) in sites
+    assert not any(name == "not.linted" for name, _, _ in sites)
+
+
+def test_catalog_types_parsed_from_tables():
+    types = load_catalog_types()
+    assert types["http.init"] == "counter"
+    assert types["round.remaining_s"] == "gauge"
+    assert types["http.compute_score_s"] == "histogram"
+    assert types["slo.burning"] == "gauge"
+    # prose mentions outside typed table rows carry no type
+    assert "slo.burn" not in types
+
+
+def test_type_drift_is_a_lint_error():
+    """An emission site whose call kind contradicts the catalog row's
+    declared type (a counter quietly emitted as a gauge) fails the
+    lint instead of shipping a broken exposition shape."""
+    from cassmantle_tpu.analysis.core import parse_source, run_passes
+    from cassmantle_tpu.analysis.metric_names import MetricNamePass
+
+    drift = parse_source("metrics.inc('http.compute_score_s')\n", "<t>")
+    findings = run_passes([drift], [MetricNamePass()])
+    assert len(findings) == 1 and "type drift" in findings[0].message
+    drift2 = parse_source("metrics.gauge('http.init', 1.0)\n", "<t>")
+    assert any("type drift" in f.message
+               for f in run_passes([drift2], [MetricNamePass()]))
+    # the matching kind is clean; wildcard sites need only ONE matching
+    # typed row of the right kind
+    ok = parse_source(
+        "metrics.observe('http.compute_score_s', 1.0)\n"
+        "metrics.inc(f'{self.name}.batches')\n", "<t>")
+    assert run_passes([ok], [MetricNamePass()]) == []

@@ -1,0 +1,705 @@
+"""Cluster observability tests (ISSUE 9, fast tier).
+
+Covers the three tentpole legs and their acceptance bars:
+
+- **cross-worker trace propagation**: traceparent format/parse, remote-
+  parent span continuation, the peer/kill-switch gate, and the
+  two-in-process-worker e2e — a room request redirected across workers
+  yields ONE trace id whose merged ``/debugz?trace=&scope=cluster``
+  view contains both workers' spans (http hop → queue-wait → device
+  stage);
+- **metrics federation**: the shard-merge exactness property (merge of
+  per-worker snapshots == single-registry ground truth, histogram
+  buckets included), the bounds-mismatch fallback, and the e2e
+  ``/metrics?scope=cluster`` totals == sum of per-worker registries,
+  with stale/dead peers marked;
+- **SLO burn-rate engine**: state-machine units with an injectable
+  clock (trip on the fast window, recover on the slow), and the e2e —
+  an injected latency burst flips an ``/sloz`` objective to burning,
+  ``slo.burn`` lands in the flight recorder, then recovers.
+
+Plus the satellites: process self-metrics, per-room metric labels
+(asserted through the two-worker fabric), and the bench counter-delta
+helper.
+"""
+
+import asyncio
+import dataclasses
+import json
+import math
+import random
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.engine.content import (
+    FakeContentBackend,
+    hash_embed,
+    hash_similarity,
+)
+from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.engine.store import MemoryStore
+from cassmantle_tpu.fabric.rooms import RoomFabric
+from cassmantle_tpu.obs.recorder import FlightRecorder, flight_recorder
+from cassmantle_tpu.obs.slo import Objective, SloEngine, _latency_good
+from cassmantle_tpu.obs.trace import (
+    format_traceparent,
+    parse_traceparent,
+    tracer,
+)
+from cassmantle_tpu.utils.logging import Metrics, merge_states, metrics
+
+
+def make_cfg(num_rooms=1, **obs_kw):
+    cfg = _tiny_config()
+    return cfg.replace(
+        game=dataclasses.replace(
+            cfg.game, rate_limit_default=1e6, rate_limit_api=1e6,
+            time_per_prompt=30.0),
+        fabric=dataclasses.replace(
+            cfg.fabric, num_rooms=num_rooms, heartbeat_s=30.0),
+        obs=dataclasses.replace(
+            cfg.obs, slo_eval_interval_s=300.0,
+            process_sample_interval_s=60.0,
+            cluster_fanout_timeout_s=1.0, **obs_kw),
+    )
+
+
+# -- traceparent wire format -----------------------------------------------
+
+def test_traceparent_roundtrip_and_rejects():
+    ctx = tracer.new_root_ctx()
+    parsed = parse_traceparent(format_traceparent(ctx))
+    assert (parsed.trace_id, parsed.span_id, parsed.sampled) == \
+        (ctx.trace_id, ctx.span_id, ctx.sampled)
+    unsampled = tracer.detached_ctx()
+    assert format_traceparent(unsampled).endswith("-00")
+    assert parse_traceparent(format_traceparent(unsampled)).sampled \
+        is False
+    # malformed input is dropped, never a fresh context
+    for bad in (None, "", "garbage", "00-short-span-01",
+                "01-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                "00-" + "g" * 32 + "-" + "b" * 16 + "-01"):
+        assert parse_traceparent(bad) is None
+    # marks are fresh per hop: request-local state never crosses
+    ctx.marks["queue_wait_s"] = 1.0
+    assert parse_traceparent(format_traceparent(ctx)).marks == {}
+
+
+def test_span_continues_remote_parent():
+    from cassmantle_tpu.obs.trace import Tracer
+
+    tr = Tracer(capacity=8)
+    remote = tr.new_root_ctx()
+    with tr.span("b.hop", parent=remote) as h:
+        assert h.trace_id == remote.trace_id
+        with tr.span("b.child") as c:
+            assert c.trace_id == remote.trace_id
+    spans = {s["name"]: s for s in tr.get_trace(remote.trace_id)}
+    assert spans["b.hop"]["parent_id"] == remote.span_id
+    assert spans["b.child"]["parent_id"] == spans["b.hop"]["span_id"]
+    # an unsampled remote context propagates ids but records nothing
+    dark = parse_traceparent(
+        format_traceparent(tr.detached_ctx()))
+    with tr.span("b.dark", parent=dark) as h:
+        assert h.trace_id == dark.trace_id
+    assert tr.get_trace(dark.trace_id) is None
+
+
+# -- registry read helpers + federation merge ------------------------------
+
+def _metric_of(line: str) -> str:
+    """The bare metric name of a Prometheus exposition line (strips
+    labels and the value)."""
+    return line.split(" ")[0].split("{")[0]
+
+def test_registry_read_helpers_aggregate_labels():
+    m = Metrics()
+    m.inc("h.n", 2, labels={"room": "a"})
+    m.inc("h.n", 3)
+    assert m.counter_total("h.n") == 5
+    assert m.counter_total("absent.name") == 0
+    m.gauge("h.v", 1.0, labels={"w": "1"})
+    m.gauge("h.v", 7.0)
+    assert max(m.gauge_values("h.v")) == 7.0
+    assert m.gauge_values("absent.name") == []
+    m.observe("h.l_s", 0.05, labels={"room": "a"}, buckets=(0.1, 1.0))
+    m.observe("h.l_s", 0.5, buckets=(0.1, 1.0))
+    bounds, counts, total = m.hist_totals("h.l_s")
+    assert bounds == (0.1, 1.0)
+    assert counts == (1, 1, 0)
+    assert total == 2
+    assert m.hist_totals("absent.name") is None
+
+
+def test_merge_states_matches_single_registry_ground_truth():
+    """The exactness property: per-worker shard registries merged ==
+    one registry that saw every event — counters AND histogram buckets
+    (bucket counts are integers; merge must be exact, not a percentile
+    re-estimate). States round-trip through JSON like the real wire."""
+    rng = random.Random(7)
+    bounds = (0.01, 0.1, 1.0)
+    ground = Metrics(default_buckets=bounds)
+    shards = [Metrics(default_buckets=bounds) for _ in range(3)]
+    for _ in range(400):
+        shard = rng.choice(shards)
+        if rng.random() < 0.5:
+            name = rng.choice(["a.hits", "b.misses"])
+            labels = ({"room": rng.choice(["r1", "r2"])}
+                      if rng.random() < 0.5 else None)
+            v = rng.randint(1, 5)
+            shard.inc(name, v, labels=labels)
+            ground.inc(name, v, labels=labels)
+        else:
+            name = rng.choice(["a.lat_s", "b.wait_s"])
+            v = rng.random() * 2.0
+            shard.observe(name, v)
+            ground.observe(name, v)
+    states = [(f"w{i}", json.loads(json.dumps(s.dump_state())))
+              for i, s in enumerate(shards)]
+    merged = merge_states(states)
+    assert merged.snapshot()["counters"] == \
+        ground.snapshot()["counters"]
+
+    def hist_lines(m):
+        return [line for line in m.prometheus().splitlines()
+                if "_bucket{" in line
+                or _metric_of(line).endswith("_count")]
+
+    assert hist_lines(merged) == hist_lines(ground)
+    # _sum is a float reduction whose addition ORDER differs between
+    # the shard path and the ground path — equal to fp tolerance
+    for name in ("a.lat_s", "b.wait_s"):
+        hm = merged.hist_totals(name)
+        hg = ground.hist_totals(name)
+        assert hm[1] == hg[1] and hm[2] == hg[2]
+    mt = merged.snapshot()["timings"]
+    gt = ground.snapshot()["timings"]
+    for name in mt:
+        assert math.isclose(mt[name]["mean_s"], gt[name]["mean_s"],
+                            rel_tol=1e-9)
+
+
+def test_merge_states_gauges_labeled_and_bounds_mismatch_falls_back():
+    a = Metrics()
+    a.gauge("x.depth", 3.0)
+    a.observe("x.lat_s", 0.5, buckets=(0.1, 1.0))
+    b = Metrics()
+    b.gauge("x.depth", 5.0)
+    b.observe("x.lat_s", 0.5, buckets=(0.2, 2.0))   # skewed ladder
+    merged = merge_states([("wa", a.dump_state()),
+                           ("wb", b.dump_state())])
+    snap = merged.snapshot()
+    # gauges: per-worker spread, never a meaningless sum
+    assert snap["gauges"]['x.depth{worker="wa"}'] == 3.0
+    assert snap["gauges"]['x.depth{worker="wb"}'] == 5.0
+    # mismatched bounds: wb's series survives worker-labeled instead of
+    # being mis-binned into wa's ladder
+    assert snap["timings"]["x.lat_s"]["count"] == 1
+    assert snap["timings"]['x.lat_s{worker="wb"}']["count"] == 1
+
+
+# -- SLO engine units (injectable clock) -----------------------------------
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+
+def test_latency_good_bucket_math():
+    bounds = (0.1, 1.0, 10.0)
+    counts = (4, 3, 2, 1)          # last = +Inf overflow
+    assert _latency_good(bounds, counts, 0.1) == 4
+    assert _latency_good(bounds, counts, 0.5) == 7   # next bound up
+    assert _latency_good(bounds, counts, 10.0) == 9
+    assert _latency_good(bounds, counts, 99.0) == 9  # all but overflow
+
+
+def test_slo_latency_trips_on_fast_window_recovers_on_slow():
+    reg = Metrics()
+    rec = FlightRecorder(capacity=64)
+    clock = FakeClock()
+    obj = Objective(name="lat", kind="latency", metric="svc.req_s",
+                    threshold_s=0.1, objective_ratio=0.9)
+    eng = SloEngine([obj], registry=reg, recorder=rec,
+                    fast_window_s=60.0, slow_window_s=600.0,
+                    clock=clock.now, min_eval_gap_s=0.0)
+    buckets = (0.1, 1.0)
+    # healthy traffic: no burn
+    for _ in range(100):
+        reg.observe("svc.req_s", 0.01, buckets=buckets)
+    clock.t = 10.0
+    out = eng.evaluate()
+    assert out["lat"]["state"] == "ok"
+    assert out["lat"]["fast_burn"] == 0.0
+    # a latency burst blows the fast window -> burning + slo.burn event
+    for _ in range(50):
+        reg.observe("svc.req_s", 5.0, buckets=buckets)
+    clock.t = 20.0
+    out = eng.evaluate()
+    assert out["lat"]["state"] == "burning"
+    assert out["lat"]["fast_burn"] > 1.0
+    assert [e["kind"] for e in rec.tail(kind="slo.")] == ["slo.burn"]
+    assert reg.gauge_values("slo.burning") == [1.0]
+    # fast window drains but the slow window still holds the burst:
+    # STILL burning (recovery is slow-window gated)
+    for _ in range(20):
+        reg.observe("svc.req_s", 0.01, buckets=buckets)
+    clock.t = 90.0
+    out = eng.evaluate()
+    assert out["lat"]["fast_burn"] <= 1.0
+    assert out["lat"]["slow_burn"] > 1.0
+    assert out["lat"]["state"] == "burning"
+    # past the slow window: only healthy deltas remain -> recovered
+    for _ in range(100):
+        reg.observe("svc.req_s", 0.01, buckets=buckets)
+    clock.t = 700.0
+    out = eng.evaluate()
+    assert out["lat"]["state"] == "ok"
+    assert [e["kind"] for e in rec.tail(kind="slo.")] == \
+        ["slo.burn", "slo.recovered"]
+
+
+def test_slo_ratio_objective_sums_labeled_counters():
+    reg = Metrics()
+    rec = FlightRecorder(capacity=16)
+    clock = FakeClock()
+    obj = Objective(name="gen", kind="ratio", good=("x.ok",),
+                    bad=("x.err",), objective_ratio=0.5)
+    eng = SloEngine([obj], registry=reg, recorder=rec,
+                    fast_window_s=60.0, slow_window_s=120.0,
+                    clock=clock.now, min_eval_gap_s=0.0)
+    # per-room labels must aggregate to worker truth
+    reg.inc("x.ok", 8, labels={"room": "a"})
+    reg.inc("x.ok", 2, labels={"room": "b"})
+    clock.t = 10.0
+    assert eng.evaluate()["gen"]["state"] == "ok"
+    reg.inc("x.err", 30)
+    clock.t = 20.0
+    out = eng.evaluate()
+    assert out["gen"]["state"] == "burning"
+    assert out["gen"]["fast_burn"] > 1.0
+
+
+def test_slo_gauge_objective_and_no_traffic():
+    reg = Metrics()
+    rec = FlightRecorder(capacity=16)
+    clock = FakeClock()
+    objs = [Objective(name="lag", kind="gauge", metric="x.lag",
+                      bound=10.0),
+            Objective(name="quiet", kind="ratio", good=("q.ok",),
+                      bad=("q.err",), objective_ratio=0.99)]
+    eng = SloEngine(objs, registry=reg, recorder=rec,
+                    fast_window_s=60.0, slow_window_s=120.0,
+                    clock=clock.now, min_eval_gap_s=0.0)
+    clock.t = 1.0
+    out = eng.evaluate()
+    # absent gauge / zero traffic = no burn, never a false trip
+    assert out["lag"]["state"] == "ok"
+    assert out["quiet"]["fast_burn"] == 0.0
+    reg.gauge("x.lag", 20.0, labels={"store": "a"})
+    reg.gauge("x.lag", 3.0, labels={"store": "b"})   # max() wins
+    clock.t = 2.0
+    out = eng.evaluate()
+    assert out["lag"]["state"] == "burning"
+    assert out["lag"]["fast_burn"] == 2.0
+    reg.gauge("x.lag", 3.0, labels={"store": "a"})
+    clock.t = 3.0
+    assert eng.evaluate()["lag"]["state"] == "ok"
+
+
+def test_slo_eval_gap_rate_limits_scrapes():
+    reg = Metrics()
+    clock = FakeClock()
+    eng = SloEngine([Objective(name="g", kind="gauge", metric="x.g",
+                               bound=1.0)],
+                    registry=reg, recorder=FlightRecorder(capacity=4),
+                    fast_window_s=60.0, slow_window_s=120.0,
+                    clock=clock.now, min_eval_gap_s=5.0)
+    clock.t = 1.0
+    eng.evaluate()
+    first = reg.counter_total("slo.evals")
+    clock.t = 2.0
+    eng.evaluate()                       # inside the gap: cached
+    assert reg.counter_total("slo.evals") == first
+    clock.t = 7.0
+    eng.evaluate()
+    assert reg.counter_total("slo.evals") == first + 1
+
+
+# -- process self-metrics --------------------------------------------------
+
+def test_process_metrics_sample():
+    from cassmantle_tpu.obs.process import ProcessMetrics
+
+    reg = Metrics()
+    clock = FakeClock(100.0)
+    proc = ProcessMetrics(registry=reg, clock=clock.now)
+    clock.t = 105.0
+    proc.sample()
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["process.uptime_s"] == 5.0
+    assert gauges["process.rss_bytes"] > 1e6      # a real python process
+    assert gauges["process.cpu_s"] > 0.0
+
+
+# -- bench counter deltas --------------------------------------------------
+
+def test_bench_counter_deltas_select_diagnosis_counters():
+    import bench
+
+    before = {"jit.recompiles": 1.0, "scorer.embed_cache_hits": 5.0,
+              "http.init": 3.0}
+    after = {"jit.recompiles": 4.0, "scorer.embed_cache_hits": 5.0,
+             "http.init": 9.0, "score.dispatch_hangs": 2.0,
+             'stage.denoise.preemptions': 1.0,
+             'game.guesses{room="r"}': 7.0}
+    deltas = bench._counter_deltas(before, after)
+    # unchanged and non-diagnosis counters (http.init, game.guesses)
+    # stay out; new diagnosis counters count from zero
+    assert deltas == {"jit.recompiles": 3, "score.dispatch_hangs": 2,
+                      "stage.denoise.preemptions": 1}
+
+
+# -- e2e: SLO through /sloz, /readyz, /debugz ------------------------------
+
+@pytest.mark.asyncio
+async def test_latency_burst_flips_sloz_and_recorder_then_recovers():
+    """Acceptance: an injected latency burst flips the /sloz
+    score_latency objective to burning, slo.burn lands in the flight
+    recorder (readable at /debugz), /readyz carries the advisory block
+    without gating on it — then the burst drains and it recovers."""
+    from cassmantle_tpu.server.app import create_app
+
+    cfg = make_cfg(slo_fast_window_s=0.3, slo_slow_window_s=0.8,
+                   slo_score_p99_s=0.05)
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity)
+    app = create_app(game, cfg, start_timer=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        watermark = flight_recorder.stats()["total_recorded"]
+        res = await client.get("/sloz")
+        body = await res.json()
+        assert body["objectives"]["score_latency"]["state"] == "ok"
+        assert body["windows"]["fast_s"] == 0.3
+        # the injected burst: 40 requests at 1s against a 50ms target
+        for _ in range(40):
+            metrics.observe("http.compute_score_s", 1.0)
+        # step past the engine's scrape-rate-limit gap (fast_window/10)
+        await asyncio.sleep(0.05)
+        res = await client.get("/sloz")
+        body = await res.json()
+        assert body["objectives"]["score_latency"]["state"] == "burning"
+        assert "score_latency" in body["burning"]
+        # /readyz carries the block but stays 200 (advisory, not gating)
+        res = await client.get("/readyz")
+        assert res.status == 200
+        assert (await res.json())["slo"]["burning"] == ["score_latency"]
+        # the burn event is in the flight recorder, visible at /debugz
+        dbg = await client.get("/debugz?kind=slo.")
+        events = [e for e in (await dbg.json())["events"]
+                  if e["seq"] > watermark]
+        assert [e["kind"] for e in events] == ["slo.burn"]
+        assert events[0]["objective"] == "score_latency"
+        # drain past the slow window with healthy traffic -> recovered.
+        # /readyz is read FIRST: its advisory block must evaluate on
+        # read (rate-limited), so it stays live even when the
+        # background loop is off (CASSMANTLE_NO_SLO) — a frozen
+        # first-ever verdict would still say burning here
+        await asyncio.sleep(0.9)
+        for _ in range(100):
+            metrics.observe("http.compute_score_s", 0.001)
+        res = await client.get("/readyz")
+        assert (await res.json())["slo"]["burning"] == []
+        res = await client.get("/sloz")
+        assert (await res.json())["objectives"]["score_latency"][
+            "state"] == "ok"
+        dbg = await client.get("/debugz?kind=slo.")
+        kinds = [e["kind"] for e in (await dbg.json())["events"]
+                 if e["seq"] > watermark]
+        assert kinds == ["slo.burn", "slo.recovered"]
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_inbound_traceparent_gate(monkeypatch):
+    """A loopback-presented traceparent continues the trace; with the
+    cluster-obs kill switch set it is ignored (fresh trace); malformed
+    input never joins anything."""
+    from cassmantle_tpu.server.app import create_app
+
+    cfg = make_cfg()
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity)
+    app = create_app(game, cfg, start_timer=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        ctx = tracer.new_root_ctx()
+        tp = format_traceparent(ctx)
+        res = await client.get("/client/status",
+                               params={"traceparent": tp})
+        assert res.headers["X-Trace-Id"] == ctx.trace_id
+        # header form too (the peer fan-out channel)
+        ctx2 = tracer.new_root_ctx()
+        res = await client.get(
+            "/client/status",
+            headers={"traceparent": format_traceparent(ctx2)})
+        assert res.headers["X-Trace-Id"] == ctx2.trace_id
+        # malformed: dropped, a fresh trace is minted
+        res = await client.get("/client/status",
+                               params={"traceparent": "garbage"})
+        assert res.headers["X-Trace-Id"] not in (ctx.trace_id,
+                                                 ctx2.trace_id)
+        # kill switch: the same valid context is ignored
+        monkeypatch.setenv("CASSMANTLE_NO_CLUSTER_OBS", "1")
+        res = await client.get("/client/status",
+                               params={"traceparent": tp})
+        assert res.headers["X-Trace-Id"] != ctx.trace_id
+    finally:
+        await client.close()
+
+
+@pytest.mark.asyncio
+async def test_cluster_secret_legs_for_external_bearers(monkeypatch):
+    """The redirect channel is carried back by the UNTRUSTED player:
+    with every source-based leg off (loopback patched away), a
+    traceparent is honored only with a valid ``tracesig`` under the
+    store-distributed cluster secret, /debugz and cluster /metrics
+    admit only the ``X-Cluster-Auth`` token, and forgeries fail."""
+    from cassmantle_tpu.server import app as app_mod
+    from cassmantle_tpu.server.app import create_app
+
+    cfg = make_cfg()
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity)
+    app = create_app(game, cfg, start_timer=False)
+    fabric = app[app_mod._FABRIC]
+    await fabric._ensure_cluster_key()
+    assert fabric._cluster_key
+    # a second fabric over the same store derives the SAME secret (the
+    # boot race converges on whichever write won)
+    other = RoomFabric(cfg, game.store, lambda r, s: game,
+                       worker_id="w2", heartbeat=False)
+    await other._ensure_cluster_key()
+    assert other.cluster_token() == fabric.cluster_token()
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    # simulate an external (non-loopback, non-member) bearer
+    monkeypatch.setattr(app_mod, "_is_loopback", lambda request: False)
+    try:
+        ctx = tracer.new_root_ctx()
+        tp = format_traceparent(ctx)
+        # bare context from an outsider: rejected
+        res = await client.get("/client/status",
+                               params={"traceparent": tp})
+        assert res.headers["X-Trace-Id"] != ctx.trace_id
+        # forged signature: rejected
+        res = await client.get(
+            "/client/status",
+            params={"traceparent": tp, "tracesig": "0" * 32})
+        assert res.headers["X-Trace-Id"] != ctx.trace_id
+        # the signature a redirecting worker mints: honored
+        res = await client.get(
+            "/client/status",
+            params={"traceparent": tp,
+                    "tracesig": fabric.sign_trace(tp)})
+        assert res.headers["X-Trace-Id"] == ctx.trace_id
+        # an OTel-style client auto-injecting its OWN traceparent
+        # header must not shadow the signed query context the redirect
+        # pinned — the channels are judged independently
+        minted = format_traceparent(tracer.new_root_ctx())
+        res = await client.get(
+            "/client/status",
+            params={"traceparent": tp,
+                    "tracesig": fabric.sign_trace(tp)},
+            headers={"traceparent": minted})
+        assert res.headers["X-Trace-Id"] == ctx.trace_id
+        # operator/cluster surfaces: refused without the token,
+        # admitted with it
+        for path in ("/debugz", "/metrics?format=state",
+                     "/metrics?scope=cluster"):
+            res = await client.get(path)
+            assert res.status == 403, path
+            res = await client.get(
+                path, headers={"X-Cluster-Auth": "not-the-token"})
+            assert res.status == 403, path
+            res = await client.get(
+                path,
+                headers={"X-Cluster-Auth": fabric.cluster_token()})
+            assert res.status == 200, path
+    finally:
+        await client.close()
+
+
+# -- e2e acceptance: two in-process fabric workers -------------------------
+
+async def _start_worker(cfg, store, worker_id, service):
+    """One fabric worker on a real socket: its own supervisor and
+    membership identity, sharing the store (the cluster's coordination
+    plane) and the serving stack (this is one process)."""
+    from cassmantle_tpu.server.app import create_app
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    sup = ServingSupervisor()
+
+    def factory(room, room_store):
+        return Game(cfg, room_store, service.content_backend,
+                    embed=service.embed, similarity=service.similarity,
+                    supervisor=sup, room=room)
+
+    fabric = RoomFabric(cfg, store, factory, worker_id=worker_id,
+                        start_timers=False, heartbeat=True,
+                        supervisor=sup)
+    server = TestServer(create_app(fabric, cfg, start_timer=False))
+    await server.start_server()
+    url = f"http://127.0.0.1:{server.port}"
+    fabric.membership.addr = url
+    return server, fabric, url
+
+
+async def _sync_membership(fabrics):
+    for f in fabrics:
+        await f.membership.heartbeat(len(f._games))
+    for f in fabrics:
+        live = await f.membership.refresh()
+        await f._handle_moves(f._apply_membership(live))
+
+
+@pytest.mark.asyncio
+async def test_two_workers_one_trace_and_exact_federation():
+    """The ISSUE 9 acceptance path, in-process: a room request
+    redirected across workers yields ONE trace id whose merged
+    /debugz?trace=&scope=cluster view spans both workers (http hop →
+    queue-wait → device stage); /metrics?scope=cluster counter totals
+    equal the sum of the per-worker registry states exactly, histogram
+    buckets included; per-room labels and stale/dead peer marking ride
+    along. (Both workers share this process's global registry/tracer —
+    the federation math is what's under test, and 'sum of per-worker
+    registries' holds exactly either way.)"""
+    import aiohttp
+
+    from cassmantle_tpu.serving.service import InferenceService
+
+    cfg = make_cfg(num_rooms=8)
+    store = MemoryStore()
+    service = InferenceService(
+        cfg, backend=FakeContentBackend(image_size=32))
+    server_a, fabric_a, url_a = await _start_worker(
+        cfg, store, "w-a", service)
+    server_b, fabric_b, url_b = await _start_worker(
+        cfg, store, "w-b", service)
+    http = aiohttp.ClientSession()
+    try:
+        await _sync_membership([fabric_a, fabric_b])
+        placement = fabric_a.directory.placement()
+        b_rooms = [r for r, w in placement.items() if w == "w-b"]
+        assert b_rooms, "8 rooms over 2 workers: w-b must own some"
+        room = b_rooms[0]
+        q = f"?room={room}&session=s-hop"
+
+        # the 307 pins room+session+traceparent+tracesig on the
+        # Location (the signature is what lets an external bearer's
+        # follow-up keep the trace)
+        res = await http.get(url_a + "/fetch/contents" + q,
+                             allow_redirects=False)
+        assert res.status == 307
+        loc = res.headers["Location"]
+        assert loc.startswith(url_b) and "traceparent=00-" in loc
+        assert "tracesig=" in loc
+
+        # follow the hop for real: contents, then a scored guess
+        res = await http.get(url_a + "/fetch/contents" + q)
+        assert res.status == 200 and str(res.url).startswith(url_b)
+        mask = (await res.json())["prompt"]["masks"][0]
+        res = await http.post(url_a + "/compute_score" + q,
+                              json={"inputs": {str(mask): "storm"}})
+        assert res.status == 200 and str(res.url).startswith(url_b)
+        trace_id = res.headers["X-Trace-Id"]
+
+        # ONE trace id across the hop: the merged cluster view holds
+        # both workers' http spans (parent-linked) down to the
+        # device-synchronized scorer stage
+        dbg = await http.get(
+            url_a + f"/debugz?trace={trace_id}&scope=cluster")
+        assert dbg.status == 200
+        data = await dbg.json()
+        assert data["scope"] == "cluster"
+        assert data["peers"]["w-a"]["status"] == "self"
+        assert data["peers"]["w-b"]["status"] == "ok"
+        spans = data["spans"]
+        assert all(s["trace_id"] == trace_id for s in spans)
+        hops = {s["attrs"]["worker"]: s for s in spans
+                if s["name"] == "http.post /compute_score"}
+        assert set(hops) == {"w-a", "w-b"}
+        assert hops["w-a"]["attrs"]["status"] == 307
+        assert hops["w-b"]["attrs"]["status"] == 200
+        assert hops["w-b"]["parent_id"] == hops["w-a"]["span_id"]
+        names = {s["name"] for s in spans}
+        assert {"game.score", "score.queue_wait",
+                "score.batch_service"} <= names
+        stage = [s for s in spans if s["name"] == "scorer.encode_s"]
+        assert stage and stage[0]["attrs"]["device_synced"] is True
+
+        # per-room labels: the scored guess and the room's generation
+        # carry room= labels in the registry
+        snap = await (await http.get(url_a + "/metrics")).json()
+        assert snap["counters"][f'game.guesses{{room="{room}"}}'] >= 1
+        assert f'round.generate_s{{room="{room}"}}' in snap["timings"]
+
+        # federation exactness: cluster totals == sum of the per-worker
+        # registry states, histogram buckets included
+        sa = await (await http.get(
+            url_a + "/metrics?format=state")).json()
+        sb = await (await http.get(
+            url_b + "/metrics?format=state")).json()
+        assert sa["worker"] == "w-a" and sb["worker"] == "w-b"
+        res = await http.get(url_a + "/metrics?scope=cluster",
+                             headers={"Accept": "text/plain"})
+        got = await res.text()
+        expected = merge_states([("w-a", sa["state"]),
+                                 ("w-b", sb["state"])]).prometheus()
+
+        def exact_lines(text):
+            return sorted(
+                line for line in text.splitlines()
+                if not line.startswith("#")
+                and (_metric_of(line).endswith(
+                        ("_total", "_count", "_sum"))
+                     or "_bucket{" in line))
+
+        assert exact_lines(got) == exact_lines(expected)
+        assert 'cassmantle_federation_peer_up{worker="w-b"} 1' in got
+
+        # stale and dark peers are MARKED, never silently dropped
+        await store.hset(
+            "fabric:workers", "w-stale",
+            json.dumps({"addr": "http://127.0.0.1:1", "rooms": 0,
+                        "t": time.time() - 9999}))
+        await store.hset(
+            "fabric:workers", "w-dark",
+            json.dumps({"addr": "http://127.0.0.1:9", "rooms": 0,
+                        "t": time.time()}))
+        snap = await (await http.get(
+            url_a + "/metrics?scope=cluster")).json()
+        fed = snap["federation"]
+        assert fed["w-a"]["status"] == "self"
+        assert fed["w-b"]["status"] == "ok"
+        assert fed["w-stale"]["status"] == "stale"
+        assert fed["w-dark"]["status"] == "error"
+        assert snap["gauges"]['federation.peer_up{worker="w-dark"}'] \
+            == 0.0
+        assert snap["gauges"]['federation.peer_up{worker="w-b"}'] == 1.0
+    finally:
+        await http.close()
+        await server_a.close()
+        await server_b.close()

@@ -28,6 +28,7 @@ from cassmantle_tpu.analysis.metric_names import (  # noqa: E402,F401
     check,
     extract_sites,
     load_catalog,
+    load_catalog_types,
     main,
 )
 
