@@ -109,6 +109,24 @@ class VAEConfig:
     # bf16 compute (fp32 GroupNorm statistics via GroupNorm32): the decode
     # is a one-shot memory-bound pass; bf16 halves its HBM traffic.
     dtype: str = "bfloat16"
+    # Fused GroupNorm+SiLU+conv3x3 Pallas path for the VAE ResBlock
+    # pairs (ops/fused_conv.py — the same kernel, return_affine +
+    # Conv3x3Params trick, and CASSMANTLE_NO_FUSED_CONV kill switch the
+    # UNet ResBlocks use): the cost table prices VAE decode at 10.47 TF
+    # per SDXL image and, like the UNet's, each of its norm→act→conv
+    # sequences otherwise round-trips the level activation through HBM.
+    # Param tree/checkpoint layout unchanged (parity-pinned,
+    # tests/test_encprop.py). VAE channels (128/256/512) are already
+    # 128-lane aligned, so no conv_pad_to analogue is needed.
+    fused_conv: bool = False
+
+    def arch(self) -> "VAEConfig":
+        """This config with execution-strategy flags cleared — the
+        ARCHITECTURE identity (param tree + numerics), mirroring
+        UNetConfig.arch(): ``fused_conv`` changes how the decode
+        executes, never what the tree is. Used for param cache keys and
+        ``share_params_with`` compatibility."""
+        return dataclasses.replace(self, fused_conv=False)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -225,6 +243,29 @@ class SamplerConfig:
     # pairs, the shallow pass reusing the previous step's deepest-level
     # activations (~60% of full compute; ddim only, even num_steps).
     deepcache: bool = False
+    # Encoder propagation (Faster Diffusion, PAPERS.md): run the full
+    # UNet only at key steps; in between, reuse the key step's encoder
+    # features (skip stack + mid output) and run ONLY the decoder —
+    # batched across each segment's propagated steps in one forward,
+    # since the decoder never reads x_t (ops/ddim.py, models/unet.py
+    # ``return_skips``/``skips_cache``). Composes with ``deepcache``
+    # (deep-cache refreshes happen exactly at encoder key steps) and
+    # with every deterministic sampler kind; eta>0 is rejected and the
+    # staged denoise path falls back to monolithic.
+    # CASSMANTLE_NO_ENCPROP=1 is the runtime kill switch (docs/DEPLOY.md
+    # §6). Quality is gated by eval/clip_parity.py::encprop_quality_report
+    # (stride 1 is exact full-forward parity by construction).
+    encprop: bool = False
+    # Key-step cadence: one full forward every ``encprop_stride`` steps
+    # after the dense prefix. Stride 1 = full forward every step
+    # (bit-identical to the plain sampler).
+    encprop_stride: int = 3
+    # Leading steps that are ALL key steps — encoder features drift
+    # fastest early in sampling (Faster Diffusion's non-uniform key
+    # schedule), so keys are denser there. With the 50-step default and
+    # stride 3 this yields 20 encoder forwards per trajectory (the
+    # encoder is skipped on 60% of steps).
+    encprop_dense_steps: int = 5
     # Text decode (reference decodes 32-96 new tokens, backend.py:250-255;
     # its hosted call samples greedily — temperature 0 is reference
     # parity, >0 enables top-k Gumbel sampling for story variety).
@@ -470,6 +511,9 @@ class QualityGateConfig:
         ("deepcache", 0.97),
         ("turbo", 0.95),
         ("int8", 0.98),
+        # encoder propagation reuses key-step encoder features on 60%
+        # of steps; like deepcache it claims near-anchor quality
+        ("encprop", 0.95),
     )
     # absolute floor for the anchor itself: catches a pipeline bug that
     # degrades every preset uniformly (ratios would all still pass)
@@ -582,6 +626,28 @@ def staged_serving_config() -> FrameworkConfig:
     is the runtime kill switch."""
 
     return FrameworkConfig(serving=ServingConfig(staged_serving=True))
+
+
+def encprop_serving_config() -> FrameworkConfig:
+    """DDIM-50 with encoder propagation AND the decode-side kernels on:
+    full UNet forwards only at the 20 key steps of the default schedule
+    (5 dense + every 3rd), decoder-only forwards — batched per segment
+    — on the other 30, plus fused GroupNorm+SiLU+conv3x3 VAE ResBlocks.
+    This is the ON arm of the `sd15_encprop` bench A/B; the SDXL arm
+    (`sdxl_encprop`) applies the same sampler/vae replaces to
+    sdxl_config(), where the encoder (down+mid, 43% of UNet FLOPs —
+    much of it the mid-block half of the depth-10 transformer level)
+    is the profile-driven lever for the >80%-of-ceiling ROADMAP
+    target. Quality gates via
+    eval/clip_parity.py (encprop row in QualityGateConfig);
+    CASSMANTLE_NO_ENCPROP=1 is the runtime kill switch."""
+
+    base = FrameworkConfig()
+    return base.replace(
+        sampler=dataclasses.replace(base.sampler, encprop=True),
+        models=dataclasses.replace(
+            base.models,
+            vae=dataclasses.replace(base.models.vae, fused_conv=True)))
 
 
 def deepcache_serving_config() -> FrameworkConfig:

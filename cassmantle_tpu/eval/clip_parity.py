@@ -107,6 +107,7 @@ class ClipSimilarityHarness:
                         "vision": self.vision_params,
                         "proj": self.text_projection}
         self._jit_sim = jax.jit(self._sim_impl)
+        self._jit_pair_sim = jax.jit(self._pair_sim_impl)
 
     def _tokenize(self, prompts: Sequence[str]) -> np.ndarray:
         out = np.full((len(prompts), self.pad_len),
@@ -149,3 +150,65 @@ class ClipSimilarityHarness:
             report["baseline_mean"] = float(baseline_mean)
             report["parity_ratio"] = float(np.mean(sims) / baseline_mean)
         return report
+
+    def _pair_sim_impl(self, params, images_a_u8, images_b_u8):
+        def embed(imgs):
+            pre = preprocess_for_clip(imgs, self.vision_cfg.image_size)
+            return self.vision.apply(params["vision"], pre)
+
+        return jnp.sum(embed(images_a_u8) * embed(images_b_u8), axis=-1)
+
+    def image_similarity(self, images_a_u8: np.ndarray,
+                         images_b_u8: np.ndarray) -> np.ndarray:
+        """(B,) cosine similarities between the CLIP-vision embeddings
+        of two image batches — the image↔image counterpart of
+        :meth:`similarity`, jitted once like it (``_jit_pair_sim``).
+        Identical batches score 1.0 exactly (both arms embed through
+        the same compiled tower), which is what makes the stride-1
+        exact-parity leg of the encprop gate a deterministic tier-1
+        assertion even on random init."""
+        return np.asarray(self._jit_pair_sim(
+            self._params, jnp.asarray(images_a_u8),
+            jnp.asarray(images_b_u8)))
+
+
+# Image-quality floor for encoder-propagation serving (the approximation
+# contract in PARITY.md): mean CLIP-vision similarity between the
+# encprop arm's images and the full-forward arm's SAME-SEED images must
+# stay above this. At stride 1 encprop IS the full forward (bit-exact,
+# similarity 1.0 — pinned in tier-1); the default key schedule is gated
+# against this floor whenever the harness runs with real weights
+# (random-init runs report advisory only, like every QualityGateConfig
+# gate).
+ENCPROP_IMAGE_SIM_FLOOR = 0.95
+
+
+def encprop_quality_report(
+    harness: ClipSimilarityHarness,
+    images_encprop: np.ndarray,
+    images_full: np.ndarray,
+    prompts: Sequence[str],
+    floor: float = ENCPROP_IMAGE_SIM_FLOOR,
+) -> dict:
+    """The encprop image-quality gate: same-seed encprop vs full-forward
+    outputs compared in CLIP-vision space (robust, image↔image — no
+    text-prompt noise term), plus both arms' prompt CLIP-sim for the
+    record. ``passes_floor`` is the gate verdict; ``gate_enforced``
+    says whether it is a real-weights measurement or plumbing-only
+    (the enforcement convention of QualityGateConfig)."""
+    pair = harness.image_similarity(images_encprop, images_full)
+    report = {
+        "image_sim_mean": float(np.mean(pair)),
+        "image_sim_min": float(np.min(pair)),
+        "floor": float(floor),
+        "passes_floor": bool(np.mean(pair) >= floor),
+        "exact": bool(np.array_equal(images_encprop, images_full)),
+        "clip_sim_encprop": float(
+            np.mean(harness.similarity(images_encprop, prompts))),
+        "clip_sim_full": float(
+            np.mean(harness.similarity(images_full, prompts))),
+        "n": int(images_full.shape[0]),
+        "real_weights": harness.loaded_real_weights,
+        "gate_enforced": harness.loaded_real_weights,
+    }
+    return report

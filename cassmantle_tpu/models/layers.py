@@ -327,6 +327,27 @@ class GroupNorm32(nn.Module):
         )(x, return_affine=return_affine)
 
 
+def fused_gn_silu_conv3x3(x, out_channels: int, dtype,
+                          norm_name: str, conv_name: str,
+                          epsilon: float = 1e-5, pad_to: int = 0):
+    """The fused-conv dispatch glue shared by the UNet and VAE
+    ResBlocks: fp32 GroupNorm statistics here (``return_affine``),
+    param declaration via :class:`Conv3x3Params` (nn.Conv's exact
+    tree), then the one-pass GN-affine+SiLU+conv3x3 Pallas kernel
+    (ops/fused_conv.py). Must be called inside the parent module's
+    ``@nn.compact`` ``__call__`` — the explicit submodule names keep
+    the param paths identical to the unfused ``GroupNorm32``/
+    ``nn.Conv`` layout. ``epsilon`` is the GroupNorm epsilon (UNet
+    resblocks 1e-5, VAE 1e-6); ``pad_to`` the MXU channel padding."""
+    from cassmantle_tpu.ops.fused_conv import gn_silu_conv3x3
+
+    a, b = GroupNorm32(epsilon=epsilon, name=norm_name)(
+        x, return_affine=True)
+    kernel, bias = Conv3x3Params(out_channels, name=conv_name)(x.shape[-1])
+    return gn_silu_conv3x3(x, a, b, kernel.astype(dtype),
+                           bias.astype(dtype), pad_to=pad_to)
+
+
 class Conv3x3Params(nn.Module):
     """Parameter twin of ``nn.Conv(features, (3, 3))`` that DECLARES the
     kernel/bias without running the convolution.

@@ -31,10 +31,10 @@ import jax.numpy as jnp
 from cassmantle_tpu.config import UNetConfig
 from cassmantle_tpu.models.layers import (
     GEGLU,
-    Conv3x3Params,
     GroupNorm32,
     LayerNorm32,
     MultiHeadAttention,
+    fused_gn_silu_conv3x3,
     nearest_upsample_2x,
     timestep_embedding,
 )
@@ -60,13 +60,8 @@ class ResBlock(nn.Module):
     conv_pad_to: int = 0
 
     def _gn_silu_conv(self, x, norm_name: str, conv_name: str):
-        from cassmantle_tpu.ops.fused_conv import gn_silu_conv3x3
-
-        a, b = GroupNorm32(name=norm_name)(x, return_affine=True)
-        kernel, bias = Conv3x3Params(
-            self.out_channels, name=conv_name)(x.shape[-1])
-        return gn_silu_conv3x3(
-            x, a, b, kernel.astype(self.dtype), bias.astype(self.dtype),
+        return fused_gn_silu_conv3x3(
+            x, self.out_channels, self.dtype, norm_name, conv_name,
             pad_to=self.conv_pad_to)
 
     @nn.compact
@@ -161,17 +156,21 @@ class UNet(nn.Module):
     @nn.compact
     def __call__(
         self,
-        latents: jax.Array,                  # (B, H, W, 4) noisy latents
+        latents: Optional[jax.Array],        # (B, H, W, 4) noisy latents
         timesteps: jax.Array,                # (B,) int/float
         context: jax.Array,                  # (B, S, context_dim) text states
         addition_embeds: Optional[jax.Array] = None,  # SDXL micro-conds
         deep_cache: Optional[jax.Array] = None,
         return_deep: bool = False,
+        skips_cache=None,
+        return_skips: bool = False,
     ) -> jax.Array:
-        """Denoise forward. Two extra modes implement deep-feature reuse
-        (DeepCache-style serving: deep activations vary slowly across
-        adjacent diffusion steps, so a shallow step can reuse them —
-        see ops/ddim.py::ddim_sample_deepcache and PARITY.md):
+        """Denoise forward. Two pairs of extra modes implement feature
+        reuse across adjacent diffusion steps (PARITY.md documents both
+        approximation contracts):
+
+        Deep-feature reuse (DeepCache-style — ops/ddim.py::
+        ddim_sample_deepcache):
 
         - ``return_deep=True``: also return the activation entering the
           SHALLOWEST up level (captured after level 1's upsample conv).
@@ -179,12 +178,43 @@ class UNet(nn.Module):
           down blocks (fresh skips), substitute the cached deep
           activation, and finish with level-0 up blocks + conv_out —
           skipping every deeper level and the mid block entirely.
+
+        Encoder propagation (Faster Diffusion-style — ops/ddim.py::
+        ddim_sample_encprop; the symmetric counterpart that skips the
+        ENCODER instead of the deep levels):
+
+        - ``return_skips=True``: also return the encoder feature cache
+          ``(skip stack, up-path entry)`` — the full down-path skip
+          stack plus the activation entering the up path (the mid-block
+          output) as captured at a key step.
+        - ``skips_cache=<that cache>``: skip conv_in, every down level,
+          and the mid block; run ONLY the up path (+ conv_out) against
+          the cached skips. The time embedding stays fresh — it is the
+          only place the current timestep enters the decoder — so
+          ``latents`` may be None (nothing reads it). Because the
+          decoder never touches x_t, a run of consecutive propagated
+          steps can batch into ONE decoder forward (the cache rows
+          tile along batch; ops/ddim.py::make_cfg_denoiser_encprop).
+
+        Both return_* flags may be combined (the composed
+        deepcache+encprop serving loop captures both at key steps);
+        ``deep_cache`` and ``skips_cache`` are mutually exclusive.
         """
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        latents = latents.astype(dtype)
+        decoder_only = skips_cache is not None
+        assert not (decoder_only and deep_cache is not None), (
+            "deep_cache and skips_cache are mutually exclusive modes"
+        )
+        if latents is not None:
+            latents = latents.astype(dtype)
+        else:
+            assert decoder_only, "latents may be None only with skips_cache"
         context = context.astype(dtype)
         shallow_only = deep_cache is not None
+        assert not (return_skips and (shallow_only or decoder_only)), (
+            "return_skips needs the full encoder to have run"
+        )
 
         # -- time embedding ------------------------------------------------
         temb = timestep_embedding(timesteps, cfg.base_channels)
@@ -200,34 +230,47 @@ class UNet(nn.Module):
             temb = temb + aemb
 
         levels = len(cfg.channel_mults)
-        x = nn.Conv(cfg.base_channels, (3, 3), padding=1,
-                    dtype=dtype, name="conv_in")(latents)
 
         def res_block(ch: int, name: str) -> ResBlock:
             return ResBlock(ch, dtype, fused_conv=cfg.fused_conv,
                             conv_pad_to=cfg.conv_pad_to, name=name)
 
-        # -- down ----------------------------------------------------------
-        skips = [x]
-        down_levels = 1 if shallow_only else levels
-        for lvl in range(down_levels):
-            ch = cfg.base_channels * cfg.channel_mults[lvl]
-            for blk in range(cfg.blocks_per_level):
-                x = res_block(ch, f"down_{lvl}_res_{blk}")(x, temb)
-                if cfg.attention_levels[lvl] and cfg.transformer_depth[lvl]:
-                    x = SpatialTransformer(
-                        num_heads=self._heads(ch),
-                        depth=cfg.transformer_depth[lvl],
-                        context_dim=cfg.context_dim, dtype=dtype,
-                        name=f"down_{lvl}_attn_{blk}",
-                    )(x, context)
-                skips.append(x)
-            if lvl != levels - 1 and not shallow_only:
-                x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=1,
-                            dtype=dtype, name=f"down_{lvl}_downsample")(x)
-                skips.append(x)
+        if decoder_only:
+            # encoder propagation: the whole encoder (conv_in + down
+            # levels + mid block) is skipped — the cached skip stack and
+            # up-path entry stand in for it. Only temb above is fresh.
+            cached_skips, up_entry = skips_cache
+            skips = [s.astype(dtype) for s in cached_skips]
+            x = up_entry.astype(dtype)
+        else:
+            x = nn.Conv(cfg.base_channels, (3, 3), padding=1,
+                        dtype=dtype, name="conv_in")(latents)
 
-        if not shallow_only:
+            # -- down ------------------------------------------------------
+            skips = [x]
+            down_levels = 1 if shallow_only else levels
+            for lvl in range(down_levels):
+                ch = cfg.base_channels * cfg.channel_mults[lvl]
+                for blk in range(cfg.blocks_per_level):
+                    x = res_block(ch, f"down_{lvl}_res_{blk}")(x, temb)
+                    if cfg.attention_levels[lvl] \
+                            and cfg.transformer_depth[lvl]:
+                        x = SpatialTransformer(
+                            num_heads=self._heads(ch),
+                            depth=cfg.transformer_depth[lvl],
+                            context_dim=cfg.context_dim, dtype=dtype,
+                            name=f"down_{lvl}_attn_{blk}",
+                        )(x, context)
+                    skips.append(x)
+                if lvl != levels - 1 and not shallow_only:
+                    x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=1,
+                                dtype=dtype,
+                                name=f"down_{lvl}_downsample")(x)
+                    skips.append(x)
+
+        skips_out = tuple(skips) if return_skips else None
+
+        if not shallow_only and not decoder_only:
             # -- mid -------------------------------------------------------
             mid_ch = cfg.base_channels * cfg.channel_mults[-1]
             mid_depth = max(
@@ -240,6 +283,8 @@ class UNet(nn.Module):
                 context_dim=cfg.context_dim, dtype=dtype, name="mid_attn",
             )(x, context)
             x = res_block(mid_ch, "mid_res_1")(x, temb)
+
+        up_entry_out = x if return_skips else None
 
         # -- up ------------------------------------------------------------
         deep_out = None
@@ -274,6 +319,10 @@ class UNet(nn.Module):
         x = nn.Conv(cfg.sample_channels, (3, 3), padding=1,
                     dtype=jnp.float32, name="conv_out")(x)
         eps = x.astype(jnp.float32)
+        if return_deep and return_skips:
+            return eps, deep_out, (skips_out, up_entry_out)
         if return_deep:
             return eps, deep_out
+        if return_skips:
+            return eps, (skips_out, up_entry_out)
         return eps

@@ -6,9 +6,14 @@ Replaces the image-decoding tail of the reference's remote diffusion call
 on-device and only uint8 RGB crosses back to host.
 
 NHWC, fp32 by default (the VAE is the most precision-sensitive stage; its
-FLOPs are a rounding error next to 50 UNet steps). Attention in the mid
-block is single-head over H·W tokens, routed through ops.attention like
-every other attention site.
+FLOPs are a rounding error next to 50 UNet steps — though at SDXL-1024 the
+decode is 10.47 TF/image, which the decode-side kernels below attack).
+Attention in the mid block is single-head over H·W tokens, routed through
+ops.attention like every other attention site — on TPU that now dispatches
+the wide-head flash variant (ops/flash_attention.py::flash_wide_ok,
+512-blocks) instead of materializing the S=16,384 score matrix in HBM at
+SDXL's 128² latent. ``VAEConfig.fused_conv`` additionally routes every
+ResBlock's GN→SiLU→conv3x3 pair through the fused Pallas kernel.
 """
 
 from __future__ import annotations
@@ -21,24 +26,50 @@ from cassmantle_tpu.config import VAEConfig
 from cassmantle_tpu.models.layers import (
     GroupNorm32,
     MultiHeadAttention,
+    fused_gn_silu_conv3x3,
     nearest_upsample_2x,
 )
 
 
 class VAEResBlock(nn.Module):
+    """GN/SiLU/conv3x3 x2 + skip — the VAE twin of the UNet ResBlock.
+
+    ``fused_conv`` routes both norm+act+conv sequences through the same
+    Pallas fused kernel the UNet hot loop uses (ops/fused_conv.py):
+    GroupNorm statistics still reduce in fp32 here (``return_affine``,
+    at the VAE's 1e-6 epsilon), and the normalize, SiLU, and 3x3 conv
+    run as one kernel — the activated tensor never round-trips HBM,
+    which at SDXL decode means the 1024² per-level activations. The
+    param tree is IDENTICAL either way (Conv3x3Params declares
+    nn.Conv's exact layout), so checkpoints and the init cache are
+    shared and ``VAEConfig.arch()`` clears the flag for identity.
+    """
+
     out_channels: int
     dtype: jnp.dtype
+    fused_conv: bool = False
+
+    def _gn_silu_conv(self, x, norm_name: str, conv_name: str):
+        return fused_gn_silu_conv3x3(
+            x, self.out_channels, self.dtype, norm_name, conv_name,
+            epsilon=1e-6)
 
     @nn.compact
     def __call__(self, x):
-        h = GroupNorm32(epsilon=1e-6, name="norm1")(x)
-        h = nn.silu(h)
-        h = nn.Conv(self.out_channels, (3, 3), padding=1,
-                    dtype=self.dtype, name="conv1")(h)
-        h = GroupNorm32(epsilon=1e-6, name="norm2")(h)
-        h = nn.silu(h)
-        h = nn.Conv(self.out_channels, (3, 3), padding=1,
-                    dtype=self.dtype, name="conv2")(h)
+        if self.fused_conv:
+            h = self._gn_silu_conv(x, "norm1", "conv1")
+        else:
+            h = GroupNorm32(epsilon=1e-6, name="norm1")(x)
+            h = nn.silu(h)
+            h = nn.Conv(self.out_channels, (3, 3), padding=1,
+                        dtype=self.dtype, name="conv1")(h)
+        if self.fused_conv:
+            h = self._gn_silu_conv(h, "norm2", "conv2")
+        else:
+            h = GroupNorm32(epsilon=1e-6, name="norm2")(h)
+            h = nn.silu(h)
+            h = nn.Conv(self.out_channels, (3, 3), padding=1,
+                        dtype=self.dtype, name="conv2")(h)
         if x.shape[-1] != self.out_channels:
             x = nn.Conv(self.out_channels, (1, 1),
                         dtype=self.dtype, name="skip")(x)
@@ -73,15 +104,18 @@ class VAEDecoder(nn.Module):
         mults = cfg.channel_mults
         ch = cfg.base_channels * mults[-1]
         x = nn.Conv(ch, (3, 3), padding=1, dtype=dtype, name="conv_in")(z)
-        x = VAEResBlock(ch, dtype, name="mid_res_0")(x)
+        x = VAEResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                        name="mid_res_0")(x)
         x = VAEAttnBlock(dtype, name="mid_attn")(x)
-        x = VAEResBlock(ch, dtype, name="mid_res_1")(x)
+        x = VAEResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                        name="mid_res_1")(x)
 
         for i, mult in enumerate(reversed(mults)):
             lvl = len(mults) - 1 - i
             ch = cfg.base_channels * mult
             for blk in range(cfg.blocks_per_level + 1):
-                x = VAEResBlock(ch, dtype, name=f"up_{lvl}_res_{blk}")(x)
+                x = VAEResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                                name=f"up_{lvl}_res_{blk}")(x)
             if lvl != 0:
                 x = nearest_upsample_2x(x)
                 x = nn.Conv(ch, (3, 3), padding=1, dtype=dtype,
@@ -107,14 +141,17 @@ class VAEEncoder(nn.Module):
         for lvl, mult in enumerate(cfg.channel_mults):
             ch = cfg.base_channels * mult
             for blk in range(cfg.blocks_per_level):
-                x = VAEResBlock(ch, dtype, name=f"down_{lvl}_res_{blk}")(x)
+                x = VAEResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                                name=f"down_{lvl}_res_{blk}")(x)
             if lvl != len(cfg.channel_mults) - 1:
                 x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=1,
                             dtype=dtype, name=f"down_{lvl}_downsample")(x)
         ch = cfg.base_channels * cfg.channel_mults[-1]
-        x = VAEResBlock(ch, dtype, name="mid_res_0")(x)
+        x = VAEResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                        name="mid_res_0")(x)
         x = VAEAttnBlock(dtype, name="mid_attn")(x)
-        x = VAEResBlock(ch, dtype, name="mid_res_1")(x)
+        x = VAEResBlock(ch, dtype, fused_conv=cfg.fused_conv,
+                        name="mid_res_1")(x)
         x = GroupNorm32(epsilon=1e-6, name="norm_out")(x)
         x = nn.silu(x)
         moments = nn.Conv(cfg.latent_channels * 2, (3, 3), padding=1,

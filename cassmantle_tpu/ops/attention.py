@@ -136,12 +136,26 @@ def multi_head_attention(
         from cassmantle_tpu.ops.flash_attention import (
             flash_attention_ok,
             flash_cross_ok,
+            flash_wide_ok,
         )
 
         if flash_attention_ok(q, k):
             from cassmantle_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, scale=scale)
+        if flash_wide_ok(q, k):
+            # wide-head self-attention (the VAE mid block: single head
+            # over H·W tokens at full channel width — S=16k, D=512 at
+            # SDXL decode): same kernel at 512-blocks so the fat head
+            # fits VMEM; the XLA path would materialize the (S, S)
+            # score matrix in HBM.
+            from cassmantle_tpu.ops.flash_attention import (
+                WIDE_BLOCK,
+                flash_attention,
+            )
+
+            return flash_attention(q, k, v, scale=scale,
+                                   block_q=WIDE_BLOCK, block_k=WIDE_BLOCK)
         if flash_cross_ok(q, k):
             import os
 

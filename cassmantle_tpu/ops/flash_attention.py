@@ -177,8 +177,14 @@ def _fold_heads(t, s, d):
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    scale=None, interpret=None) -> jax.Array:
-    """(..., S, H, D) self-attention via the Pallas kernel."""
+                    scale=None, interpret=None,
+                    block_q: int = BLOCK_Q,
+                    block_k: int = BLOCK_K) -> jax.Array:
+    """(..., S, H, D) self-attention via the Pallas kernel.
+
+    ``block_q``/``block_k`` override the default tiles — the wide-head
+    dispatch (``flash_wide_ok``) shrinks them so fat single-head VMEM
+    working sets (the VAE mid-block's D=512) still fit."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
@@ -189,9 +195,37 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qf = _fold_heads(q, sq, d)
     kf, vf = _fold_heads(k, sk, d), _fold_heads(v, sk, d)
-    out = _flash_bhsd(qf, kf, vf, float(scale), bool(interpret))
+    out = _flash_bhsd(qf, kf, vf, float(scale), bool(interpret),
+                      block_q=block_q, block_k=block_k)
     out = out.reshape(tuple(batch) + (h, sq, d))
     return jnp.moveaxis(out, -3, -2)              # (..., S, H, D)
+
+
+# Wide-head self-attention: the VAE mid block attends single-head over
+# H·W image tokens at the FULL channel width (D = 512 at production
+# geometry) — S hits 16,384 at SDXL's 128² latent, where the XLA path
+# materializes a 16k×16k fp32 score matrix (1 GB per image) in HBM. The
+# main kernel's 1024-tiles would blow VMEM at D=512 (two (BQ, BK) fp32
+# intermediates + three (BK, D) operand tiles), so this dispatch runs
+# the SAME kernel at 512-blocks: ~5 MB/program working set, scores
+# never leave VMEM. Gated to D above MAX_HEAD_DIM so it can't shadow
+# the tuned main path.
+WIDE_BLOCK = 512
+MAX_WIDE_HEAD_DIM = 512
+
+
+def flash_wide_ok(q: jax.Array, k: jax.Array) -> bool:
+    """Self-attention shapes for the wide-head (VAE mid-block) variant:
+    D past the main kernel's bound but within the 512-block VMEM
+    budget, and a sequence that tiles into 512-blocks."""
+    sq, sk, d = q.shape[-3], k.shape[-3], q.shape[-1]
+    return (
+        sq == sk
+        and sq % WIDE_BLOCK == 0
+        and sq >= WIDE_BLOCK
+        and MAX_HEAD_DIM < d <= MAX_WIDE_HEAD_DIM
+        and q.ndim >= 4
+    )
 
 
 # Cross-attention K/V blocks: the text context is short (77 for CLIP), so

@@ -101,12 +101,13 @@ def share_compatible(models_a, models_b) -> bool:
     — the pipeline then derives/loads its own UNet). The single
     definition of the ``share_params_with`` contract: the pipeline's
     assert and callers picking anchors (tools/clip_report.py) both use
-    this. UNet configs compare by ``arch()``: the fused-conv execution
-    flags (fused_conv/conv_pad_to) change how convs run, never the
-    param tree, so a fused A/B arm shares the donor's weights."""
+    this. UNet and VAE configs compare by ``arch()``: the fused-conv
+    execution flags (fused_conv/conv_pad_to) change how convs run,
+    never the param tree, so a fused A/B arm shares the donor's
+    weights."""
     return (models_a.clip_text == models_b.clip_text
             and models_a.unet.arch() == models_b.unet.arch()
-            and models_a.vae == models_b.vae
+            and models_a.vae.arch() == models_b.vae.arch()
             and models_a.param_dtype == models_b.param_dtype)
 
 
@@ -146,11 +147,61 @@ def deepcache_schedule(sampler_cfg):
         f"{sampler_cfg.kind!r}")
 
 
+def encprop_plan(sampler_cfg):
+    """Validate an encoder-propagation sampler config and return its
+    ``(stride, dense_steps, key_count)`` key schedule (shared by the
+    SD1.5 and SDXL pipelines, like deepcache_schedule). Composes with
+    every deterministic sampler kind; eta>0 is rejected (propagated
+    steps replay the decoder deterministically — there is no per-step
+    noise chain to reuse), and the deepcache composition inherits
+    deepcache's own sampler-kind constraint."""
+    from cassmantle_tpu.ops.ddim import encprop_key_indices
+    from cassmantle_tpu.ops.samplers import SAMPLER_KINDS
+
+    assert sampler_cfg.eta == 0.0, \
+        "encprop needs eta=0 (the propagated decoder loop is deterministic)"
+    assert sampler_cfg.kind in SAMPLER_KINDS, \
+        f"encprop composes with {SAMPLER_KINDS}, not {sampler_cfg.kind!r}"
+    assert sampler_cfg.encprop_stride >= 1, \
+        f"encprop stride must be >= 1, got {sampler_cfg.encprop_stride}"
+    assert 0 <= sampler_cfg.encprop_dense_steps <= sampler_cfg.num_steps, \
+        "encprop dense prefix outside the step count"
+    if sampler_cfg.deepcache:
+        assert sampler_cfg.kind in ("ddim", "dpmpp_2m"), \
+            "deepcache composes with ddim or dpmpp_2m, not " \
+            f"{sampler_cfg.kind!r}"
+    keys = encprop_key_indices(
+        sampler_cfg.num_steps, sampler_cfg.encprop_stride,
+        sampler_cfg.encprop_dense_steps)
+    return (sampler_cfg.encprop_stride, sampler_cfg.encprop_dense_steps,
+            len(keys))
+
+
 def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
                     params, ctx, uncond_ctx, lat,
                     addition_embeds=None, uncond_addition_embeds=None):
     """The denoise stage both image pipelines share: plain CFG sampling,
-    or the deepcache full/shallow pairing when configured."""
+    the deepcache full/shallow pairing, or encoder propagation (full
+    forwards at key steps, batched decoder-only forwards in between —
+    possibly composed with deepcache) when configured."""
+    from cassmantle_tpu.ops.ddim import encprop_disabled
+
+    if sampler_cfg.encprop and not encprop_disabled():
+        from cassmantle_tpu.ops.ddim import make_cfg_denoiser_encprop
+        from cassmantle_tpu.ops.samplers import make_encprop_sampler
+
+        stride, dense, _ = encprop_plan(sampler_cfg)
+        sample = make_encprop_sampler(
+            sampler_cfg.kind, sampler_cfg.num_steps, stride, dense,
+            deepcache=sampler_cfg.deepcache)
+        dn_key, dn_prop, dn_shallow = make_cfg_denoiser_encprop(
+            unet_apply, params, ctx, uncond_ctx,
+            sampler_cfg.guidance_scale,
+            addition_embeds=addition_embeds,
+            uncond_addition_embeds=uncond_addition_embeds,
+            deepcache=sampler_cfg.deepcache,
+        )
+        return sample(dn_key, dn_prop, lat, denoise_shallow=dn_shallow)
     if sampler_cfg.deepcache:
         from cassmantle_tpu.ops.ddim import (
             ddim_sample_deepcache,
@@ -177,6 +228,25 @@ def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
         uncond_addition_embeds=uncond_addition_embeds,
     )
     return sample_latents(denoise, lat)
+
+
+def note_encprop_counters(counts, n_images: int) -> None:
+    """Diagnosis counters for encoder propagation (host-side, derived
+    from the static key schedule — the step loop itself is one XLA
+    computation, so per-step device counters would cost a host sync):
+    how many full-encoder, deepcache-shallow (composed loop only), and
+    decoder-only UNet forwards the serving path dispatched. Shared by
+    both image pipelines; silent when the config or the kill switch has
+    encprop off, so bench A/B counter deltas separate the arms."""
+    from cassmantle_tpu.ops.ddim import encprop_disabled
+
+    if counts and not encprop_disabled():
+        keys, shallow, props = counts
+        metrics.inc("pipeline.encprop_key_steps", keys * n_images)
+        if shallow:
+            metrics.inc("pipeline.encprop_shallow_steps",
+                        shallow * n_images)
+        metrics.inc("pipeline.encprop_prop_steps", props * n_images)
 
 
 def pad_prompts_to_dp(prompts: Sequence[str], dp: int):
@@ -310,8 +380,10 @@ class Text2ImagePipeline:
                 loaded_vae if loaded_vae is not None
                 else init_params_cached(
                     self.vae, 3, lat,
+                    # cache key on arch(): fused_conv changes execution, not
+                    # the tree (see UNet note above)
                     cache_path=param_cache_path(
-                        f"vae{cfg.sampler.image_size}", m.vae))
+                        f"vae{cfg.sampler.image_size}", m.vae.arch()))
             )
             # True only when EVERY stage came from a checkpoint: quality
             # evals (tools/clip_report.py) refuse to call a partially
@@ -328,6 +400,16 @@ class Text2ImagePipeline:
             log.info("%s", fc_describe(m.unet))
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
+        # fail fast on invalid encprop configs and precompute the
+        # key/shallow/propagated accounting the diagnosis counters report
+        self._encprop_counts = None
+        if cfg.sampler.encprop:
+            from cassmantle_tpu.ops.ddim import encprop_step_counts
+
+            encprop_plan(cfg.sampler)
+            self._encprop_counts = encprop_step_counts(
+                cfg.sampler.num_steps, cfg.sampler.encprop_stride,
+                cfg.sampler.encprop_dense_steps, cfg.sampler.deepcache)
         self.sample_latents = make_sampler(
             cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
         )
@@ -365,9 +447,11 @@ class Text2ImagePipeline:
     def _staged_enabled(self) -> bool:
         """Per-call routing decision: the ServingConfig knob, minus the
         runtime kill switch, minus configs the slot stepper cannot
-        replay exactly — deepcache's paired steps, eta>0's per-step
-        noise chain, non-stageable sampler kinds, and meshed (dp/sp)
-        serving all keep the proven monolithic dispatch."""
+        replay exactly — deepcache's paired steps, encprop's per-segment
+        key/propagated structure (slots sit at arbitrary schedule
+        positions; a slot admitted mid-segment has no cache), eta>0's
+        per-step noise chain, non-stageable sampler kinds, and meshed
+        (dp/sp) serving all keep the proven monolithic dispatch."""
         from cassmantle_tpu.serving.stages import (
             STAGEABLE_KINDS,
             staged_serving_disabled,
@@ -378,6 +462,7 @@ class Text2ImagePipeline:
                 and not staged_serving_disabled()
                 and self.mesh is None
                 and not s.deepcache
+                and not s.encprop
                 and s.eta == 0.0
                 and s.kind in STAGEABLE_KINDS)
 
@@ -467,6 +552,7 @@ class Text2ImagePipeline:
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.images", n)
+        note_encprop_counters(self._encprop_counts, n)
         return np.asarray(images[:n])
 
     # -- img2img ----------------------------------------------------------
@@ -489,7 +575,7 @@ class Text2ImagePipeline:
                        "vae_encoder")
             or init_params_cached(
                 encoder, 4, img, jax.random.PRNGKey(0),
-                cache_path=param_cache_path(f"vae_enc{size}", m.vae))
+                cache_path=param_cache_path(f"vae_enc{size}", m.vae.arch()))
         )
         self._i2i_fns = {}
         self.vae_enc = encoder
@@ -535,6 +621,13 @@ class Text2ImagePipeline:
                 "img2img does not support deepcache (schedule tails have "
                 "arbitrary parity); use a non-deepcache config for "
                 "image-conditioned generation"
+            )
+        if self.cfg.sampler.encprop:
+            raise NotImplementedError(
+                "img2img does not support encoder propagation (strength "
+                "tails start mid-schedule, where the dense-prefix key "
+                "accounting no longer holds); use a non-encprop config "
+                "for image-conditioned generation"
             )
         self._ensure_encoder()
         steps = self.cfg.sampler.num_steps

@@ -70,7 +70,15 @@ class SDXLPipeline:
         cfg: FrameworkConfig,
         weights_dir: Optional[str] = None,
         mesh: Optional[Mesh] = None,
+        share_params_with: "Optional[SDXLPipeline]" = None,
     ) -> None:
+        """``share_params_with``: reuse another SDXL pipeline's loaded
+        param trees (device buffers shared, nothing copied) when the
+        architectures match — the `sdxl_encprop` bench A/B arms then
+        hold ONE set of the multi-GB SDXL weights in HBM instead of
+        two. Stricter than the SD1.5 donor contract: both text towers
+        and the int8 flag must match exactly (SDXL has no
+        int8-asymmetry re-load path)."""
         enable_compile_cache()
         m = cfg.models
         assert m.clip_text_2 is not None, (
@@ -99,42 +107,60 @@ class SDXLPipeline:
             "addition_embed_dim must exceed the bigG pooled width"
         )
 
-        ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
-        self.clip_params = (
-            maybe_load(weights_dir, "clip_text.safetensors",
-                       lambda t: convert_clip_text(t, m.clip_text.num_layers),
-                       "clip_text", cast_to=m.param_dtype)
-            or init_params_cached(
-                self.clip, 1, ids,
-                cache_path=param_cache_path("clip_text", m.clip_text),
-                cast_to=m.param_dtype)
-        )
-        # read once: the same file carries the tower AND its
-        # text_projection (data/manifests/clip_bigg.json)
-        t2 = load_checkpoint_tensors(
-            weights_dir, "clip_text_2.safetensors", "clip_text_2")
-        converted2 = convert_tensors(
-            t2, lambda t: convert_clip_text(t, m.clip_text_2.num_layers),
-            "clip_text_2", cast_to=m.param_dtype)
-        self.clip2_params = (
-            converted2
-            if converted2 is not None
-            else init_params_cached(
-                self.clip2, 11, ids,
-                cache_path=param_cache_path("clip_text_2", m.clip_text_2),
-                cast_to=m.param_dtype)
-        )
-        # Real SDXL conditions on text_projection(pooled) — the
-        # CLIPTextModelWithProjection text_embeds — not the raw pooled
-        # state; skipping the (square, 1280x1280) projection would
-        # silently divert from the published model the moment real
-        # weights load. Random init keeps the identity behavior.
-        self.clip2_proj = None
-        if converted2 is not None and t2 is not None \
-                and "text_projection.weight" in t2:
-            self.clip2_proj = jnp.asarray(
-                convert_clip_text_projection(t2),
-                dtype=jnp.dtype(m.param_dtype))
+        if share_params_with is not None:
+            from cassmantle_tpu.serving.pipeline import share_compatible
+
+            donor = share_params_with
+            dm = donor.cfg.models
+            assert share_compatible(dm, m) \
+                and dm.clip_text_2 == m.clip_text_2 \
+                and dm.unet_int8 == m.unet_int8, (
+                    "share_params_with needs matching SDXL architectures"
+                )
+            self.clip_params = donor.clip_params
+            self.clip2_params = donor.clip2_params
+            self.clip2_proj = donor.clip2_proj
+            self.unet_params = donor.unet_params
+            self.vae_params = donor.vae_params
+        else:
+            ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
+            self.clip_params = (
+                maybe_load(weights_dir, "clip_text.safetensors",
+                           lambda t: convert_clip_text(
+                               t, m.clip_text.num_layers),
+                           "clip_text", cast_to=m.param_dtype)
+                or init_params_cached(
+                    self.clip, 1, ids,
+                    cache_path=param_cache_path("clip_text", m.clip_text),
+                    cast_to=m.param_dtype)
+            )
+            # read once: the same file carries the tower AND its
+            # text_projection (data/manifests/clip_bigg.json)
+            t2 = load_checkpoint_tensors(
+                weights_dir, "clip_text_2.safetensors", "clip_text_2")
+            converted2 = convert_tensors(
+                t2, lambda t: convert_clip_text(t, m.clip_text_2.num_layers),
+                "clip_text_2", cast_to=m.param_dtype)
+            self.clip2_params = (
+                converted2
+                if converted2 is not None
+                else init_params_cached(
+                    self.clip2, 11, ids,
+                    cache_path=param_cache_path("clip_text_2",
+                                                m.clip_text_2),
+                    cast_to=m.param_dtype)
+            )
+            # Real SDXL conditions on text_projection(pooled) — the
+            # CLIPTextModelWithProjection text_embeds — not the raw pooled
+            # state; skipping the (square, 1280x1280) projection would
+            # silently divert from the published model the moment real
+            # weights load. Random init keeps the identity behavior.
+            self.clip2_proj = None
+            if converted2 is not None and t2 is not None \
+                    and "text_projection.weight" in t2:
+                self.clip2_proj = jnp.asarray(
+                    convert_clip_text_projection(t2),
+                    dtype=jnp.dtype(m.param_dtype))
         lat_hw = cfg.sampler.image_size // self.vae_scale
         lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
         t0 = jnp.zeros((1,), dtype=jnp.int32)
@@ -144,30 +170,45 @@ class SDXLPipeline:
         from cassmantle_tpu.serving.pipeline import int8_unet_tools
 
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
-        # cache key on arch(): the fused-conv execution flags
-        # (UNetConfig.fused_conv / conv_pad_to) don't change the tree,
-        # so A/B arms share one cached init (see serving/pipeline.py)
-        self.unet_params = (
-            maybe_load(weights_dir, "unet_xl.safetensors",
-                       lambda t: convert_unet(t, m.unet), "unet_xl",
-                       cast_to=m.param_dtype, transform=unet_transform)
-            or init_params_cached(
-                self.unet, 2, lat, t0, ctx, add,
-                cache_path=param_cache_path("unet_xl", m.unet.arch()),
-                cast_to=m.param_dtype, transform=unet_transform)
+        if share_params_with is None:
+            # cache key on arch(): the fused-conv execution flags
+            # (UNetConfig.fused_conv / conv_pad_to) don't change the tree,
+            # so A/B arms share one cached init (see serving/pipeline.py)
+            self.unet_params = (
+                maybe_load(weights_dir, "unet_xl.safetensors",
+                           lambda t: convert_unet(t, m.unet), "unet_xl",
+                           cast_to=m.param_dtype, transform=unet_transform)
+                or init_params_cached(
+                    self.unet, 2, lat, t0, ctx, add,
+                    cache_path=param_cache_path("unet_xl", m.unet.arch()),
+                    cast_to=m.param_dtype, transform=unet_transform)
+            )
+            self.vae_params = (
+                maybe_load(weights_dir, "vae_xl.safetensors",
+                           lambda t: convert_vae_decoder(t, m.vae),
+                           "vae_xl")
+                or init_params_cached(
+                    self.vae, 3, lat,
+                    cache_path=param_cache_path(
+                        f"vae_xl{cfg.sampler.image_size}", m.vae.arch()))
+            )
+        from cassmantle_tpu.serving.pipeline import (
+            deepcache_schedule,
+            encprop_plan,
         )
-        self.vae_params = (
-            maybe_load(weights_dir, "vae_xl.safetensors",
-                       lambda t: convert_vae_decoder(t, m.vae), "vae_xl")
-            or init_params_cached(
-                self.vae, 3, lat,
-                cache_path=param_cache_path(
-                    f"vae_xl{cfg.sampler.image_size}", m.vae))
-        )
-        from cassmantle_tpu.serving.pipeline import deepcache_schedule
 
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
+        # fail fast on invalid encprop configs + accounting for the
+        # diagnosis counters (see Text2ImagePipeline)
+        self._encprop_counts = None
+        if cfg.sampler.encprop:
+            from cassmantle_tpu.ops.ddim import encprop_step_counts
+
+            encprop_plan(cfg.sampler)
+            self._encprop_counts = encprop_step_counts(
+                cfg.sampler.num_steps, cfg.sampler.encprop_stride,
+                cfg.sampler.encprop_dense_steps, cfg.sampler.deepcache)
         self.unet_apply = wrap_unet_apply(self.unet.apply)
         from cassmantle_tpu.ops.fused_conv import describe as fc_describe
 
@@ -331,4 +372,7 @@ class SDXLPipeline:
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.sdxl_images", n)
+        from cassmantle_tpu.serving.pipeline import note_encprop_counters
+
+        note_encprop_counters(self._encprop_counts, n)
         return np.asarray(images[:n])

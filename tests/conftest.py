@@ -80,6 +80,12 @@ FAST_MODULES = frozenset({
     # actually runs in the default sweep. test_spec_decode stays for
     # the same reason: greedy/spec bit-parity + the jit-sentinel
     # steady-state assertions are tier-1 acceptance bars (PR 5/7).
+    # test_encprop follows the same pattern (round 16): it compiles
+    # two tiny pipelines, but stride-1 bit-parity, the quality gate,
+    # and the warmed-encprop-loop jit sentinel are acceptance bars
+    # that MUST run in the default sweep; its secondary pipeline
+    # smokes (kill switch, counters, batched-decoder equivalence,
+    # composed/preset pipelines) live in test_encprop_serving (slow).
 })
 
 SLOW_MODULES = frozenset({
@@ -110,6 +116,13 @@ SLOW_MODULES = frozenset({
     # the default tier was landing within run-to-run variance of the
     # 870s window (777s pass / ~880s miss on the same tree).
     "test_lm_train",
+    # secondary encprop serving smokes (each compiles another whole
+    # tiny pipeline or unet scan, ~80s together on a small host); the
+    # tier-1 acceptance bars — stride-1 bit-parity on both geometries,
+    # the quality-gate mechanism, key-schedule accounting, the warmed-
+    # loop jit sentinel, decode-kernel parity — stay in the default
+    # tier via test_encprop (round 16)
+    "test_encprop_serving",
 })
 
 

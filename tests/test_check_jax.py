@@ -464,6 +464,47 @@ def test_mirror_into_executor_submit_fails_and_suppression_passes():
     assert lint(src.format(sup=sup), BufferEscapePass()) == []
 
 
+_SKIP_CACHE_MIRROR_SRC = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    class EncpropScheduler:
+        # encoder-propagation cache host mirror: the skip-stack shape
+        # retained across denoise steps (ISSUE 11). The shipped serving
+        # loop keeps the cache purely ON DEVICE inside one scan (no
+        # host mirror exists to alias); this fixture pins the hazard a
+        # host-mirrored variant would reintroduce.
+        def __init__(self, capacity, width):
+            self._skip_cache = np.zeros((capacity, width),
+                                        dtype=np.float32)
+
+        def step(self):
+            cache = jnp.asarray(self._skip_cache{copy})
+            self._dispatch(cache)
+            self._refresh_keys()
+
+        def _refresh_keys(self):
+            self._skip_cache[0] += 1.0
+"""
+
+
+def test_encprop_skip_cache_mirror_shape_is_caught():
+    """Golden fixture for the encprop skip-stack cache shape: a numpy
+    mirror of per-step encoder features handed to ``jnp.asarray``
+    (zero-copy alias on CPU) and then mutated by the next key-step
+    refresh — exactly the buffer-escape/tracer-leak territory the PR 7
+    passes exist for. The ``.copy()`` variant is the clean shape."""
+    findings = lint(_SKIP_CACHE_MIRROR_SRC.format(copy=""),
+                    BufferEscapePass())
+    assert rules(findings) == ["buffer-escape"]
+    assert "self._skip_cache" in findings[0].message
+
+
+def test_encprop_skip_cache_copy_fix_is_clean():
+    assert lint(_SKIP_CACHE_MIRROR_SRC.format(copy=".copy()"),
+                BufferEscapePass()) == []
+
+
 def test_unmutated_mirror_and_host_reads_are_clean():
     assert lint("""
         import numpy as np

@@ -1,0 +1,182 @@
+"""Encoder propagation: the secondary serving-path smokes.
+
+Split from tests/test_encprop.py (slow tier, tests/conftest.py map):
+each of these compiles another whole tiny pipeline, and the tier-1
+acceptance bars — stride-1 bit-parity, the quality-gate mechanism,
+key-schedule accounting, batched-decoder equivalence, kill switch,
+jit sentinel, decode-kernel parity — already run in the default tier.
+These cover the remaining serving shapes end to end: the non-trivial
+default-style key schedule through the quality report, the composed
+deepcache+encprop pipeline, the encprop preset with the fused VAE,
+batched-vs-sequential propagated-decoder equivalence through the real
+UNet, the CASSMANTLE_NO_ENCPROP kill-switch revert, and the
+pipeline.encprop_* diagnosis counters.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.ops.ddim import (
+    DDIMSchedule,
+    ddim_sample_encprop,
+    encprop_key_indices,
+    make_cfg_denoiser_encprop,
+)
+
+
+@pytest.fixture(scope="module")
+def plain_pipe():
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    return Text2ImagePipeline(_tiny_config())
+
+
+def _tiny_unet():
+    from cassmantle_tpu.models.unet import UNet
+    from cassmantle_tpu.models.weights import init_params
+
+    cfg = _tiny_config().models.unet
+    model = UNet(cfg)
+    lat = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 4))
+    t = jnp.array([5, 9], jnp.int32)
+    ctx = jax.random.normal(jax.random.PRNGKey(1), (2, 6, cfg.context_dim))
+    params = init_params(model, 0, lat, t, ctx, None)
+    return model, params, lat, t, ctx, None
+
+
+def _encprop_cfg(stride=1, dense=0, **sampler_kw):
+    cfg = _tiny_config()
+    return cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, encprop=True, encprop_stride=stride,
+        encprop_dense_steps=dense, **sampler_kw))
+
+
+def test_pipeline_default_schedule_quality_report(plain_pipe):
+    """The default (non-trivial) key schedule flows through the quality
+    gate end to end; on random init the verdict is advisory
+    (gate_enforced False) but every field must compute."""
+    from cassmantle_tpu.eval.clip_parity import (
+        ClipSimilarityHarness,
+        encprop_quality_report,
+    )
+    from cassmantle_tpu.models.clip_vision import ClipVisionConfig
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    prompts = ["a quiet harbor at dawn"]
+    enc = Text2ImagePipeline(_encprop_cfg(stride=2, dense=1),
+                             share_params_with=plain_pipe)
+    a = plain_pipe.generate(prompts, seed=3)
+    b = enc.generate(prompts, seed=3)
+    harness = ClipSimilarityHarness(
+        text_cfg=_tiny_config().models.clip_text,
+        vision_cfg=ClipVisionConfig(
+            image_size=32, patch_size=8, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=4,
+            projection_dim=64),
+        pad_len=16)
+    report = encprop_quality_report(harness, b, a, prompts)
+    for field in ("image_sim_mean", "image_sim_min", "clip_sim_encprop",
+                  "clip_sim_full", "floor"):
+        assert np.isfinite(report[field]), field
+    assert report["gate_enforced"] is False
+
+
+def test_composed_pipeline_runs():
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    cfg = _tiny_config()
+    cfg = cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, encprop=True, encprop_stride=4,
+        encprop_dense_steps=0, deepcache=True))
+    imgs = Text2ImagePipeline(cfg).generate(["a bridge in fog"], seed=2)
+    assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
+
+
+def test_encprop_preset_with_fused_vae_runs(plain_pipe):
+    """The encprop_serving_config shape — encprop sampler + fused VAE —
+    through the tiny pipeline; fused VAE shares the plain pipeline's
+    param tree (arch()-keyed compatibility)."""
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    cfg = _encprop_cfg(stride=2, dense=1)
+    cfg = cfg.replace(models=dataclasses.replace(
+        cfg.models, vae=dataclasses.replace(cfg.models.vae,
+                                            fused_conv=True)))
+    pipe = Text2ImagePipeline(cfg, share_params_with=plain_pipe)
+    imgs = pipe.generate(["a lighthouse in rain"], seed=9)
+    assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
+
+
+def test_batched_prop_decoder_equals_sequential():
+    """One batched decoder forward for a segment's propagated steps must
+    equal per-step decoder forwards — through the REAL tiny UNet and the
+    real cache tiling (make_cfg_denoiser_encprop), end to end through
+    the sampler."""
+    model, params, lat_b2, t, ctx, add = _tiny_unet()
+    lat = jax.random.normal(jax.random.PRNGKey(8), (1, 8, 8, 4))
+    cond, uncond = ctx[:1], jnp.zeros_like(ctx[:1])
+    schedule = DDIMSchedule.create(6)
+    dk, dp, _ = make_cfg_denoiser_encprop(
+        model.apply, params, cond, uncond, 5.0)
+
+    # direct: a 2-step prop batch equals the two single-step calls.
+    # Tolerance is fp32-reassociation-sized, not bitwise: the backend
+    # may tile/thread a batch-4 matmul differently than a batch-2 one
+    # (observed ~1e-5 on the 8-virtual-device CPU env); the CLAIM is
+    # per-row computation independence, which these bounds pin.
+    _, cache = dk(lat, schedule.timesteps[0])
+    ts = schedule.timesteps[1:3]
+    batched = dp(cache, ts)
+    one = dp(cache, ts[:1])
+    two = dp(cache, ts[1:2])
+    np.testing.assert_allclose(np.asarray(batched[0]), np.asarray(one[0]),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(batched[1]), np.asarray(two[0]),
+                               atol=1e-4, rtol=1e-4)
+
+    # and through the whole sampler: batch_props on vs off
+    out_b = ddim_sample_encprop(dk, dp, lat, schedule, stride=3,
+                                dense_steps=0, batch_props=True)
+    out_s = ddim_sample_encprop(dk, dp, lat, schedule, stride=3,
+                                dense_steps=0, batch_props=False)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_s),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_kill_switch_reverts_to_full_forwards(plain_pipe, monkeypatch):
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils.logging import metrics
+
+    monkeypatch.setenv("CASSMANTLE_NO_ENCPROP", "1")
+    killed = Text2ImagePipeline(_encprop_cfg(stride=2, dense=0),
+                                share_params_with=plain_pipe)
+    before = dict(metrics.snapshot()["counters"])
+    out = killed.generate(["a quiet harbor at dawn"], seed=3)
+    after = dict(metrics.snapshot()["counters"])
+    np.testing.assert_array_equal(
+        out, plain_pipe.generate(["a quiet harbor at dawn"], seed=3))
+    # the diagnosis counters must not claim encprop ran
+    assert after.get("pipeline.encprop_key_steps", 0) == \
+        before.get("pipeline.encprop_key_steps", 0)
+
+
+def test_encprop_diagnosis_counters(plain_pipe):
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils.logging import metrics
+
+    enc = Text2ImagePipeline(_encprop_cfg(stride=2, dense=0),
+                             share_params_with=plain_pipe)
+    before = dict(metrics.snapshot()["counters"])
+    enc.generate(["a quiet harbor at dawn"], seed=4)
+    after = dict(metrics.snapshot()["counters"])
+    n = _tiny_config().sampler.num_steps
+    keys = len(encprop_key_indices(n, 2, 0))
+    assert after.get("pipeline.encprop_key_steps", 0) - \
+        before.get("pipeline.encprop_key_steps", 0) == keys
+    assert after.get("pipeline.encprop_prop_steps", 0) - \
+        before.get("pipeline.encprop_prop_steps", 0) == n - keys

@@ -143,3 +143,30 @@ def test_sdxl_turbo_combo():
     pipe = SDXLPipeline(cfg)
     imgs = pipe.generate(["a brass harbor at dusk"], seed=4)
     assert imgs.shape[-1] == 3 and imgs.dtype == np.uint8
+
+
+def test_sdxl_encprop_stride1_bit_parity_and_schedule(pipe, cfg):
+    """Full-pipeline encprop on the dual-tower SDXL path (the
+    `sdxl_encprop` bench arm's shape): stride 1 is uint8 bit-identical
+    to the plain pipeline, and a non-trivial key schedule runs end to
+    end producing a (deliberately) different image."""
+    import dataclasses
+
+    from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+    prompts = ["a tower at dusk"]
+    base = pipe.generate(prompts, seed=5)
+    # share_params_with: the encprop arms hold the donor's trees (the
+    # sdxl_encprop bench A/B contract — one SDXL weight set in HBM)
+    enc1 = SDXLPipeline(cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, encprop=True, encprop_stride=1,
+        encprop_dense_steps=0)), share_params_with=pipe)
+    assert enc1.unet_params is pipe.unet_params
+    np.testing.assert_array_equal(base, enc1.generate(prompts, seed=5))
+
+    enc2 = SDXLPipeline(cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, encprop=True, encprop_stride=2,
+        encprop_dense_steps=0)), share_params_with=pipe)
+    out = enc2.generate(prompts, seed=5)
+    assert out.shape == base.shape and out.dtype == np.uint8
+    assert not np.array_equal(base, out)

@@ -16,7 +16,7 @@ must not be quoted as a quality number.
 
 Usage:
     python tools/clip_report.py [--weights weights] [--out CLIP_REPORT.json]
-        [--platform cpu] [--presets ddim50,dpmpp25,deepcache,turbo,int8]
+        [--platform cpu] [--presets ddim50,dpmpp25,deepcache,turbo,int8,encprop]
         [--tiny]
 """
 
@@ -68,10 +68,13 @@ def preset_factories(tiny: bool):
             "deepcache": tiny_kind("ddim", num_steps=4, deepcache=True),
             "turbo": tiny_kind("dpmpp_2m", num_steps=4, deepcache=True),
             "int8": lambda: _with_unet_int8(test_config()),
+            "encprop": tiny_kind("ddim", num_steps=4, encprop=True,
+                                 encprop_stride=2, encprop_dense_steps=0),
         }
     from cassmantle_tpu.config import (
         FrameworkConfig,
         deepcache_serving_config,
+        encprop_serving_config,
         fast_serving_config,
         turbo_serving_config,
     )
@@ -84,6 +87,9 @@ def preset_factories(tiny: bool):
         # quality arm of the sd15_int8 bench A/B: same DDIM-50
         # trajectory, int8 UNet weights
         "int8": lambda: _with_unet_int8(FrameworkConfig()),
+        # quality arm of the sd15_encprop bench A/B: DDIM-50 with
+        # encoder propagation (20 key steps) + fused VAE decode
+        "encprop": encprop_serving_config,
     }
 
 
@@ -134,7 +140,7 @@ def main() -> None:
                          "suite file)")
     ap.add_argument("--platform", default="auto", choices=["auto", "cpu"])
     ap.add_argument("--presets",
-                    default="ddim50,dpmpp25,deepcache,turbo,int8")
+                    default="ddim50,dpmpp25,deepcache,turbo,int8,encprop")
     ap.add_argument("--seeds", type=int, default=2,
                     help="image batches per preset (n = seeds * 8 prompts)")
     ap.add_argument("--tiny", action="store_true",
