@@ -1168,6 +1168,384 @@ def bench_rooms_load(weights_dir: str) -> dict:
     }
 
 
+# -- chaos drill (ISSUE 12): seeded fault schedule vs the real fabric -----
+
+def _phase_stats(raw: dict, extra: dict = None) -> dict:
+    """One drill phase's record: p50/p99, error budget spent, plus the
+    per-worker chaos.injections total scraped after the load."""
+    import numpy as np
+
+    lats = raw.get("latencies") or []
+    total = raw.get("guesses", 0) + raw.get("errors", 0)
+    stats = {
+        "guesses": raw.get("guesses", 0),
+        "errors": raw.get("errors", 0),
+        "error_budget_spent": round(raw.get("errors", 0) / total, 4)
+        if total else None,
+    }
+    if lats:
+        ms = np.sort(np.asarray(lats)) * 1000.0
+        stats["p50_ms"] = round(float(ms[len(ms) // 2]), 1)
+        stats["p99_ms"] = round(float(ms[int(len(ms) * 0.99)]), 1)
+    if extra:
+        stats.update(extra)
+    return stats
+
+
+async def _scrape_chaos_injections(base_urls) -> int:
+    """Sum of ``chaos.injections`` across the workers' /metrics — the
+    drill's proof that the armed plan actually fired."""
+    import aiohttp
+
+    total = 0
+    timeout = aiohttp.ClientTimeout(total=5.0)
+    async with aiohttp.ClientSession(timeout=timeout) as http:
+        for url in base_urls:
+            try:
+                async with http.get(url + "/metrics") as res:
+                    counters = (await res.json()).get("counters", {})
+            except Exception:
+                continue
+            total += int(counters.get("chaos.injections", 0))
+    return total
+
+
+async def _first_success_after(base_url: str, deadline_s: float) -> float:
+    """Seconds until the worker answers a scoring request again —
+    the drill's recovery clock (bounded; None-equivalent = deadline)."""
+    import asyncio as _asyncio
+
+    import aiohttp
+
+    t0 = time.monotonic()
+    timeout = aiohttp.ClientTimeout(total=3.0)
+    async with aiohttp.ClientSession(timeout=timeout) as http:
+        while time.monotonic() - t0 < deadline_s:
+            try:
+                async with http.post(
+                    base_url + "/compute_score?session=recovery-probe",
+                    json={"inputs": {"0": "probe"}},
+                ) as res:
+                    if res.status == 200:
+                        return round(time.monotonic() - t0, 3)
+            except Exception:
+                pass
+            await _asyncio.sleep(0.1)
+    return round(deadline_s, 3)
+
+
+def _drill_cluster_phase(name: str, spec: str, seed: int, *,
+                         base_port: int, store_port: int, rooms: int,
+                         sessions: int, seconds: float,
+                         round_seconds: float = 8.0,
+                         kill_leader: bool = False) -> dict:
+    """One multi-process drill phase: fresh store(s) + 2 fabric workers
+    booted with the phase's CASSMANTLE_CHAOS plan, sustained guess load,
+    per-fault latency/error stats. ``kill_leader`` runs a replicated
+    store pair and kills the leader mid-phase, measuring recovery."""
+    import asyncio
+
+    from cassmantle_tpu.native.client import spawn_server
+
+    store_procs = []
+    if kill_leader:
+        store_procs.append(spawn_server(store_port, repl=True,
+                                        repl_id="drill-A", lease_ms=600))
+        store_procs.append(spawn_server(store_port + 1, follower=True,
+                                        repl_id="drill-B", lease_ms=600))
+        store_addr = (f"repl:127.0.0.1:{store_port},"
+                      f"127.0.0.1:{store_port + 1}")
+    else:
+        store_procs.append(spawn_server(store_port))
+        store_addr = f"native:{store_port}"
+    prev = os.environ.pop("CASSMANTLE_CHAOS", None)
+    if spec:
+        os.environ["CASSMANTLE_CHAOS"] = f"seed={seed};{spec}"
+    procs = []
+    try:
+        procs, base_urls = rooms_load_spawn_workers(
+            2, rooms, base_port, store_addr,
+            round_seconds=round_seconds)
+        extra = {}
+        if kill_leader:
+            phase1 = asyncio.run(_rooms_load_drive(
+                base_urls, sessions, seconds / 2.0, ws_conns=0))
+            store_procs[0].kill()
+            store_procs[0].wait()
+            extra["recovery_s"] = asyncio.run(
+                _first_success_after(base_urls[0], deadline_s=20.0))
+            raw = asyncio.run(_rooms_load_drive(
+                base_urls, sessions, seconds / 2.0, ws_conns=0))
+            raw["guesses"] += phase1["guesses"]
+            raw["errors"] += phase1["errors"]
+            raw["latencies"] = phase1["latencies"] + raw["latencies"]
+        else:
+            raw = asyncio.run(_rooms_load_drive(
+                base_urls, sessions, seconds, ws_conns=0))
+        if spec:
+            extra["injections"] = asyncio.run(
+                _scrape_chaos_injections(base_urls))
+        return _phase_stats(raw, extra)
+    finally:
+        if spec:
+            os.environ.pop("CASSMANTLE_CHAOS", None)
+        if prev is not None:
+            os.environ["CASSMANTLE_CHAOS"] = prev
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(timeout=10.0)
+        for sp in store_procs:
+            try:
+                sp.kill()
+                sp.wait()
+            except Exception:
+                pass
+
+
+def _drill_wedged_dispatch_phase(seed: int) -> dict:
+    """In-process wedged-dispatch drill: a chaos ``wedge`` holds the
+    REAL dispatch thread, submits fail at their deadline, the watchdog
+    replaces the thread, and recovery is measured from the release to
+    the next successful dispatch."""
+    import asyncio
+
+    from cassmantle_tpu import chaos
+    from cassmantle_tpu.serving.queue import (
+        BatchingQueue,
+        DeadlineExceeded,
+        DispatchTimeout,
+        _DispatchWorker,
+    )
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    chaos.configure(
+        f"seed={seed};queue.dispatch=wedge:times=1,wedge_s=30")
+    sup = ServingSupervisor(degraded_cooldown_s=0.2)
+    q = BatchingQueue(
+        lambda items: [0.0 for _ in items], max_batch=4,
+        max_delay_ms=1, default_deadline_s=0.3, hang_timeout_s=0.6,
+        supervisor=sup, name="drillscore",
+        dispatcher=_DispatchWorker(name="drill.dispatch_worker"))
+    stats = {"deadline_failures": 0}
+
+    async def run() -> None:
+        try:
+            await q.submit("wedge-me")
+        except (DeadlineExceeded, DispatchTimeout):
+            stats["deadline_failures"] += 1
+        # let the watchdog declare the wedge and replace the thread:
+        # the hang clock arms when the handler is OBSERVED running,
+        # one wait-window after dispatch, so the fire lands at up to
+        # ~2x hang_timeout_s
+        await asyncio.sleep(1.5)
+        t0 = time.monotonic()
+        chaos.release("queue.dispatch")
+        assert await q.submit("after") == 0.0
+        stats["recovery_s"] = round(time.monotonic() - t0, 3)
+        # the overrun COUNT, not the live degraded flag: the short
+        # drill cooldown has usually lapsed by this read
+        stats["watchdog_fired"] = (
+            sup.status()["watchdog"]["overruns"] >= 1)
+        await q.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        chaos.disarm()
+    stats["injections"] = 1
+    return stats
+
+
+def _drill_sigterm_handoff_phase(*, base_port: int, store_port: int,
+                                 rooms: int) -> dict:
+    """The graceful-handoff drill: SIGTERM one of two workers and pin
+    that (a) its rooms are adopted by the survivor BEFORE the process
+    exits, and (b) a score accepted on the victim before the signal is
+    still visible through the survivor after (no lost accepted
+    scores — the ISSUE 12 acceptance)."""
+    import asyncio
+    import signal as _signal
+
+    import aiohttp
+
+    from cassmantle_tpu.native.client import spawn_server
+
+    store_proc = spawn_server(store_port)
+    procs = []
+    try:
+        procs, base_urls = rooms_load_spawn_workers(
+            2, rooms, base_port, f"native:{store_port}",
+            round_seconds=30.0)
+
+        async def run() -> dict:
+            timeout = aiohttp.ClientTimeout(total=5.0)
+            async with aiohttp.ClientSession(timeout=timeout) as http:
+                async with http.get(base_urls[1] + "/readyz") as res:
+                    fab = (await res.json())["fabric"]
+                victim_id = fab["worker"]
+                victim_rooms = [r for r, w in fab["rooms"].items()
+                                if w == victim_id]
+                if not victim_rooms:
+                    return {"error": "victim owns no rooms"}
+                room = victim_rooms[0]
+                sid = "handoff-s"
+                q = f"?session={sid}&room={room}"
+                async with http.get(base_urls[1] + "/init" + q) as res:
+                    assert res.status == 200
+                async with http.get(
+                        base_urls[1] + "/fetch/contents" + q) as res:
+                    prompt = (await res.json())["prompt"]
+                mask = (prompt["masks"] or [0])[0]
+                async with http.post(
+                    base_urls[1] + "/compute_score" + q,
+                    json={"inputs": {str(mask): "drill-guess"}},
+                ) as res:
+                    scores_before = await res.json()
+                t_term = time.monotonic()
+                os.kill(procs[1].pid, _signal.SIGTERM)
+                adopted_at = None
+                adopted_while_alive = False
+                deadline = t_term + 15.0
+                while time.monotonic() < deadline:
+                    alive = procs[1].is_alive()
+                    try:
+                        async with http.get(
+                                base_urls[0] + "/readyz") as res:
+                            placement = (await res.json())[
+                                "fabric"]["rooms"]
+                    except Exception:
+                        placement = {}
+                    if adopted_at is None and all(
+                            placement.get(r) not in (victim_id, None)
+                            for r in victim_rooms):
+                        adopted_at = time.monotonic()
+                        adopted_while_alive = alive
+                    if adopted_at is not None and not alive:
+                        break
+                    await asyncio.sleep(0.03)
+                procs[1].join(timeout=10.0)
+                exited_at = time.monotonic()
+                # the survivor now owns the room: the victim's accepted
+                # score must still be there (shared store, no loss)
+                async with http.get(
+                        base_urls[0] + "/fetch/contents" + q) as res:
+                    prompt_after = (await res.json())["prompt"]
+                key = str(mask)
+                before = scores_before.get(key)
+                after = prompt_after.get("scores", {}).get(key)
+                # handoff() exits only after observing the peer beat
+                # that rebuilt the ring, so adoption-before-exit holds
+                # by construction; the 30ms external poll can still
+                # miss the window, so the hard pins are adoption WELL
+                # below the staleness TTL (the handoff moved the rooms,
+                # not the TTL) + the draining verdict + score survival
+                return {
+                    "adopted_before_exit_observed": bool(
+                        adopted_at is not None
+                        and adopted_while_alive),
+                    "adoption_s": round(adopted_at - t_term, 3)
+                    if adopted_at else None,
+                    "membership_ttl_s": 2.5,
+                    "handoff_exit_s": round(exited_at - t_term, 3),
+                    "score_preserved": (
+                        before is not None and after is not None
+                        and float(after) == float(before)),
+                }
+
+        return asyncio.run(run())
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(timeout=10.0)
+        try:
+            store_proc.kill()
+            store_proc.wait()
+        except Exception:
+            pass
+
+
+DRILL_PHASES = ("baseline", "slow_store", "flaky_generation",
+                "heartbeat_flap", "leader_kill", "wedged_dispatch",
+                "sigterm_handoff")
+
+
+def chaos_drill_run(seed: int = 42, rooms: int = 3, sessions: int = 4,
+                    seconds: float = 3.0, base_port: int = 8531,
+                    store_port: int = 7531,
+                    phases=DRILL_PHASES) -> dict:
+    """The seeded chaos drill (docs/CHAOS.md runbook): a fresh
+    two-worker fabric per phase, each phase arming one fault family
+    via CASSMANTLE_CHAOS (same seed => same schedule), plus the
+    in-process wedged-dispatch and process-level SIGTERM-handoff
+    phases. Shared by ``bench.py chaos_drill`` and the slow-tier smoke
+    (tests/test_chaos_drill.py)."""
+    from cassmantle_tpu.native.client import ensure_built
+
+    if ensure_built() is None:
+        raise RuntimeError("mantlestore toolchain unavailable")
+    specs = {
+        "baseline": "",
+        "slow_store": "store.client.op=latency:delay_s=0.02,p=0.3",
+        "flaky_generation": "round.generate=flake:p=0.5",
+        "heartbeat_flap": "fabric.heartbeat=flake:p=0.5",
+        "leader_kill": "",
+    }
+    out = {"seed": seed, "phases": {}}
+    port = base_port
+    sport = store_port
+    for phase in phases:
+        if phase == "wedged_dispatch":
+            out["phases"][phase] = _drill_wedged_dispatch_phase(seed)
+            continue
+        if phase == "sigterm_handoff":
+            out["phases"][phase] = _drill_sigterm_handoff_phase(
+                base_port=port, store_port=sport, rooms=rooms)
+            port += 4
+            sport += 4
+            continue
+        out["phases"][phase] = _drill_cluster_phase(
+            phase, specs[phase], seed, base_port=port,
+            store_port=sport, rooms=rooms, sessions=sessions,
+            seconds=seconds,
+            round_seconds=1.5 if phase == "flaky_generation" else 8.0,
+            kill_leader=(phase == "leader_kill"))
+        port += 4
+        sport += 4
+    return out
+
+
+def bench_chaos_drill(weights_dir: str) -> dict:
+    """ISSUE 12's deliverable: the fleet driven through a seeded fault
+    schedule — slow store, flaky generation, membership flap, store
+    leader kill, wedged dispatch, SIGTERM handoff — reporting per-fault
+    p99, error budget spent, and recovery seconds. Knobs:
+    BENCH_CHAOS_SEED / BENCH_CHAOS_SECONDS / BENCH_CHAOS_ROOMS /
+    BENCH_CHAOS_SESSIONS / BENCH_CHAOS_BASE_PORT /
+    BENCH_CHAOS_STORE_PORT (env)."""
+    env = os.environ.get
+    raw = chaos_drill_run(
+        seed=int(env("BENCH_CHAOS_SEED", "42")),
+        rooms=int(env("BENCH_CHAOS_ROOMS", "3")),
+        sessions=int(env("BENCH_CHAOS_SESSIONS", "4")),
+        seconds=float(env("BENCH_CHAOS_SECONDS", "4")),
+        base_port=int(env("BENCH_CHAOS_BASE_PORT", "8531")),
+        store_port=int(env("BENCH_CHAOS_STORE_PORT", "7531")),
+    )
+    phases = raw["phases"]
+    recovery = phases.get("leader_kill", {}).get("recovery_s")
+    return {
+        "metric": "chaos_drill_leader_kill_recovery_s",
+        "value": recovery,
+        "unit": "seconds",
+        "vs_baseline": None,
+        "seed": raw["seed"],
+        "phases": phases,
+    }
+
+
 # Counters whose per-entry deltas carry diagnostic weight: recompiles,
 # cache effectiveness, staged-serving churn, and every supervision
 # counter (suffix match). Attached to each BENCH_SUITE.json record so
@@ -1237,6 +1615,7 @@ SUITE = {
     "e2e": bench_e2e_round,
     "soak": bench_soak,
     "rooms_load": bench_rooms_load,
+    "chaos_drill": bench_chaos_drill,
 }
 
 # ``--north-star-only`` measures exactly these, with BENCH_ROUNDS=1

@@ -1,0 +1,136 @@
+"""Fault-point registry pass: every ``fault_point("name")`` documented.
+
+The same contract the env-flag and metric-name passes enforce, applied
+to the chaos subsystem (``cassmantle_tpu/chaos/``, docs/CHAOS.md):
+every ``fault_point(...)`` / ``afault_point(...)`` call in the package
+must name a registered fault point — a row in the docs/CHAOS.md
+fault-point registry table — and every row there must correspond to a
+real call site. An unregistered point is a drill lever the operator
+cannot find; a stale row is a drill that silently injects nothing.
+Rule ``fault-point``, three directions:
+
+- per module: calls whose literal name has no registry row;
+- per module: calls whose name is NOT a literal (the registry contract
+  needs greppable names, exactly like metric names);
+- finalize(): registry rows whose point is never hit anywhere in the
+  walked module set (anchored at the docs line) — skipped on scoped
+  runs like the env-flag orphan check.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from cassmantle_tpu.analysis.core import (
+    REPO,
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+)
+
+RULE = "fault-point"
+
+REGISTRY_DOC = REPO / "docs" / "CHAOS.md"
+_SECTION = "## Fault-point registry"
+_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`")
+_CALLS = ("fault_point", "afault_point")
+
+
+def load_registry(doc: pathlib.Path = REGISTRY_DOC) -> Dict[str, int]:
+    """point -> line number for every first-column backticked name in
+    the docs/CHAOS.md fault-point registry table."""
+    if not doc.exists():
+        return {}
+    registry: Dict[str, int] = {}
+    in_section = False
+    for lineno, line in enumerate(doc.read_text().splitlines(), 1):
+        if line.startswith("## "):
+            in_section = line.startswith(_SECTION)
+            continue
+        if in_section:
+            m = _ROW.match(line.strip())
+            if m:
+                registry.setdefault(m.group(1), lineno)
+    return registry
+
+
+def extract_calls(tree: ast.Module
+                  ) -> List[Tuple[Optional[str], int]]:
+    """(point-or-None, lineno) for every ``fault_point``/``afault_point``
+    call; None = the name argument is not a string literal."""
+    calls: List[Tuple[Optional[str], int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None or name.rsplit(".", 1)[-1] not in _CALLS:
+            continue
+        if not node.args:
+            calls.append((None, node.lineno))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            calls.append((arg.value, node.lineno))
+        else:
+            calls.append((None, node.lineno))
+    return calls
+
+
+class FaultPointPass(LintPass):
+    name = "faultpoints"
+    description = ("fault_point()/afault_point() names registered in "
+                   "the docs/CHAOS.md fault-point table, and vice "
+                   "versa")
+
+    def __init__(self, registry: Optional[Dict[str, int]] = None,
+                 check_orphans: bool = True) -> None:
+        self._registry = registry
+        self._check_orphans = check_orphans
+        self._seen: Set[str] = set()
+        self._warned_empty = False
+
+    @property
+    def registry(self) -> Dict[str, int]:
+        if self._registry is None:
+            self._registry = load_registry()
+        return self._registry
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        registry = self.registry
+        calls = extract_calls(module.tree)
+        if calls and not registry and not self._warned_empty:
+            self._warned_empty = True
+            yield Finding(RULE, str(REGISTRY_DOC), 1,
+                          "fault-point registry (docs/CHAOS.md table) "
+                          "missing or empty")
+        for point, lineno in calls:
+            if point is None:
+                yield Finding(
+                    RULE, module.rel, lineno,
+                    "fault point name must be a string literal — the "
+                    "docs/CHAOS.md registry contract needs greppable "
+                    "names")
+                continue
+            self._seen.add(point)
+            if registry and point not in registry:
+                yield Finding(
+                    RULE, module.rel, lineno,
+                    f"fault point {point!r} has no row in the "
+                    f"docs/CHAOS.md registry table — document the "
+                    f"drill lever")
+
+    def finalize(self) -> Iterator[Finding]:
+        if not self._check_orphans:
+            return
+        for point, lineno in sorted(self.registry.items()):
+            if point not in self._seen:
+                yield Finding(
+                    RULE, "docs/CHAOS.md", lineno,
+                    f"{point} has a registry row but no "
+                    f"fault_point()/afault_point() call site in the "
+                    f"package — stale drill lever (remove the row or "
+                    f"wire the point)")

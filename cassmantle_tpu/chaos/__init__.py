@@ -1,0 +1,48 @@
+"""Deterministic fault-injection subsystem (docs/CHAOS.md).
+
+``fault_point`` / ``afault_point`` are the no-op-unless-armed hooks
+compiled into every subsystem boundary; ``configure`` /
+``configure_from_env`` arm a seeded plan from ``CASSMANTLE_CHAOS`` or
+``config.ChaosConfig``; ``status()`` is the block `/readyz` and
+`/healthz` carry whenever a drill is armed.
+"""
+
+from cassmantle_tpu.chaos.core import (
+    CHAOS_ENV,
+    FAULT_POINTS,
+    KINDS,
+    ChaosInjected,
+    ChaosPartition,
+    ChaosPlan,
+    ChaosRule,
+    afault_point,
+    armed,
+    configure,
+    configure_from_env,
+    disarm,
+    fault_point,
+    parse_spec,
+    plan,
+    release,
+    status,
+)
+
+__all__ = [
+    "CHAOS_ENV",
+    "FAULT_POINTS",
+    "KINDS",
+    "ChaosInjected",
+    "ChaosPartition",
+    "ChaosPlan",
+    "ChaosRule",
+    "afault_point",
+    "armed",
+    "configure",
+    "configure_from_env",
+    "disarm",
+    "fault_point",
+    "parse_spec",
+    "plan",
+    "release",
+    "status",
+]

@@ -491,6 +491,27 @@ class FabricConfig:
     # CASSMANTLE_REPL_LEASE_MS override.
     repl_poll_s: float = 0.05
     repl_lease_s: float = 3.0
+    # Graceful SIGTERM handoff bound (fabric/rooms.py RoomFabric.handoff):
+    # after leaving membership and draining rooms, the worker waits up to
+    # this long for every live peer to heartbeat PAST the departure — the
+    # beat that rebuilds the peer's ring and adopts the rooms — so
+    # adoption happens before process exit, not after the staleness TTL.
+    handoff_grace_s: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection (cassmantle_tpu/chaos/,
+    docs/CHAOS.md). ``spec`` uses the same grammar as the
+    ``CASSMANTLE_CHAOS`` env lever (which wins when both are set):
+    ``seed=N;point=kind:k=v,...`` clauses against the fault-point
+    registry. Empty spec (the default) = disarmed, and every fault
+    point is a zero-overhead no-op."""
+
+    spec: str = ""
+    # Default plan seed when the spec carries no ``seed=`` clause —
+    # the same seed replays the same fault schedule.
+    seed: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -532,6 +553,7 @@ class FrameworkConfig:
     game: GameConfig = dataclasses.field(default_factory=GameConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     fabric: FabricConfig = dataclasses.field(default_factory=FabricConfig)
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     spec_decode: SpecDecodeConfig = dataclasses.field(
         default_factory=SpecDecodeConfig)
     quality: QualityGateConfig = dataclasses.field(

@@ -66,19 +66,51 @@ class RoundReserve:
         self.store = store
         self.capacity = capacity
 
+    @staticmethod
+    def _digest(text: str) -> str:
+        import hashlib
+
+        return hashlib.md5(text.encode()).hexdigest()
+
     async def archive(self, text: str, prompt_state_json: str,
                       image_bytes: bytes) -> None:
         """Append one generated round; overwrites the oldest past capacity.
         Consecutive duplicates (a restarted story landing on the same seed)
-        are skipped so the ring never wastes two slots on one puzzle."""
+        are skipped, and re-archiving a text the ring already holds
+        REFRESHES that slot in place (idempotent archive, ISSUE 12): a
+        generation retried after a mid-flight worker death must not
+        consume a second ring slot for the same puzzle."""
         archived = int(await self.store.hget(META_KEY, "archived") or 0)
         if archived > 0:
             last_slot = str((archived - 1) % self.capacity)
             last = await self.store.hget(ROUNDS_KEY, last_slot)
             if last is not None and pickle.loads(last)[0] == text:
                 return
+        held = await self.store.hget(META_KEY,
+                                     f"slot_of:{self._digest(text)}")
+        if held is not None:
+            slot = held.decode()
+            blob = await self.store.hget(ROUNDS_KEY, slot)
+            # the blob is authoritative (the slot_of entry can go stale
+            # when ring wraparound evicted the text): refresh in place
+            # only when the slot still holds THIS text
+            if blob is not None and pickle.loads(blob)[0] == text:
+                await self.store.hset(
+                    ROUNDS_KEY, slot,
+                    pickle.dumps((text, prompt_state_json, image_bytes)))
+                await self.store.hset(INDEX_KEY, slot, prompt_state_json)
+                metrics.inc("reserve.refreshed")
+                return
         seq = await self.store.hincrby(META_KEY, "archived", 1)
         slot = str((seq - 1) % self.capacity)
+        # ring wraparound evicts whatever the slot held: drop the
+        # evicted text's slot_of entry so the digest index stays
+        # bounded by capacity instead of growing per unique text
+        old_blob = await self.store.hget(ROUNDS_KEY, slot)
+        if old_blob is not None:
+            old_text = pickle.loads(old_blob)[0]
+            await self.store.hdel(META_KEY,
+                                  f"slot_of:{self._digest(old_text)}")
         # the payload is one atomic field; the index is written after, so
         # a crash between the two leaves a stale index entry at worst —
         # pick() re-verifies against the blob before serving
@@ -87,6 +119,8 @@ class RoundReserve:
             pickle.dumps((text, prompt_state_json, image_bytes)))
         await self.store.hset(INDEX_KEY, slot, prompt_state_json)
         await self.store.hset(META_KEY, f"seq:{slot}", seq)
+        await self.store.hset(META_KEY, f"slot_of:{self._digest(text)}",
+                              slot)
         # archived == about to be played: stamp now so degraded pickup
         # starts from the round the players saw longest ago
         stamp = await self.store.hincrby(META_KEY, "plays", 1)

@@ -37,6 +37,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
+from cassmantle_tpu.chaos import afault_point
 from cassmantle_tpu.engine.masking import EmbedFn, build_prompt_state
 from cassmantle_tpu.engine.reserve import RoundReserve
 from cassmantle_tpu.engine.store import LockTimeout, StateStore
@@ -151,6 +152,12 @@ class RoundManager:
         if self.breaker is not None and not self.breaker.allow():
             raise CircuitOpen(self.breaker.name)
         try:
+            # generation fault point, INSIDE the guarded attempt: an
+            # injected failure counts toward the breaker and rides the
+            # same retry/reserve degradation a real dark device does
+            # (the chaos port of tests/test_fault_injection.py's
+            # FlakyBackend/DeadBackend monkeypatching)
+            await afault_point("round.generate")
             # a ROOT trace per generation attempt: round generation is
             # background work with no HTTP request to inherit from, and
             # the pipeline's stage spans (prompt decode, t2i) need an
@@ -194,6 +201,16 @@ class RoundManager:
         await self.store.hset(PROMPT_KEY, "seed", content.prompt_text)
         await self.store.hset(PROMPT_KEY, slot, state_json)
         await self.store.hset(IMAGE_KEY, slot, jpeg)
+        if slot == "next":
+            # generation id for idempotent promotion (ISSUE 12): a
+            # worker killed between the current-slot writes and the
+            # buffer cleanup must not let the NEXT promote re-run the
+            # whole promotion (double episode bump) — promote_buffer
+            # compares this id against the last promoted one
+            import uuid as _uuid
+
+            await self.store.hset(PROMPT_KEY, "next_gen",
+                                  _uuid.uuid4().hex)
         if slot == "current":
             await self._bump_image_version()
         if self.reserve is not None:
@@ -307,6 +324,40 @@ class RoundManager:
             ):
                 prompt_next = await self.store.hget(PROMPT_KEY, "next")
                 image_next = await self.store.hget(IMAGE_KEY, "next")
+                next_gen = await self.store.hget(PROMPT_KEY, "next_gen")
+                promoted = await self.store.hget(PROMPT_KEY,
+                                                 "promoted_gen")
+                if next_gen is not None and next_gen == promoted:
+                    # this buffer ALREADY promoted its current slots: a
+                    # worker died after the current writes + marker but
+                    # before the tail. FINISH the interrupted tail
+                    # instead of re-promoting — clients must see the
+                    # new image version (a skipped bump would pin the
+                    # old round's cached image against the new prompt
+                    # all round), a pending storyline restart must
+                    # land, and the episode advances ONCE. The only
+                    # repeatable piece is the version bump (a crash
+                    # after it but before the hdel re-bumps: one extra
+                    # cache invalidation, never a stale serve); story
+                    # and episode sit after the hdel, so this branch is
+                    # their first and only run.
+                    await self._bump_image_version()
+                    await self.store.hdel(PROMPT_KEY, "next",
+                                          "next_gen")
+                    await self.store.hdel(IMAGE_KEY, "next")
+                    next_story = await self.store.hget(STORY_KEY,
+                                                       "next")
+                    if next_story is not None:
+                        await self.init_story(next_story.decode())
+                        await self.store.hdel(STORY_KEY, "next")
+                    await self.store.hincrby(STORY_KEY, "episode", 1)
+                    metrics.inc("rounds.promote_dedup",
+                                labels=self.metric_labels)
+                    flight_recorder.record("round.promote_dedup")
+                    log.warning("buffer was already promoted by a "
+                                "crashed worker; finished its cleanup "
+                                "without re-promoting")
+                    return
                 if prompt_next is None or image_next is None:
                     # generation is dark (breaker open / buffer failed):
                     # rotate a reserve round so players get a FRESH
@@ -337,8 +388,17 @@ class RoundManager:
                         # the restore is also a current-image change
                         await self._bump_image_version()
                     raise
+                if next_gen is not None:
+                    # the promotion marker lands RIGHT AFTER the
+                    # current-slot writes: the crash window where a
+                    # retry would double-promote shrinks to the gap
+                    # between these two writes (and a double there
+                    # rewrites identical bytes; only the episode
+                    # counter could run ahead by one)
+                    await self.store.hset(PROMPT_KEY, "promoted_gen",
+                                          next_gen)
                 await self._bump_image_version()
-                await self.store.hdel(PROMPT_KEY, "next")
+                await self.store.hdel(PROMPT_KEY, "next", "next_gen")
                 await self.store.hdel(IMAGE_KEY, "next")
                 next_story = await self.store.hget(STORY_KEY, "next")
                 if next_story is not None:

@@ -565,12 +565,19 @@ class ReplicatedStore(StateStore):
     async def _call(self, invoke):
         """Run one client operation against the leader, failing over on
         connection loss / timeout / READONLY rejection."""
+        from cassmantle_tpu.chaos import afault_point
+
         deadline = time.monotonic() + self.failover_grace_s
         while True:
             idx = await self._ensure_leader(
                 grace_s=max(0.0, deadline - time.monotonic()))
             client = self._client(idx)
             try:
+                # leader-boundary fault point: a peer-scoped partition
+                # (host:port) raises ConnectionError and drives the SAME
+                # drop + re-elect path a real leader cut does
+                await afault_point("repl.leader_call",
+                                   peer="%s:%d" % self.endpoints[idx])
                 return await asyncio.wait_for(
                     invoke(client), timeout=self.op_timeout_s)
             except RuntimeError as exc:
@@ -601,8 +608,13 @@ class ReplicatedStore(StateStore):
             await asyncio.sleep(self.poll_interval_s)
 
     async def _pump_once(self) -> None:
+        from cassmantle_tpu.chaos import afault_point
         from cassmantle_tpu.utils.logging import metrics
 
+        # pump fault point: a raise lands in the loop's except (counted
+        # repl.pump_errors, next tick retries); latency models a slow
+        # shipping pass (repl.lag growth the drills can watch)
+        await afault_point("repl.pump")
         leader_idx = self._leader_idx()
         if leader_idx is None:
             return

@@ -24,6 +24,7 @@ import json
 import time
 from typing import Callable, Dict, Optional
 
+from cassmantle_tpu.chaos import afault_point
 from cassmantle_tpu.engine.store import StateStore
 from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
@@ -49,6 +50,10 @@ class ClusterMembership:
 
     async def heartbeat(self, room_count: int = 0) -> Dict[str, dict]:
         """Announce this worker and refresh the live view."""
+        # heartbeat fault point: a flake here ages this worker toward
+        # the staleness TTL (peers see it leave and adopt its rooms) —
+        # the membership-churn drill (docs/CHAOS.md)
+        await afault_point("fabric.heartbeat")
         payload = json.dumps({
             "addr": self.addr,
             "rooms": int(room_count),

@@ -16,6 +16,7 @@ import os
 import subprocess
 from typing import Dict, Optional, Set
 
+from cassmantle_tpu.chaos import afault_point
 from cassmantle_tpu.engine.store import (
     LockTimeout,
     StateStore,
@@ -162,6 +163,11 @@ class MantleStore(StateStore):
 
     # -- protocol ---------------------------------------------------------
     async def _cmd(self, *args: bytes):
+        # the store-boundary fault point (docs/CHAOS.md): latency here is
+        # a slow store, partition (peer-scoped host:port) is a network
+        # cut this client treats exactly like a refused connection
+        await afault_point("store.client.op",
+                           peer=f"{self.host}:{self.port}")
         if self._writer is None:
             await self.connect()
         async with self._io_lock:

@@ -46,6 +46,8 @@ from typing import Optional
 
 from aiohttp import WSMsgType, web
 
+from cassmantle_tpu import chaos
+from cassmantle_tpu.chaos import afault_point
 from cassmantle_tpu.config import FrameworkConfig, ObsConfig
 from cassmantle_tpu.engine.game import Game
 from cassmantle_tpu.fabric.rooms import RoomFabric
@@ -134,6 +136,16 @@ def _check_room_ownership(request: web.Request, fabric: RoomFabric,
     params: cookies are host-scoped and do not survive the hop, so a
     cookie-only client would otherwise re-resolve a DIFFERENT room on
     the target worker (redirect ping-pong between owners)."""
+    if request.headers.get("X-Score-Hedge") == "1" and \
+            _is_cluster_peer(request, fabric):
+        # an authenticated scorer hedge from a sick peer (ISSUE 12):
+        # the room's owner IS the worker that hedged here, so the
+        # ownership redirect would bounce the request straight back.
+        # Serve it locally — the shared store keeps the session/score
+        # writes consistent, the same contract ownerless foreign
+        # serves already rely on.
+        metrics.inc("score.hedge_served")
+        return
     if fabric.is_local(room):
         return
     addr = fabric.owner_addr(room)
@@ -382,28 +394,104 @@ async def handle_fetch_contents(request: web.Request) -> web.Response:
     return response
 
 
+# Bounded hedge fan: a sick cluster must not retry-storm itself — at
+# most this many peers are dialed per shed request, each under the
+# cluster fan-out timeout, and a hedged request NEVER re-hedges.
+SCORE_HEDGE_MAX_ATTEMPTS = 2
+
+
+async def _hedge_score(request: web.Request, room: str, session: str,
+                       payload: dict) -> Optional[dict]:
+    """Cross-worker scorer failover (ISSUE 12): when the local score
+    path is provably dark, dial a healthy fabric peer's /compute_score
+    with the cluster token and the ``X-Score-Hedge`` marker (the peer
+    serves the foreign room locally and never re-hedges, so a fully
+    sick cluster degrades after one bounded fan instead of storming).
+    Returns the peer's scores dict, or None when no peer answered —
+    floor scores are the caller's LAST resort, not its first."""
+    fabric = request.app[_FABRIC]
+    token = fabric.cluster_token()
+    if token is None:
+        return None
+    try:
+        table = await fabric.membership.table()
+    except Exception:
+        return None
+    peers = [(worker, row["info"].get("addr"))
+             for worker, row in sorted(table.items())
+             if worker != fabric.worker_id and not row["stale"]
+             and row["info"].get("addr")]
+    http = _peer_session(request)
+    attempts = 0
+    for worker, addr in peers:
+        if attempts >= SCORE_HEDGE_MAX_ATTEMPTS:
+            break
+        attempts += 1
+        metrics.inc("score.hedge_attempts")
+        try:
+            await afault_point("score.hedge", peer=worker)
+            async with http.post(
+                addr.rstrip("/") + "/compute_score",
+                params={"room": room, "session": session},
+                json=payload,
+                headers={"X-Cluster-Auth": token,
+                         "X-Score-Hedge": "1"},
+            ) as res:
+                if res.status != 200:
+                    # a degraded peer sheds hedges with 503: try the
+                    # next one, never loop back
+                    metrics.inc("score.hedge_failures")
+                    continue
+                data = await res.json()
+        except Exception:
+            metrics.inc("score.hedge_failures")
+            continue
+        metrics.inc("score.hedge_success")
+        flight_recorder.record("score.hedge", peer=worker, room=room)
+        return data
+    return None
+
+
 async def handle_compute_score(request: web.Request) -> web.Response:
-    _, game = await _resolve_game(request)
+    room, game = await _resolve_game(request)
     supervisor = game.supervisor
-    if supervisor.shed_scores():
-        # the scorer is provably dark (breaker open): shed with an
-        # honest 503 + Retry-After instead of serving floor scores that
-        # read as "every guess is wrong"
-        metrics.inc("http.score_shed")
-        raise web.HTTPServiceUnavailable(
-            text="scoring degraded; retry shortly",
-            headers={"Retry-After": str(int(supervisor.retry_after_s()))})
     session = _session_id(request) or str(uuid.uuid4())
-    await game.ensure_client(session)
     try:
         data = await request.json()
         inputs = data["inputs"]
         assert isinstance(inputs, dict)
     except Exception:
         raise web.HTTPBadRequest(text="body must be {inputs: {idx: guess}}")
+    if supervisor.shed_scores() or supervisor.device_unhealthy():
+        # the local scorer is provably dark (breaker open / device
+        # verdict false). Failover ladder (ISSUE 12): (1) a request
+        # that IS someone else's hedge sheds 503 + Retry-After so the
+        # origin tries its next peer — hedges must never cascade;
+        # (2) hedge to a healthy fabric peer (real scores); (3) floor
+        # scores as the LAST resort, honestly marked.
+        if request.headers.get("X-Score-Hedge") == "1":
+            metrics.inc("http.score_shed")
+            raise web.HTTPServiceUnavailable(
+                text="scoring degraded; retry shortly",
+                headers={"Retry-After":
+                         str(int(supervisor.retry_after_s()))})
+        hedged = await _hedge_score(request, room, session,
+                                    {"inputs": inputs})
+        if hedged is not None:
+            response = web.json_response(hedged)
+            response.headers["X-Score-Hedged"] = "1"
+            return response
+        metrics.inc("score.hedge_floor")
+        flight_recorder.record("score.floor", room=room)
+        # fall through: the breaker-aware local path serves floor
+        # scores (engine min_score), marked so clients/operators can
+        # tell degradation from wrong guesses
+    await game.ensure_client(session)
     with metrics.timer("http.compute_score_s"):
         scores = await game.compute_client_scores(session, inputs)
     response = web.json_response(scores)
+    if supervisor.shed_scores() or supervisor.device_unhealthy():
+        response.headers["X-Score-Degraded"] = "floor"
     # client-side latency attribution: how long this request's guess
     # batch waited to coalesce vs how long the device batch it rode
     # took (filled by BatchingQueue into the request's trace marks;
@@ -488,6 +576,10 @@ async def _peer_fanout(request: web.Request, path: str, params: dict):
 
     async def fetch(worker: str, addr: str):
         try:
+            # peer-fan-out fault point: a worker-scoped partition marks
+            # exactly that peer errored in the merged view while the
+            # rest of the fleet stays readable (docs/CHAOS.md)
+            await afault_point("fabric.peer_http", peer=worker)
             async with session.get(addr.rstrip("/") + path,
                                    params=params,
                                    headers=headers) as res:
@@ -739,6 +831,12 @@ async def handle_readyz(request: web.Request) -> web.Response:
         device_ok=device_ok, include_events=_is_loopback(request))
     status["store"] = store_ok
     ready = bool(status["ready"]) and store_ok
+    if fabric.draining:
+        # graceful handoff in progress (SIGTERM): admission must stop —
+        # load balancers drain NOW, while in-flight requests finish and
+        # peers adopt the rooms (fabric/rooms.py RoomFabric.handoff)
+        ready = False
+        status["state"] = "draining"
     status["ready"] = ready
     # the SLO block is ADVISORY, never gating: burn rates tell the
     # operator where the error budget goes; draining a worker stays a
@@ -750,7 +848,8 @@ async def handle_readyz(request: web.Request) -> web.Response:
     status["slo"] = engine.status()
     if ready:
         return web.json_response(status)
-    status["state"] = "degraded"
+    if status.get("state") != "draining":
+        status["state"] = "degraded"
     retry_after = str(int(supervisor.retry_after_s()))
     return web.json_response(
         status, status=503, headers={"Retry-After": retry_after})
@@ -866,6 +965,11 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
     # apply the observability knobs before any route can record
     # (tracer/recorder/metrics are process globals; idempotent)
     configure_observability(cfg.obs)
+    # arm (or disarm) the fault-injection plan: CASSMANTLE_CHAOS wins
+    # over cfg.chaos.spec; disarmed, every fault point stays a no-op
+    # (docs/CHAOS.md). /readyz + /healthz carry the chaos block while
+    # armed, so a drill can never be mistaken for an incident.
+    chaos.configure_from_env(cfg.chaos)
     if isinstance(game, RoomFabric):
         fabric = game
         fabric.start_timers = start_timer
@@ -937,6 +1041,21 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
             tasks.append(loop.create_task(
                 _slo_loop(app_[_SLO], cfg.obs.slo_eval_interval_s)))
 
+    async def on_shutdown(app_: web.Application) -> None:
+        # graceful SIGTERM handoff (ISSUE 12): leave membership, drain
+        # rooms, wait for peers to adopt — BEFORE the process dies, so
+        # the ring moves on a peer beat instead of after the staleness
+        # TTL. aiohttp has already closed the listeners by this hook,
+        # so new connections are refused (the LB's drain signal) while
+        # in-flight requests finish under the shutdown grace. For an
+        # operator-initiated drain with the listener still up, calling
+        # RoomFabric.handoff() directly serves 307s to the adopters
+        # and /readyz reports "draining" throughout.
+        try:
+            await fabric.handoff()
+        except Exception:
+            log.exception("graceful handoff failed; shutting down anyway")
+
     async def on_cleanup(app_: web.Application) -> None:
         for task in app_[_OBS_TASKS]:
             task.cancel()
@@ -951,6 +1070,7 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
         await fabric.shutdown()
 
     app.on_startup.append(on_startup)
+    app.on_shutdown.append(on_shutdown)
     app.on_cleanup.append(on_cleanup)
     return app
 
@@ -1237,8 +1357,16 @@ def main() -> None:
                     p.join()
                     if p.exitcode not in (0, None, -signal.SIGINT,
                                           -signal.SIGTERM):
+                        # a dead sibling is degraded capacity, not just
+                        # a log line (ISSUE 12 satellite): count it,
+                        # flight-record it, and let the supervisor's
+                        # /readyz watchdog block surface the total
                         log.error("worker pid=%s died with exit code %s",
                                   p.pid, p.exitcode)
+                        metrics.inc("server.worker_deaths")
+                        flight_recorder.record(
+                            "server.worker_death", pid=p.pid,
+                            exitcode=p.exitcode)
 
         threading.Thread(target=_watch, daemon=True).start()
         try:

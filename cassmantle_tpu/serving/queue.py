@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Callable, Generic, List, Optional, Sequence, TypeVar
 
+from cassmantle_tpu.chaos import fault_point
 from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.obs.trace import current_ctx, run_with_ctx, tracer
 from cassmantle_tpu.utils.locks import OrderedLock
@@ -354,7 +355,7 @@ class BatchingQueue(Generic[T, R]):
             # span's context, so its block_timer stage spans land in the
             # batch's trace (contextvars don't cross threads on their own)
             dispatch, started = self._dispatcher.submit(
-                run_with_ctx, batch_ctx, self.handler, items)
+                run_with_ctx, batch_ctx, self._handle_batch, items)
             wrapped = asyncio.wrap_future(dispatch)
             try:
                 with metrics.timer(f"{self.name}.batch_s"):
@@ -409,6 +410,14 @@ class BatchingQueue(Generic[T, R]):
                 self._record_batch_obs(
                     batch_ctx, parent, futures, start_wall, t_dispatch,
                     status)
+
+    def _handle_batch(self, items: List[T]):
+        """The dispatched body: the ``queue.dispatch`` fault point runs
+        ON the dispatch thread, peer-scoped by queue name — a ``wedge``
+        rule wedges the real thread and exercises the real watchdog
+        (deadline expiry, thread disown + replace), not a mock of it."""
+        fault_point("queue.dispatch", peer=self.name)
+        return self.handler(items)
 
     def _record_batch_obs(self, batch_ctx, parent, futures,
                           start_wall: float, t_dispatch: float,

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cassmantle_tpu.chaos import fault_point
 from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.obs.trace import current_ctx, tracer
 from cassmantle_tpu.ops.ddim import initial_latents, make_slot_denoiser
@@ -534,6 +535,11 @@ class StagedImageServer:
         hook = self._on_step
         if hook is not None:
             hook(self)
+        # staged-tick fault point (docs/CHAOS.md): a raise exercises the
+        # loop-error containment below (in-flight callers failed, loop
+        # survives); a wedge holds the denoise thread so the stage
+        # progress watchdog path is the thing that notices
+        fault_point("stage.denoise.tick")
         idle = self._active_n == 0 and not self._pend
         self._drain_admissions(block=idle)
         now = time.monotonic()

@@ -109,6 +109,14 @@ class ServingSupervisor:
             return {s: round(now - t, 3)
                     for s, t in self._stage_progress.items()}
 
+    def device_unhealthy(self) -> bool:
+        """True only when the cached device verdict is a hard False —
+        a sync read with NO probe dial, cheap enough for the request
+        path (the scorer-hedge decision, server/app.py). None/unknown
+        reads healthy: hedging is for provably dark devices."""
+        dh = self.device_health
+        return dh is not None and dh.last_verdict() is False
+
     # -- device -----------------------------------------------------------
     async def probe_device(self) -> Optional[bool]:
         """DeviceHealth verdict for status(); None = nothing to probe
@@ -168,6 +176,11 @@ class ServingSupervisor:
                 "degraded_for_s": max(
                     0.0, self._degraded_until - self.clock()),
             }
+        deaths = metrics.counter_total("server.worker_deaths")
+        if deaths:
+            # dead sibling workers (the parent's watcher counts them):
+            # capacity this /readyz verdict silently lost (ISSUE 12)
+            watchdog["worker_deaths"] = int(deaths)
         metrics.gauge("supervisor.degraded", 0.0 if ready else 1.0)
         status: Dict[str, object] = {
             "ready": ready,
@@ -182,6 +195,13 @@ class ServingSupervisor:
         stages = self.stage_health()
         if stages:
             status["stages"] = stages
+        from cassmantle_tpu import chaos
+
+        if chaos.armed():
+            # a drill must never read as an incident: whenever a fault
+            # plan is armed, BOTH probe surfaces say so (healthz embeds
+            # this same status block)
+            status["chaos"] = chaos.status()
         if self.fabric_status is not None:
             try:
                 status["fabric"] = self.fabric_status()

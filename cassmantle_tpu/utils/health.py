@@ -78,6 +78,13 @@ class DeviceHealth:
         self._checked_at = 0.0
         self._inflight: Optional[_Probe] = None
 
+    def last_verdict(self):
+        """The cached verdict (True/False/None-unknown) with NO probe
+        dial — the request-path read (scorer hedging) where blocking up
+        to ``timeout_s`` on a wedged device is not an option."""
+        with self._lock:
+            return self._healthy
+
     def check(self) -> tuple:
         with self._lock:
             age = time.monotonic() - self._checked_at

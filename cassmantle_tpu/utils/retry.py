@@ -10,12 +10,24 @@ a backoff schedule matching the reference's default.
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Awaitable, Callable, Optional, Tuple, Type, TypeVar
 
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 T = TypeVar("T")
 log = get_logger("retry")
+
+# Default jitter source. Module-level (not per-call) so the stream is
+# one process-wide sequence; seed_jitter() pins it for drills/tests —
+# a seeded chaos run replays the same retry spacing too.
+_jitter_rng = random.Random()
+
+
+def seed_jitter(seed: int) -> None:
+    """Re-seed the default jitter stream (deterministic drills)."""
+    global _jitter_rng
+    _jitter_rng = random.Random(seed)
 
 
 def linear_backoff(base_s: float = 10.0):
@@ -37,9 +49,20 @@ async def retry_async(
     name: str = "op",
     deadline_s: Optional[float] = None,
     give_up_on: Tuple[Type[BaseException], ...] = (),
+    jitter: bool = True,
+    rng: Optional[random.Random] = None,
 ) -> T:
     """Run ``op`` with up to ``max_retries`` attempts; re-raises the last
     failure (callers keep skip-don't-crash semantics at their level).
+
+    Backoff is FULL-JITTERED by default: each pause is drawn uniformly
+    from (0, schedule(attempt)] — N callers tripped by one store blip
+    (every worker's round clock hitting the same dead leader) spread
+    their re-dials across the window instead of retrying in lockstep
+    and re-spiking the thing that just fell over. ``rng`` injects the
+    jitter source (deterministic under drill seeds; see
+    :func:`seed_jitter` for the module default); ``jitter=False`` keeps
+    the exact reference schedule.
 
     ``deadline_s`` bounds total wall time: no further attempt starts once
     elapsed + the next backoff would pass it. Callers that retry while
@@ -66,6 +89,13 @@ async def retry_async(
                         name, attempt + 1, max_retries, exc)
             if attempt + 1 < max_retries:
                 pause = backoff(attempt)
+                if jitter and pause > 0:
+                    # full jitter (uniform over (0, schedule]): the
+                    # spread that actually decorrelates a thundering
+                    # herd; attempts stay bounded by max_retries and
+                    # the deadline check below, so a small draw cannot
+                    # turn backoff into an unbounded hot loop
+                    pause *= (rng or _jitter_rng).random()
                 if deadline_s is not None and \
                         loop.time() - start + pause >= deadline_s:
                     log.warning("%s: deadline %.0fs reached after %d "

@@ -58,7 +58,8 @@ def pytest_configure(config):
 # host; see ROADMAP.md for the tier commands.
 
 FAST_MODULES = frozenset({
-    "test_aux", "test_bench_harness", "test_check_concurrency",
+    "test_aux", "test_bench_harness", "test_chaos",
+    "test_check_concurrency",
     "test_check_jax", "test_check_metrics", "test_eval",
     "test_fabric", "test_fault_injection",
     "test_flash_attention", "test_frontend", "test_fused_conv",
@@ -100,6 +101,12 @@ SLOW_MODULES = frozenset({
     # wall clock that the per-component fast-tier coverage in
     # test_fabric already smoke-tests in-process
     "test_fabric_cluster",
+    # the seeded chaos drill smoke: multi-process fabric phases (store
+    # spawns + worker subprocesses + SIGTERM handoff) beside
+    # test_fabric_cluster; the fast in-process versions of every
+    # behavior live in test_chaos / test_fault_injection /
+    # test_chaos_recovery
+    "test_chaos_drill",
     # moved to slow at round 14: the default tier outgrew its tier-1
     # window on a 2-core host (the fabric + cluster-obs suites grew it
     # past ~900s vs the 870s budget) and was alphabetically truncating

@@ -27,11 +27,43 @@ async def test_retry_succeeds_after_failures():
 
     result = await retry_async(
         flaky, max_retries=5, backoff=linear_backoff(10.0),
-        sleep=fake_sleep,
+        sleep=fake_sleep, jitter=False,
     )
     assert result == "ok"
     assert len(attempts) == 3
     assert sleeps == [10.0, 20.0]  # reference schedule (k+1)*base
+
+
+@pytest.mark.asyncio
+async def test_retry_full_jitter_spreads_and_replays_with_seeded_rng():
+    """ISSUE 12 satellite: backoff pauses are full-jittered (uniform
+    over (0, schedule]) so N callers tripped by one store blip don't
+    re-dial in lockstep — and an injected RNG replays the exact same
+    pause sequence (deterministic under drill seeds)."""
+    import random
+
+    async def always_fails():
+        raise RuntimeError("transient")
+
+    async def run(rng):
+        sleeps = []
+
+        async def sleep(v):
+            sleeps.append(v)
+
+        with pytest.raises(RuntimeError):
+            await retry_async(always_fails, max_retries=4,
+                              backoff=linear_backoff(10.0),
+                              sleep=sleep, rng=rng)
+        return sleeps
+
+    a = await run(random.Random(7))
+    b = await run(random.Random(7))
+    c = await run(random.Random(8))
+    assert a == b                      # seeded replay
+    assert a != c                      # actually jittered
+    for pause, bound in zip(a, (10.0, 20.0, 30.0)):
+        assert 0.0 <= pause <= bound   # full jitter stays in-window
 
 
 @pytest.mark.asyncio

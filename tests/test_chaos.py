@@ -1,0 +1,333 @@
+"""Chaos fabric core (ISSUE 12): seeded plans, fault-point semantics,
+the zero-overhead disarmed contract, the /readyz chaos block, and the
+fault-point registry lint (docs/CHAOS.md)."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from cassmantle_tpu import chaos
+from cassmantle_tpu.chaos import ChaosInjected, ChaosPartition
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    """Every test leaves the process-global plan disarmed."""
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# -- spec parsing ----------------------------------------------------------
+
+def test_parse_spec_grammar():
+    seed, rules = chaos.parse_spec(
+        "seed=9;round.generate=flake:p=0.25;"
+        "store.client.op=latency:delay_s=0.02,p=0.3;"
+        "fabric.peer_http=partition:peer=w-b;"
+        "queue.dispatch=wedge:after=3,times=1,wedge_s=2.5")
+    assert seed == 9
+    by_point = {r.point: r for r in rules}
+    assert by_point["round.generate"].kind == "flake"
+    assert by_point["round.generate"].p == 0.25
+    assert by_point["store.client.op"].delay_s == 0.02
+    assert by_point["fabric.peer_http"].peer == "w-b"
+    w = by_point["queue.dispatch"]
+    assert (w.after, w.times, w.wedge_s) == (3, 1, 2.5)
+
+
+def test_parse_spec_rejects_typos_loudly():
+    """A typo'd drill must fail at arm time, not inject nothing."""
+    with pytest.raises(ValueError, match="unknown fault point"):
+        chaos.parse_spec("round.generat=raise")
+    with pytest.raises(ValueError, match="unknown kind"):
+        chaos.parse_spec("round.generate=explode")
+    with pytest.raises(ValueError, match="unknown param"):
+        chaos.parse_spec("round.generate=raise:bogus=1")
+    with pytest.raises(ValueError):
+        chaos.parse_spec("just-a-token")
+
+
+def test_flake_defaults_to_half_probability():
+    _, rules = chaos.parse_spec("round.generate=flake")
+    assert rules[0].p == 0.5
+
+
+# -- seeded determinism (acceptance) ---------------------------------------
+
+def _drive(plan, point, n=30, peer=None):
+    for _ in range(n):
+        try:
+            plan.hit(point, peer)
+        except (ChaosInjected, ChaosPartition):
+            pass
+    return [(f["point"], f["hit"]) for f in plan.schedule()]
+
+
+def test_same_seed_replays_identical_schedule():
+    spec = "seed=5;round.generate=flake:p=0.4"
+    a = _drive(chaos.configure(spec), "round.generate")
+    chaos.disarm()
+    b = _drive(chaos.configure(spec), "round.generate")
+    assert a == b and a, "same seed must replay the same fault schedule"
+    chaos.disarm()
+    c = _drive(chaos.configure("seed=6;round.generate=flake:p=0.4"),
+               "round.generate")
+    assert a != c
+
+
+def test_schedule_is_independent_across_points():
+    """A point's fire/skip pattern is a pure function of ITS hit
+    sequence: interleaving hits to another point must not perturb it."""
+    spec = ("seed=3;round.generate=flake:p=0.4;"
+            "fabric.heartbeat=flake:p=0.4")
+    plan = chaos.configure(spec)
+    solo = _drive(plan, "round.generate")
+    chaos.disarm()
+    plan = chaos.configure(spec)
+    for i in range(30):
+        for point in ("fabric.heartbeat", "round.generate"):
+            try:
+                plan.hit(point)
+            except ChaosInjected:
+                pass
+    interleaved = [(f["point"], f["hit"]) for f in plan.schedule()
+                   if f["point"] == "round.generate"]
+    assert interleaved == solo
+
+
+# -- kind semantics --------------------------------------------------------
+
+def test_raise_after_times_and_peer_scoping():
+    plan = chaos.configure(
+        "seed=1;fabric.peer_http=partition:peer=w-b,after=1,times=2")
+    # wrong peer never consumes the schedule
+    plan.hit("fabric.peer_http", peer="w-a")
+    plan.hit("fabric.peer_http", peer="w-b")        # after=1: skipped
+    with pytest.raises(ChaosPartition) as exc:
+        plan.hit("fabric.peer_http", peer="w-b")
+    assert isinstance(exc.value, ConnectionError)   # failover paths engage
+    with pytest.raises(ChaosPartition):
+        plan.hit("fabric.peer_http", peer="w-b")
+    plan.hit("fabric.peer_http", peer="w-b")        # times=2 exhausted
+    assert len(plan.schedule()) == 2
+
+
+def test_latency_uses_injectable_sleep():
+    slept = []
+    chaos.configure("seed=1;store.client.op=latency:delay_s=0.25",
+                    sleep=slept.append)
+    chaos.fault_point("store.client.op")
+    assert slept == [0.25]
+
+
+def test_async_latency_and_raise():
+    chaos.configure("seed=1;round.generate=latency:delay_s=0.0,times=1;"
+                    "round.generate=raise:times=1")
+
+    async def run():
+        await chaos.afault_point("round.generate")   # latency, returns
+        with pytest.raises(ChaosInjected):
+            await chaos.afault_point("round.generate")
+        await chaos.afault_point("round.generate")   # both exhausted
+
+    asyncio.run(run())
+
+
+def test_wedge_blocks_until_released():
+    chaos.configure("seed=1;queue.dispatch=wedge:times=1,wedge_s=10")
+    entered = threading.Event()
+    done = threading.Event()
+
+    def wedged():
+        entered.set()
+        chaos.fault_point("queue.dispatch")
+        done.set()
+
+    t = threading.Thread(target=wedged, daemon=True)
+    t.start()
+    assert entered.wait(1.0)
+    assert not done.wait(0.2), "wedge must hold until released"
+    assert chaos.release("queue.dispatch") == 1
+    assert done.wait(2.0), "release must unblock the wedge"
+    t.join(timeout=2.0)
+
+
+# -- the zero-overhead disarmed contract (acceptance) ----------------------
+
+def test_disarmed_fault_point_is_a_noop_with_no_measurable_work():
+    assert not chaos.armed()
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        chaos.fault_point("round.generate")
+    elapsed = time.perf_counter() - t0
+    # one module-global None check per call: generous bound is 5µs/call
+    # even on a loaded 2-core CI host (measured ~0.1µs)
+    assert elapsed < 1.0, f"{n} disarmed calls took {elapsed:.2f}s"
+    # the async form allocates NO coroutine while disarmed: it returns
+    # one shared done-awaitable (identity-pinned so a refactor can't
+    # silently reintroduce per-call allocation)
+    assert chaos.afault_point("round.generate") is \
+        chaos.afault_point("fabric.heartbeat")
+
+
+# -- arming surfaces -------------------------------------------------------
+
+def test_configure_from_env_and_config(monkeypatch):
+    from cassmantle_tpu.config import ChaosConfig
+
+    monkeypatch.setenv(chaos.CHAOS_ENV,
+                       "seed=4;round.generate=raise:times=0")
+    plan = chaos.configure_from_env(ChaosConfig(spec=""))
+    assert plan is not None and plan.seed == 4
+    monkeypatch.delenv(chaos.CHAOS_ENV)
+    plan = chaos.configure_from_env(
+        ChaosConfig(spec="fabric.heartbeat=raise:times=0", seed=11))
+    assert plan is not None and plan.seed == 11
+    assert chaos.configure_from_env(ChaosConfig()) is None
+    assert not chaos.armed()
+
+
+@pytest.mark.asyncio
+async def test_readyz_and_healthz_carry_chaos_block_when_armed():
+    """A drill can never be mistaken for an incident: both probe
+    surfaces say a plan is armed, and say nothing when it is not."""
+    import dataclasses
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.engine.content import (
+        FakeContentBackend,
+        hash_embed,
+        hash_similarity,
+    )
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.server.app import create_app
+
+    cfg = test_config()
+    cfg = cfg.replace(game=dataclasses.replace(
+        cfg.game, rate_limit_default=1e6, rate_limit_api=1e6))
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=16),
+                hash_embed, hash_similarity)
+    client = TestClient(TestServer(create_app(game, cfg,
+                                              start_timer=False)))
+    await client.start_server()
+    try:
+        for route in ("/readyz", "/healthz"):
+            body = await (await client.get(route)).json()
+            sup = body if route == "/readyz" else body["supervisor"]
+            assert "chaos" not in sup
+        chaos.configure("seed=2;round.generate=raise:times=0")
+        for route in ("/readyz", "/healthz"):
+            res = await client.get(route)
+            assert res.status == 200, "an armed plan is NOT degradation"
+            body = await res.json()
+            sup = body if route == "/readyz" else body["supervisor"]
+            assert sup["chaos"]["armed"] is True
+            assert sup["chaos"]["seed"] == 2
+    finally:
+        await client.close()
+
+
+def test_create_app_arms_from_config_spec():
+    """ChaosConfig.spec arms at app build (CASSMANTLE_CHAOS wins when
+    both are set; configure_from_env is covered above)."""
+    import dataclasses
+
+    from cassmantle_tpu.config import ChaosConfig, test_config
+    from cassmantle_tpu.engine.content import (
+        FakeContentBackend,
+        hash_embed,
+        hash_similarity,
+    )
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.engine.store import MemoryStore
+    from cassmantle_tpu.server.app import create_app
+
+    cfg = test_config().replace(chaos=ChaosConfig(
+        spec="fabric.heartbeat=raise:times=0", seed=13))
+    game = Game(cfg, MemoryStore(), FakeContentBackend(image_size=16),
+                hash_embed, hash_similarity)
+    create_app(game, cfg, start_timer=False)
+    assert chaos.armed() and chaos.plan().seed == 13
+
+
+# -- fault-point registry lint (satellite) ---------------------------------
+
+def _lint(source, **kw):
+    from cassmantle_tpu.analysis.core import parse_source, run_passes
+    from cassmantle_tpu.analysis.faultpoints import FaultPointPass
+
+    registry = kw.pop("registry", {p: 1 for p in chaos.FAULT_POINTS})
+    kw.setdefault("check_orphans", False)   # single-fixture walks
+    return run_passes(
+        [parse_source(source)],
+        [FaultPointPass(registry=registry, **kw)])
+
+
+def test_faultpoint_lint_flags_unregistered_and_dynamic_names():
+    bad = _lint("from cassmantle_tpu.chaos import fault_point\n"
+                "def f():\n"
+                "    fault_point('no.such.point')\n")
+    assert len(bad) == 1 and "no row" in bad[0].message
+    dyn = _lint("from cassmantle_tpu.chaos import afault_point\n"
+                "async def f(name):\n"
+                "    await afault_point(name)\n")
+    assert len(dyn) == 1 and "literal" in dyn[0].message
+    clean = _lint("from cassmantle_tpu.chaos import fault_point\n"
+                  "def f():\n"
+                  "    fault_point('round.generate')\n")
+    assert clean == []
+
+
+def test_faultpoint_lint_reports_stale_registry_rows():
+    from cassmantle_tpu.analysis.core import parse_source, run_passes
+    from cassmantle_tpu.analysis.faultpoints import FaultPointPass
+
+    findings = run_passes(
+        [parse_source("x = 1\n")],
+        [FaultPointPass(registry={"ghost.point": 7},
+                        check_orphans=True)])
+    assert len(findings) == 1 and "stale" in findings[0].message
+    # scoped runs skip the orphan direction (tools/lint_all.py)
+    findings = run_passes(
+        [parse_source("x = 1\n")],
+        [FaultPointPass(registry={"ghost.point": 7},
+                        check_orphans=False)])
+    assert findings == []
+
+
+def test_repo_fault_points_match_docs_registry_and_core_table():
+    """Three-way sync: the docs/CHAOS.md registry, the literals wired
+    into the package, and chaos.FAULT_POINTS (what plans validate
+    against) must all agree — the whole-package lint run is the
+    tier-1 gate."""
+    from cassmantle_tpu.analysis.core import (
+        PACKAGE,
+        iter_modules,
+        run_passes,
+    )
+    from cassmantle_tpu.analysis.faultpoints import (
+        FaultPointPass,
+        load_registry,
+    )
+
+    registry = load_registry()
+    assert set(registry) == set(chaos.FAULT_POINTS)
+    fp = FaultPointPass()
+    findings = run_passes(iter_modules(PACKAGE), [fp])
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert fp._seen == set(chaos.FAULT_POINTS)
+
+
+def test_lint_all_includes_faultpoint_pass():
+    import tools.lint_all as lint_all
+    from cassmantle_tpu.analysis.faultpoints import FaultPointPass
+
+    passes = lint_all.all_passes()
+    assert any(isinstance(p, FaultPointPass) for p in passes)

@@ -10,6 +10,7 @@ and assert the game keeps serving through all of them.
 
 import asyncio
 import dataclasses
+import json
 import random
 
 import pytest
@@ -351,6 +352,144 @@ async def test_hung_scorer_dispatch_fails_at_deadline_not_forever():
         await q.submit("wedge")
     release.set()                       # unwedge the disowned call
     await q.stop()
+
+
+# -- the same drills on REAL chaos fault points (ISSUE 12) -----------------
+# The monkeypatch setups above predate the chaos subsystem; these ports
+# drive the identical degradation paths through the armed plan instead
+# of swapping backends — what `CASSMANTLE_CHAOS` does to a live worker.
+
+@pytest.fixture()
+def _chaos():
+    from cassmantle_tpu import chaos
+
+    chaos.disarm()
+    yield chaos
+    chaos.disarm()
+
+
+@pytest.mark.asyncio
+async def test_chaos_point_transient_failure_recovers_via_retry(_chaos):
+    """The FlakyBackend(failures=1) drill via the ``round.generate``
+    fault point: one injected failure, the retry absorbs it, the round
+    generates — and the backend itself was only dialed once (the
+    injection fires BEFORE the device dial)."""
+    backend = FakeContentBackend(image_size=32)
+    game = make_game(backend)
+    _chaos.configure("seed=1;round.generate=raise:times=1")
+    await game.rounds.startup()
+    assert await game.rounds.fetch_current_prompt() is not None
+    assert backend.calls == 1
+    assert [f["point"] for f in _chaos.plan().schedule()] \
+        == ["round.generate"]
+
+
+@pytest.mark.asyncio
+async def test_chaos_dead_generation_trips_breaker_then_recovers(_chaos):
+    """The DeadBackend drill via chaos: a p=1 flake trips the breaker
+    (no backend dials while open), degraded promotions rotate the
+    reserve, and DISARMING the plan is the 'device heals' lever — the
+    half-open probe restores fresh rounds."""
+    backend = FakeContentBackend(image_size=32)
+    game = make_game(backend, retries=2)
+    breaker = arm_fast_breaker(game, threshold=2, reset_s=0.05)
+    game.rounds.rng = random.Random(42)
+    await game.rounds.startup()
+    for _ in range(2):
+        await game.rounds.buffer_contents()
+        await game.rounds.rollover()
+    assert await game.reserve.size() == 3
+    dials_before = backend.calls
+
+    _chaos.configure("seed=1;round.generate=raise")
+    await game.rounds.buffer_contents()      # both retries injected
+    assert breaker.state == "open"
+    assert backend.calls == dials_before     # injection precedes dials
+    before = await game.rounds.fetch_current_prompt()
+    await game.rounds.rollover()             # reserve rotation
+    after = await game.rounds.fetch_current_prompt()
+    assert after["tokens"] != before["tokens"]
+
+    _chaos.disarm()                          # the device heals
+    await asyncio.sleep(0.1)
+    assert breaker.state == "half_open"
+    await game.rounds.buffer_contents()      # probe succeeds, closes
+    assert breaker.state == "closed"
+    assert backend.calls > dials_before
+
+
+@pytest.mark.asyncio
+async def test_chaos_wedged_dispatch_deadline_then_watchdog(_chaos):
+    """The wedged-scorer drill via the ``queue.dispatch`` fault point:
+    the wedge holds the REAL dispatch thread, the pending submit fails
+    at its deadline, the watchdog declares the wedge (supervisor
+    overrun + thread replacement), and a released plan serves the next
+    batch on the fresh thread."""
+    from cassmantle_tpu.serving.queue import (
+        BatchingQueue,
+        DeadlineExceeded,
+        _DispatchWorker,
+    )
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    _chaos.configure("seed=1;queue.dispatch=wedge:times=1,wedge_s=10")
+    sup = ServingSupervisor(degraded_cooldown_s=30.0)
+    q = BatchingQueue(lambda items: [0.0 for _ in items], max_batch=4,
+                      max_delay_ms=1, default_deadline_s=0.2,
+                      hang_timeout_s=0.3, supervisor=sup,
+                      name="chaosscore",
+                      dispatcher=_DispatchWorker(
+                          name="chaos.dispatch_worker"))
+    with pytest.raises(DeadlineExceeded):
+        await q.submit("wedge-me")
+    deadline = asyncio.get_running_loop().time() + 5.0
+    while not sup.status()["watchdog"]["overruns"] and \
+            asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.05)
+    assert sup.status()["watchdog"]["overruns"] >= 1
+    assert sup.degraded
+    _chaos.release("queue.dispatch")
+    assert await q.submit("after") == 0.0    # fresh thread dispatches
+    await q.stop()
+
+
+@pytest.mark.asyncio
+async def test_interrupted_promotion_retry_finishes_without_double_promote():
+    """Idempotent promotion (ISSUE 12): a worker killed after the
+    current-slot writes + promoted_gen marker but before the cleanup
+    leaves 'next' in place. The retrying promote must FINISH the tail —
+    image version bumped (clients refetch), episode advanced ONCE,
+    buffer cleaned — and never re-run the promotion."""
+    store = MemoryStore()
+    game = make_game(FakeContentBackend(image_size=32), store=store)
+    await game.rounds.startup()
+    await game.rounds.buffer_contents()
+
+    # simulate the crash window: current slots + marker written, then
+    # death before version bump / buffer cleanup
+    prompt_next = await store.hget("prompt", "next")
+    image_next = await store.hget("image", "next")
+    next_gen = await store.hget("prompt", "next_gen")
+    assert next_gen is not None
+    await store.hset("prompt", "current", prompt_next)
+    await store.hset("image", "current", image_next)
+    await store.hset("prompt", "promoted_gen", next_gen)
+    episode = int(await store.hget("story", "episode"))
+    version = await game.rounds.current_image_version()
+
+    await game.rounds.promote_buffer()       # the retry
+    assert await store.hget("prompt", "next") is None
+    assert await store.hget("prompt", "next_gen") is None
+    assert await game.rounds.current_image_version() > version
+    assert int(await store.hget("story", "episode")) == episode + 1
+    served = await game.rounds.fetch_current_prompt()
+    assert json.loads(prompt_next.decode())["tokens"] \
+        == served["tokens"]
+
+    # a FURTHER promote with no buffer replays; the episode counter
+    # must not creep
+    await game.rounds.promote_buffer()
+    assert int(await store.hget("story", "episode")) == episode + 1
 
 
 @pytest.mark.asyncio

@@ -239,24 +239,37 @@ async def test_readyz_ok_then_degraded_then_recovered():
 
 
 @pytest.mark.asyncio
-async def test_compute_score_sheds_when_score_breaker_open():
-    """An open score breaker sheds /compute_score with 503 + Retry-After
-    (honest degradation) instead of floor scores that read as 'every
-    guess is wrong'."""
+async def test_compute_score_degrades_through_hedge_ladder():
+    """ISSUE 12 failover ladder at the HTTP layer: with the score
+    breaker open and NO fabric peers, /compute_score answers 200 with
+    floor-grade scores marked ``X-Score-Degraded`` (floor is the LAST
+    resort, not a 503 to the player) — while a request that is itself
+    a peer's HEDGE sheds 503 + Retry-After so hedges can never
+    cascade. Recovery drops the marker."""
     client, game = await make_client(make_cfg())
     try:
         await client.get("/init")
         breaker = game.supervisor.score_breaker
         for _ in range(breaker.failure_threshold):
             breaker.record_failure()
+        # a player request: no healthy peer exists (legacy one-worker
+        # wrap) so the ladder bottoms out at marked floor scores
         res = await client.post("/compute_score",
                                 json={"inputs": {"0": "word"}})
+        assert res.status == 200
+        assert res.headers["X-Score-Degraded"] == "floor"
+        # a HEDGED request must not re-hedge or floor: honest 503 so
+        # the origin worker tries its next peer
+        res = await client.post("/compute_score",
+                                json={"inputs": {"0": "word"}},
+                                headers={"X-Score-Hedge": "1"})
         assert res.status == 503
         assert int(res.headers["Retry-After"]) >= 1
         breaker.record_success()
         res = await client.post("/compute_score",
                                 json={"inputs": {"0": "word"}})
         assert res.status == 200
+        assert "X-Score-Degraded" not in res.headers
     finally:
         await client.close()
 

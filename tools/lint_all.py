@@ -1,14 +1,15 @@
 """All static passes, one exit code: metrics + concurrency + jax +
-env flags.
+env flags + fault points.
 
 The single CI/pre-commit gate: runs the metric-name pass
 (``tools/check_metrics.py``), the three concurrency passes
-(``tools/check_concurrency.py``), and the four JAX dispatch-discipline
+(``tools/check_concurrency.py``), the four JAX dispatch-discipline
 passes (``tools/check_jax.py`` — recompile hazards, tracer leaks,
-buffer escapes, env-flag registry) over the package in one module
-walk, and exits 1 if any pass finds anything. Gated as a fast-tier
-test via ``tests/test_check_concurrency.py`` and
-``tests/test_check_jax.py``.
+buffer escapes, env-flag registry), and the fault-point registry pass
+(``analysis/faultpoints.py`` vs docs/CHAOS.md) over the package in one
+module walk, and exits 1 if any pass finds anything. Gated as a
+fast-tier test via ``tests/test_check_concurrency.py``,
+``tests/test_check_jax.py``, and ``tests/test_chaos.py``.
 
 Run standalone: ``python tools/lint_all.py [cassmantle_tpu/] [--json]``.
 """
@@ -23,13 +24,23 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from cassmantle_tpu.analysis.core import PACKAGE, main_for  # noqa: E402
+from cassmantle_tpu.analysis.faultpoints import FaultPointPass  # noqa: E402
 from cassmantle_tpu.analysis.lockorder import default_passes  # noqa: E402
 from cassmantle_tpu.analysis.metric_names import MetricNamePass  # noqa: E402
 from tools.check_jax import jax_passes  # noqa: E402
 
 
 def all_passes(root=PACKAGE):
-    return [MetricNamePass(), *default_passes(), *jax_passes(root)]
+    # same whole-package rule as the env-flag orphan check: "registered
+    # but never called" is only meaningful when the walk covers the
+    # package (tools/check_jax.py jax_passes documents the pattern)
+    try:
+        covers_package = PACKAGE.resolve().is_relative_to(
+            pathlib.Path(root).resolve())
+    except AttributeError:  # pragma: no cover - py<3.9
+        covers_package = True
+    return [MetricNamePass(), *default_passes(), *jax_passes(root),
+            FaultPointPass(check_orphans=covers_package)]
 
 
 def main(argv=None) -> int:
