@@ -1546,6 +1546,261 @@ def bench_chaos_drill(weights_dir: str) -> dict:
     }
 
 
+# -- overload drill (ISSUE 13): ramp load past capacity, watch the -------
+# -- control plane plateau instead of collapse ---------------------------
+
+def _overload_worker_main(port: int, batch_ms: float, bucket: int,
+                          round_seconds: float) -> None:
+    """Child process for the overload drill: ONE fabric worker, fake
+    content backend, the fake scorer behind a REAL BatchingQueue whose
+    handler holds the dispatch thread ``batch_ms`` per batch (known
+    capacity = bucket / batch_s items/sec), with drill-tight latency
+    targets, deadlines, and SLO windows so adaptive admission and the
+    brownout ladder act within a ~10 s drill instead of a ~10 min
+    incident. No jax import (same contract as the rooms_load worker)."""
+    import dataclasses
+
+    from aiohttp import web
+
+    from cassmantle_tpu.config import FrameworkConfig
+    from cassmantle_tpu.server.app import build_fabric, create_app
+
+    cfg = FrameworkConfig()
+    cfg = cfg.replace(
+        game=dataclasses.replace(
+            cfg.game, time_per_prompt=round_seconds, lock_timeout=10.0,
+            acquire_timeout=0.5, rate_limit_default=1e6,
+            rate_limit_api=1e6),
+        serving=dataclasses.replace(
+            cfg.serving,
+            fake_score_batch_ms=batch_ms,
+            score_batch_sizes=(bucket,),
+            max_queue_delay_ms=5.0,
+            submit_deadline_s=1.5,
+            queue_latency_target_s=0.5,
+            admission_min_pending=4,
+            # the drill saturates the host CPU by design; the loop-lag
+            # leg is covered by units (tests/test_overload.py), so keep
+            # it from double-firing here
+            loop_lag_shed_s=2.0,
+            brownout_step_up_dwell_s=0.5,
+            brownout_step_down_dwell_s=0.5,
+        ),
+        obs=dataclasses.replace(
+            cfg.obs,
+            slo_eval_interval_s=0.25,
+            slo_fast_window_s=1.5,
+            slo_slow_window_s=3.0,
+            slo_score_p99_s=0.2),
+    )
+    fabric = build_fabric(cfg, fake=True)
+    web.run_app(create_app(fabric, cfg), host="127.0.0.1", port=port,
+                print=None)
+
+
+async def _overload_drive(base_url: str, phases, sessions: int) -> dict:
+    """Open-loop synthetic load: each phase fires /compute_score POSTs
+    at a fixed arrival rate WITHOUT waiting for completions (a closed
+    loop would self-throttle and never overload anything). Tracks per
+    phase: accepted latencies, rejection latencies + their Retry-After
+    values, and the brownout tier (sampled from /metrics)."""
+    import asyncio
+
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=10.0)
+    out = {"phases": {}}
+    async with aiohttp.ClientSession(timeout=timeout) as http:
+        sids = [f"ovl-{i}" for i in range(sessions)]
+        masks = [0]
+        for sid in sids:
+            q = f"?session={sid}"
+            async with http.get(base_url + "/init" + q) as res:
+                await res.json()
+        async with http.get(base_url + "/fetch/contents"
+                            + f"?session={sids[0]}") as res:
+            masks = (await res.json())["prompt"]["masks"] or [0]
+
+        tier_seen = [0.0]
+
+        async def tier_sampler(stop: asyncio.Event) -> None:
+            while not stop.is_set():
+                try:
+                    async with http.get(base_url + "/metrics") as res:
+                        gauges = (await res.json())["gauges"]
+                    tier_seen[0] = max(
+                        tier_seen[0],
+                        float(gauges.get("overload.brownout_tier", 0.0)))
+                except Exception:
+                    pass
+                try:
+                    await asyncio.wait_for(stop.wait(), 0.25)
+                except asyncio.TimeoutError:
+                    pass
+
+        async def one_request(i: int, rec: dict) -> None:
+            sid = sids[i % len(sids)]
+            t0 = time.perf_counter()
+            try:
+                async with http.post(
+                    base_url + f"/compute_score?session={sid}",
+                    json={"inputs": {str(masks[0]): f"guess{i}"}},
+                ) as res:
+                    ms = (time.perf_counter() - t0) * 1000.0
+                    if res.status == 200:
+                        await res.json()
+                        rec["accepted_ms"].append(ms)
+                    elif res.status in (429, 503):
+                        rec["rejected_ms"].append(ms)
+                        ra = res.headers.get("Retry-After")
+                        if ra is not None:
+                            rec["retry_after_s"].append(float(ra))
+                    else:
+                        rec["errors"] += 1
+            except Exception:
+                rec["errors"] += 1
+
+        for name, rate, seconds in phases:
+            rec = {"accepted_ms": [], "rejected_ms": [],
+                   "retry_after_s": [], "errors": 0,
+                   "rate": rate, "seconds": seconds}
+            stop = asyncio.Event()
+            sampler = asyncio.ensure_future(tier_sampler(stop))
+            tier_seen[0] = 0.0
+            tasks = []
+            interval = 1.0 / rate
+            t_start = time.monotonic()
+            i = 0
+            while True:
+                due = t_start + i * interval
+                now = time.monotonic()
+                if due - now > 0:
+                    await asyncio.sleep(due - now)
+                if time.monotonic() - t_start >= seconds:
+                    break
+                tasks.append(asyncio.ensure_future(one_request(i, rec)))
+                i += 1
+            await asyncio.gather(*tasks, return_exceptions=True)
+            stop.set()
+            await sampler
+            rec["elapsed_s"] = time.monotonic() - t_start
+            rec["max_tier"] = tier_seen[0]
+            rec["goodput_per_s"] = (len(rec["accepted_ms"])
+                                    / rec["elapsed_s"])
+            out["phases"][name] = rec
+        # the post-drill verdict: the /readyz overload block + final tier
+        async with http.get(base_url + "/readyz") as res:
+            body = await res.json()
+        out["overload_block"] = body.get("overload", {})
+        async with http.get(base_url + "/metrics") as res:
+            gauges = (await res.json())["gauges"]
+        out["final_tier"] = float(gauges.get("overload.brownout_tier",
+                                             0.0))
+    return out
+
+
+def overload_drill_run(batch_ms: float = 100.0, bucket: int = 4,
+                       base_port: int = 8571, sessions: int = 6,
+                       baseline_s: float = 3.0, overload_s: float = 5.0,
+                       recovery_s: float = 5.0,
+                       round_seconds: float = 30.0) -> dict:
+    """Spawn the drill worker and ramp: ~0.4x capacity (baseline), 2x
+    (overload), ~0.2x (recovery). Capacity = bucket / batch_s. Shared
+    by ``bench.py overload_drill`` and the tier-1 goodput smoke
+    (tests/test_overload.py)."""
+    import asyncio
+    import multiprocessing
+    import urllib.request
+
+    capacity = bucket / (batch_ms / 1000.0)
+    phases = [
+        ("baseline", 0.4 * capacity, baseline_s),
+        ("overload", 2.0 * capacity, overload_s),
+        ("recovery", 0.2 * capacity, recovery_s),
+    ]
+    ctx = multiprocessing.get_context("spawn")
+    url = f"http://127.0.0.1:{base_port}"
+    p = ctx.Process(target=_overload_worker_main,
+                    args=(base_port, batch_ms, bucket, round_seconds),
+                    daemon=True)
+    p.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=2.0) as res:
+                    if res.status == 200:
+                        break
+            except Exception:
+                pass
+            if time.monotonic() >= deadline:
+                raise RuntimeError("overload worker never became healthy")
+            time.sleep(0.1)
+        raw = asyncio.run(_overload_drive(url, phases, sessions))
+    finally:
+        p.terminate()
+        p.join(timeout=5.0)
+    raw.update(capacity_per_s=capacity, batch_ms=batch_ms,
+               bucket=bucket)
+    return raw
+
+
+def _pctl(values, q: float) -> float:
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    return float(vs[min(len(vs) - 1, int(len(vs) * q))])
+
+
+def bench_overload_drill(weights_dir: str) -> dict:
+    """ISSUE 13's proof: goodput under 2x sustained load plateaus at
+    capacity instead of collapsing, accepted p99 stays inside the
+    deadline budget, rejections fail fast with a computed Retry-After,
+    and the brownout ladder engages under burn and recovers with
+    hysteresis. Knobs: BENCH_OVERLOAD_BATCH_MS / BENCH_OVERLOAD_BUCKET
+    / BENCH_OVERLOAD_SECONDS / BENCH_OVERLOAD_BASE_PORT (env)."""
+    env = os.environ.get
+    seconds = float(env("BENCH_OVERLOAD_SECONDS", "5"))
+    raw = overload_drill_run(
+        batch_ms=float(env("BENCH_OVERLOAD_BATCH_MS", "100")),
+        bucket=int(env("BENCH_OVERLOAD_BUCKET", "4")),
+        base_port=int(env("BENCH_OVERLOAD_BASE_PORT", "8571")),
+        baseline_s=max(3.0, seconds * 0.6),
+        overload_s=seconds,
+        recovery_s=seconds,
+    )
+    phases = {}
+    for name, rec in raw["phases"].items():
+        phases[name] = {
+            "offered_per_s": round(rec["rate"], 1),
+            "goodput_per_s": round(rec["goodput_per_s"], 1),
+            "accepted": len(rec["accepted_ms"]),
+            "rejected": len(rec["rejected_ms"]),
+            "errors": rec["errors"],
+            "accepted_p50_ms": round(_pctl(rec["accepted_ms"], 0.5), 1),
+            "accepted_p99_ms": round(_pctl(rec["accepted_ms"], 0.99), 1),
+            "reject_p50_ms": round(_pctl(rec["rejected_ms"], 0.5), 1),
+            "retry_after_min_s": (min(rec["retry_after_s"])
+                                  if rec["retry_after_s"] else None),
+            "max_brownout_tier": rec["max_tier"],
+        }
+    over = phases["overload"]
+    base = phases["baseline"]
+    return {
+        "metric": "overload_drill_goodput_at_2x_per_s",
+        "value": over["goodput_per_s"],
+        "unit": "accepted req/s",
+        "vs_baseline": None,
+        "capacity_per_s": raw["capacity_per_s"],
+        "goodput_vs_baseline": (
+            round(over["goodput_per_s"] / base["goodput_per_s"], 2)
+            if base["goodput_per_s"] else None),
+        "final_brownout_tier": raw["final_tier"],
+        "phases": phases,
+    }
+
+
 # Counters whose per-entry deltas carry diagnostic weight: recompiles,
 # cache effectiveness, staged-serving churn, and every supervision
 # counter (suffix match). Attached to each BENCH_SUITE.json record so
@@ -1563,9 +1818,16 @@ _DELTA_COUNTERS = {
     # under CASSMANTLE_NO_ENCPROP, so the A/B deltas separate arms)
     "pipeline.encprop_key_steps", "pipeline.encprop_shallow_steps",
     "pipeline.encprop_prop_steps",
+    # overload control plane (ISSUE 13): brownout churn + shed totals
+    "overload.brownout_trips", "overload.brownout_recoveries",
+    "overload.score_shed", "overload.loop_lag_sheds",
+    "pipeline.brownout_images",
 }
 _DELTA_SUFFIXES = (".dispatch_hangs", ".deadline_expired", ".rejected",
-                   ".rejected_degraded", ".failures", ".loop_errors")
+                   ".rejected_degraded", ".failures", ".loop_errors",
+                   # overload control plane (ISSUE 13)
+                   ".rejected_overload", ".rejected_predicted_late",
+                   ".rejected_background")
 
 
 def _counter_snapshot() -> dict:
@@ -1616,6 +1878,7 @@ SUITE = {
     "soak": bench_soak,
     "rooms_load": bench_rooms_load,
     "chaos_drill": bench_chaos_drill,
+    "overload_drill": bench_overload_drill,
 }
 
 # ``--north-star-only`` measures exactly these, with BENCH_ROUNDS=1

@@ -85,6 +85,10 @@ FAULT_POINTS: Dict[str, str] = {
                         "(server/app.py; peer=worker id)",
     "score.hedge": "cross-worker scorer hedge attempt "
                    "(server/app.py; peer=worker id)",
+    "server.admit": "queue admission decision "
+                    "(serving/queue.py submit; peer=queue name)",
+    "overload.brownout": "brownout-ladder tier evaluation "
+                         "(serving/overload.py)",
 }
 
 KINDS = ("raise", "flake", "latency", "wedge", "partition")

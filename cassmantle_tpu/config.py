@@ -355,6 +355,45 @@ class ServingConfig:
     # Tightened admission bound while the supervisor reports degraded —
     # a sick device gets a short queue, not max_pending of doomed work.
     degraded_max_pending: int = 256
+    # -- overload control plane (serving/overload.py; ISSUE 13) ------------
+    # Adaptive (AIMD) admission per queue: the effective pending bound
+    # tracks measured queue-wait + batch-service latency against this
+    # target, between admission_min_pending and max_pending. Rejections
+    # carry a COMPUTED Retry-After (predicted wait = depth × observed
+    # per-item service time) and predicted-late submissions fail at
+    # submit. CASSMANTLE_NO_ADAPTIVE_ADMISSION=1 reverts to the static
+    # max_pending/degraded_max_pending pair.
+    queue_latency_target_s: float = 1.0
+    admission_min_pending: int = 8
+    # Background work (round generation, reserve refill, bench) sheds
+    # at this fraction of the adaptive limit — first under pressure.
+    admission_background_fraction: float = 0.5
+    # Starvation bound for the background tier: after this many
+    # consecutive batches dispatched with background work pending, the
+    # oldest background item heads the next batch (rounds keep rotating
+    # under sustained interactive load).
+    background_every_batches: int = 8
+    # Event-loop saturation threshold: when the server.loop_lag_s
+    # sleep-overshoot gauge (obs/process.py) exceeds this, background
+    # submissions shed BEFORE queues back up (interactive sheds at 4x).
+    loop_lag_shed_s: float = 0.25
+    # -- SLO-driven brownout ladder (serving/overload.py) ------------------
+    # Dwell before stepping UP a quality tier on sustained fast-window
+    # burn, and — the hysteresis — before stepping DOWN after the slow
+    # window recovers. CASSMANTLE_NO_BROWNOUT=1 pins tier 0.
+    brownout_step_up_dwell_s: float = 10.0
+    brownout_step_down_dwell_s: float = 30.0
+    # SLO objectives the ladder watches (obs/slo.py default_objectives
+    # names); replication lag is deliberately absent — quality tiers
+    # cannot fix a store problem.
+    brownout_objectives: Tuple[str, ...] = ("score_latency",
+                                            "round_generation")
+    # Drill/test stand-in for device scoring cost on the FAKE backend:
+    # >0 routes fake similarity through a real BatchingQueue whose
+    # handler holds the dispatch thread this long per batch — what lets
+    # `bench.py overload_drill` exercise the real admission path on a
+    # CPU-only host. 0 (the default) keeps the instant hash scorer.
+    fake_score_batch_ms: float = 0.0
     # -- stage-disaggregated image serving (serving/stages.py) -------------
     # Split the image path into encode / denoise / decode stages, each
     # independently batched, with the denoise stage running step-level

@@ -57,6 +57,16 @@ class FakeContentBackend(ContentBackend):
         text = template_text(seed)
         digest = hashlib.sha256(seed.encode()).digest()
         size = self.image_size
+        # brownout actuation (serving/overload.py, ISSUE 13): the fake
+        # backend honors the resolution-downshift tier like the real
+        # pipelines, so an overload drill against --fake workers can
+        # observe quality degradation end to end (lazy import — the
+        # engine layer must stay importable without serving)
+        from cassmantle_tpu.serving.overload import quality_overrides
+
+        tier = quality_overrides()
+        if tier is not None and tier.image_size_scale != 1.0:
+            size = max(16, int(size * tier.image_size_scale))
         y, x = np.mgrid[0:size, 0:size]
         r = (x * int(digest[5]) // size) % 256
         g = (y * int(digest[6]) // size) % 256

@@ -186,7 +186,14 @@ class Game:
         one decode+blur+encode. (Version 0 = legacy store: fall back to
         fingerprinting the bytes.)"""
         radius = await self._reveal_radius(session)
-        bucket = round(radius * 2.0) / 2.0
+        # blur-ladder quantum: 0.5 px normally; a brownout tier
+        # coarsens it (serving/overload.py) so a degraded round renders
+        # FEWER distinct decode+blur+encode buckets — coarse buckets
+        # round UP, so degradation only ever adds blur (ISSUE 13; lazy
+        # import, engine stays importable without serving)
+        from cassmantle_tpu.serving.overload import quantize_blur_radius
+
+        bucket = quantize_blur_radius(radius)
         ver: object = await self.rounds.current_image_version()
         legacy_raw: Optional[bytes] = None
         if ver == 0:

@@ -48,17 +48,26 @@ class ClusterMembership:
         self._lock = OrderedLock("fabric.membership", rank=6)
         self._live: Dict[str, dict] = {}
 
-    async def heartbeat(self, room_count: int = 0) -> Dict[str, dict]:
-        """Announce this worker and refresh the live view."""
+    async def heartbeat(self, room_count: int = 0,
+                        extra: Optional[Dict[str, object]] = None
+                        ) -> Dict[str, dict]:
+        """Announce this worker and refresh the live view. ``extra``
+        merges additional advertisement fields into the payload — the
+        fabric passes the worker's overload state (``shed``/``btier``,
+        serving/overload.py peer_advert) so peers stop hedging scorer
+        work into an already-shedding worker (ISSUE 13 satellite)."""
         # heartbeat fault point: a flake here ages this worker toward
         # the staleness TTL (peers see it leave and adopt its rooms) —
         # the membership-churn drill (docs/CHAOS.md)
         await afault_point("fabric.heartbeat")
-        payload = json.dumps({
+        info: Dict[str, object] = {
             "addr": self.addr,
             "rooms": int(room_count),
-            "t": self._clock(),
-        })
+        }
+        if extra:
+            info.update(extra)
+        info["t"] = self._clock()
+        payload = json.dumps(info)
         await self.store.hset(WORKERS_KEY, self.worker_id, payload)
         return await self.refresh()
 

@@ -534,7 +534,13 @@ class RoomFabric:
                 # not hold its loser forever and mint signatures no
                 # peer verifies
                 await self._ensure_cluster_key()
-                live = await self.membership.heartbeat(len(self._games))
+                # overload advertisement (serving/overload.py): peers
+                # read shed/btier from our heartbeat before hedging
+                # scorer work here (score.hedge_skipped_overloaded)
+                from cassmantle_tpu.serving.overload import peer_advert
+
+                live = await self.membership.heartbeat(
+                    len(self._games), extra=peer_advert())
                 await self._handle_moves(self._apply_membership(live))
             except asyncio.CancelledError:
                 raise

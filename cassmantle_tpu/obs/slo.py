@@ -157,6 +157,10 @@ class SloEngine:
                                        for o in self.objectives}
         self._last_eval: Optional[float] = None
         self._last: Dict[str, dict] = {}
+        # consumers called after each evaluation pass with the verdict
+        # dict (e.g. the brownout ladder, serving/overload.py); an
+        # actuation bug must never break the evaluation loop
+        self._listeners: list = []
         # the baseline: deltas measure from engine start, not from the
         # process's whole cumulative history
         self._samples.append((self._clock(), self._raw()))
@@ -271,7 +275,18 @@ class SloEngine:
         while len(self._samples) > 1 and self._samples[1][0] <= cut:
             self._samples.popleft()
         self._last = out
+        for listener in self._listeners:
+            try:
+                listener(out)
+            except Exception:
+                log.exception("slo listener failed; continuing")
         return out
+
+    def add_listener(self, fn: Callable[[Dict[str, dict]], None]) -> None:
+        """Subscribe a consumer to every evaluation pass (the brownout
+        ladder). Listeners run inside evaluate(), on whichever thread
+        called it — they must be fast and lock-light."""
+        self._listeners.append(fn)
 
     def status(self) -> Dict[str, object]:
         """The `/sloz` body and the `/readyz` advisory block (callers

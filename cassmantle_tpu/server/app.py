@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import math
 import os
 import re
 import tempfile
@@ -60,6 +61,8 @@ from cassmantle_tpu.obs.trace import (
     format_traceparent,
     parse_traceparent,
 )
+from cassmantle_tpu.serving import overload
+from cassmantle_tpu.serving.queue import OverloadShed
 from cassmantle_tpu.utils.logging import get_logger, merge_states, metrics
 
 log = get_logger("app")
@@ -301,6 +304,13 @@ async def tracing_middleware(request: web.Request, handler):
             # a prepared response (WS handshake already sent) can't
             # take new headers
             response.headers["X-Trace-Id"] = span.trace_id
+            tier = overload.current_tier()
+            if tier:
+                # honesty header (ISSUE 13): while the brownout ladder
+                # is degrading quality, every game response says so —
+                # clients and operators can tell a browned-out image
+                # from a generation bug
+                response.headers["X-Quality-Degraded"] = f"tier-{tier}"
         return response
 
 
@@ -333,9 +343,14 @@ def make_ratelimit_middleware(cfg: FrameworkConfig):
         principal = (_client_ip(request), room)
         if not limiter.allow(principal, request.path, rate):
             metrics.inc("http.rate_limited")
+            # Retry-After computed from THIS bucket's actual refill
+            # time (tokens missing / refill rate), not a constant 1 —
+            # a client that obeys it is admitted on its next try
+            # instead of bouncing off an empty bucket (ISSUE 13)
+            retry = limiter.retry_after_s(principal, request.path)
             raise web.HTTPTooManyRequests(
                 text="rate limit exceeded",
-                headers={"Retry-After": "1"})
+                headers={"Retry-After": str(max(1, math.ceil(retry)))})
         return await handler(request)
 
     return ratelimit
@@ -417,10 +432,19 @@ async def _hedge_score(request: web.Request, room: str, session: str,
         table = await fabric.membership.table()
     except Exception:
         return None
-    peers = [(worker, row["info"].get("addr"))
-             for worker, row in sorted(table.items())
-             if worker != fabric.worker_id and not row["stale"]
-             and row["info"].get("addr")]
+    peers = []
+    for worker, row in sorted(table.items()):
+        if worker == fabric.worker_id or row["stale"] or \
+                not row["info"].get("addr"):
+            continue
+        if row["info"].get("shed") or row["info"].get("btier"):
+            # the peer's own heartbeat already advertises overload
+            # (admission shedding / an engaged brownout tier,
+            # serving/overload.py peer_advert): hedging into it would
+            # trade a local floor score for a remote 503 — skip it
+            metrics.inc("score.hedge_skipped_overloaded")
+            continue
+        peers.append((worker, row["info"].get("addr")))
     http = _peer_session(request)
     attempts = 0
     for worker, addr in peers:
@@ -487,8 +511,20 @@ async def handle_compute_score(request: web.Request) -> web.Response:
         # scores (engine min_score), marked so clients/operators can
         # tell degradation from wrong guesses
     await game.ensure_client(session)
-    with metrics.timer("http.compute_score_s"):
-        scores = await game.compute_client_scores(session, inputs)
+    try:
+        with metrics.timer("http.compute_score_s"):
+            scores = await game.compute_client_scores(session, inputs)
+    except OverloadShed as exc:
+        # adaptive admission shed this request (serving/overload.py):
+        # answer in <50 ms with the COMPUTED Retry-After the limiter's
+        # predicted-wait estimator produced — a well-behaved client
+        # that obeys it lands when a slot is actually free
+        metrics.inc("overload.score_shed")
+        raise web.HTTPServiceUnavailable(
+            text="overloaded; retry later",
+            headers={"Retry-After":
+                     str(max(1, math.ceil(exc.retry_after_s))),
+                     "X-Overload-Shed": exc.reason})
     response = web.json_response(scores)
     if supervisor.shed_scores() or supervisor.device_unhealthy():
         response.headers["X-Score-Degraded"] = "floor"
@@ -846,6 +882,11 @@ async def handle_readyz(request: web.Request) -> web.Response:
     engine = request.app[_SLO]
     engine.evaluate()
     status["slo"] = engine.status()
+    # the overload control plane's live state (ISSUE 13): the brownout
+    # tier (also stamped on responses as X-Quality-Degraded) and every
+    # queue's adaptive admission limit — advisory like the SLO block;
+    # shedding/browning-out is the system WORKING, not a failure
+    status["overload"] = overload.status_block()
     if ready:
         return web.json_response(status)
     if status.get("state") != "draining":
@@ -992,6 +1033,9 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
         default_objectives(cfg),
         fast_window_s=cfg.obs.slo_fast_window_s,
         slow_window_s=cfg.obs.slo_slow_window_s)
+    # the SLO-driven brownout ladder (serving/overload.py) subscribes
+    # to every evaluation pass; CASSMANTLE_NO_BROWNOUT=1 pins tier 0
+    overload.configure_brownout(cfg, app[_SLO])
     app[_PROCESS] = ProcessMetrics()
     if device_health:
         from cassmantle_tpu.utils.health import DeviceHealth
@@ -1131,8 +1175,19 @@ def _serving_components(cfg: FrameworkConfig, fake: bool,
             hash_similarity,
         )
 
+        similarity = hash_similarity
+        if cfg.serving.fake_score_batch_ms > 0:
+            # overload-drill wiring (bench.py overload_drill): the fake
+            # scorer rides a REAL BatchingQueue whose handler simulates
+            # device batch cost, so synthetic load exercises the real
+            # admission/priority/Retry-After machinery on a CPU host
+            from cassmantle_tpu.serving.fake_scorer import (
+                FakeQueuedScorer,
+            )
+
+            similarity = FakeQueuedScorer(cfg, supervisor).similarity
         return FakeContentBackend(image_size=256), hash_embed, \
-            hash_similarity, None
+            similarity, None
     from cassmantle_tpu.serving.service import InferenceService
 
     service = InferenceService(cfg, weights_dir=weights_dir,

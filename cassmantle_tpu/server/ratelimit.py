@@ -43,6 +43,14 @@ class TokenBucket:
             return True
         return False
 
+    def retry_after_s(self) -> float:
+        """Seconds until this bucket refills one whole token — the
+        COMPUTED Retry-After a 429 should carry (ISSUE 13 satellite;
+        tokens were already refreshed by the failing allow())."""
+        if self.tokens >= 1.0 or self.rate <= 0:
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
 
 class RateLimiter:
     """Buckets keyed by (principal, class); stale buckets evicted on
@@ -83,3 +91,10 @@ class RateLimiter:
                 self._evict()
             bucket = self._buckets[key] = TokenBucket(rate)
         return bucket.allow()
+
+    def retry_after_s(self, principal: Principal,
+                      route_class: str) -> float:
+        """The rejecting bucket's actual refill time (0 when absent —
+        a race with eviction; the caller floors the header at 1)."""
+        bucket = self._buckets.get((principal, route_class))
+        return bucket.retry_after_s() if bucket is not None else 0.0

@@ -249,6 +249,51 @@ def note_encprop_counters(counts, n_images: int) -> None:
         metrics.inc("pipeline.encprop_prop_steps", props * n_images)
 
 
+def degraded_dispatch_variant(cache: dict, sampler_cfg, mesh,
+                              build_impl, log_):
+    """Shared brownout-variant machinery for BOTH image pipelines
+    (serving/overload.py, ISSUE 13): resolve the active tier into a
+    degraded SamplerConfig, build that delta's sampler + schedules +
+    jitted dispatch ONCE (cached by the (steps, stride, size) key — a
+    tier change never recompiles in steady state), and fall back to
+    full quality on any build failure. ``build_impl(scfg, sampler,
+    dc_schedule)`` returns the pipeline-specific sample impl; returns
+    ``(sample_fn, scfg, encprop_counts)`` or None (tier 0 / no-op
+    delta / unusable delta)."""
+    from cassmantle_tpu.serving import overload
+
+    tier = overload.quality_overrides()
+    if tier is None:
+        return None
+    try:
+        scfg = overload.degraded_sampler_cfg(sampler_cfg, tier)
+        if scfg == sampler_cfg:
+            return None
+        key = (scfg.num_steps, scfg.encprop_stride, scfg.image_size)
+        entry = cache.get(key)
+        if entry is None:
+            dc = deepcache_schedule(scfg) if scfg.deepcache else None
+            counts = None
+            if scfg.encprop:
+                from cassmantle_tpu.ops.ddim import encprop_step_counts
+
+                encprop_plan(scfg)
+                counts = encprop_step_counts(
+                    scfg.num_steps, scfg.encprop_stride,
+                    scfg.encprop_dense_steps, scfg.deepcache)
+            sampler = make_sampler(scfg.kind, scfg.num_steps,
+                                   eta=scfg.eta)
+            fn, _ = dp_sharded_sampler(build_impl(scfg, sampler, dc),
+                                       mesh)
+            entry = (fn, scfg, counts)
+            cache[key] = entry
+        return entry
+    except Exception:
+        log_.exception("brownout tier delta unusable for this config; "
+                       "serving full quality")
+        return None
+
+
 def pad_prompts_to_dp(prompts: Sequence[str], dp: int):
     """Pad a prompt list to a multiple of the dp width (equal per-device
     shards); callers drop the pad rows from the output."""
@@ -420,6 +465,13 @@ class Text2ImagePipeline:
         self._params = {"clip": self.clip_params, "unet": self.unet_params,
                         "vae": self.vae_params}
         self._sample, self.dp = dp_sharded_sampler(self._sample_impl, mesh)
+        # brownout actuation (serving/overload.py, ISSUE 13): degraded
+        # sampler variants keyed by their (steps, stride, size) delta —
+        # each TIER compiles once on first engagement and is reused
+        # (bucketed like every other serving variant), so steady-state
+        # tier changes never recompile. Tier 0 uses self._sample
+        # untouched: unloaded behavior is bit-for-bit the old path.
+        self._tier_fns: dict = {}
         # One in-flight device batch per pipeline: concurrent round
         # buffering calls generate() from multiple executor threads, and
         # the device executes serially regardless — serializing dispatch
@@ -521,6 +573,39 @@ class Text2ImagePipeline:
             self.cfg.models.clip_text.vocab_size,
         )
 
+    # -- brownout actuation (serving/overload.py, ISSUE 13) ----------------
+
+    def _build_tier_impl(self, scfg, sampler, dc):
+        """The SD1.5 sample impl bound to a degraded tier's config —
+        ``_sample_impl`` with (steps, stride, size) swapped."""
+
+        def impl(params, ids, uncond_ids, rng):
+            with annotate("clip_encode"):
+                ctx = self.clip.apply(params["clip"], ids)["hidden"]
+                uncond = self.clip.apply(params["clip"],
+                                         uncond_ids)["hidden"]
+            lat = initial_latents(rng, ids.shape[0], scfg.image_size,
+                                  self.vae_scale)
+            lat = spatially_shard_latents(lat, self.mesh)
+            with annotate("denoise_scan"):
+                final = run_cfg_denoise(
+                    scfg, sampler, dc, self.unet_apply,
+                    params["unet"], ctx, uncond, lat,
+                )
+            with annotate("vae_decode"):
+                decoded = self.vae.apply(params["vae"], final)
+            return postprocess_images(decoded)
+
+        return impl
+
+    def _degraded_sampler(self):
+        """(sample_fn, sampler_cfg, encprop_counts) for the active
+        brownout tier, or None at full quality (see
+        :func:`degraded_dispatch_variant`)."""
+        return degraded_dispatch_variant(
+            self._tier_fns, self.cfg.sampler, self.mesh,
+            self._build_tier_impl, log)
+
     def generate(self, prompts: Sequence[str], seed: int = 0,
                  deadline_s: Optional[float] = None) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. One compiled graph per batch.
@@ -532,27 +617,36 @@ class Text2ImagePipeline:
         ``deadline_s`` is honored at step boundaries on the staged path
         (an expired request frees its denoise slot); the monolithic
         dispatch is all-or-nothing and ignores it."""
-        if self._staged_enabled():
+        # brownout tier first: a degraded delta routes to its own
+        # monolithic variant (the staged slot stepper replays the FULL
+        # schedule and cannot honor a tier's step/size delta)
+        degraded = self._degraded_sampler()
+        if degraded is None and self._staged_enabled():
             images = self._staged_server().generate(
                 list(prompts), seed, deadline_s=deadline_s)
             metrics.inc("pipeline.images", len(prompts))
             return images
+        sample_fn, scfg, ep_counts = (
+            degraded if degraded is not None
+            else (self._sample, self.cfg.sampler, self._encprop_counts))
         padded, n = pad_prompts_to_dp(prompts, self.dp)
         ids = jnp.asarray(self._tokenize(padded))
         uncond = jnp.asarray(self._tokenize(
-            [self.cfg.sampler.negative_prompt] * len(padded)))
+            [scfg.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
         # block_timer = metric + device-synchronized trace span (the
         # whole CLIP->denoise->VAE jit is ONE XLA computation; its
         # internal stages stay visible as profiler TraceAnnotations)
         with self._dispatch_lock, block_timer("pipeline.t2i_s"):
-            images = self._sample(self._params, ids, uncond, rng)
+            images = sample_fn(self._params, ids, uncond, rng)
             # the dispatch lock exists to serialize device work; blocking
             # on the result under it is the point
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.images", n)
-        note_encprop_counters(self._encprop_counts, n)
+        if degraded is not None:
+            metrics.inc("pipeline.brownout_images", n)
+        note_encprop_counters(ep_counts, n)
         return np.asarray(images[:n])
 
     # -- img2img ----------------------------------------------------------

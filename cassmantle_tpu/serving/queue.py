@@ -27,6 +27,24 @@ Failure containment (the supervisor subsystem, ISSUE 2):
   :class:`DispatchTimeout`, the supervisor is flipped degraded, and the
   wedged thread is *disowned* (daemon) and replaced so later batches
   still dispatch.
+
+Overload control (ISSUE 13; serving/overload.py):
+
+- **Adaptive admission**: with an :class:`AdaptiveLimiter` wired
+  (``admission=``), the effective pending bound is the AIMD limit
+  driven by measured queue-wait + batch-service latency against a
+  target, not the static ``max_pending``. Rejections raise
+  :class:`OverloadShed` carrying a *computed* Retry-After (predicted
+  wait = depth × observed per-item service time), and a submission
+  whose predicted wait already exceeds its ``deadline_s`` is rejected
+  immediately instead of expiring in the queue.
+- **Priority tiers**: ``submit(priority=)`` with two classes.
+  Interactive (player scoring, the default) dispatches ahead of
+  background (round generation, reserve refill, bench); background is
+  the first shed under pressure; and a starvation bound guarantees a
+  background item still heads a batch after ``background_every``
+  consecutive batches dispatched with background work pending — rounds
+  keep rotating under sustained interactive load.
 """
 
 from __future__ import annotations
@@ -38,9 +56,14 @@ import threading
 import time
 from typing import Callable, Generic, List, Optional, Sequence, TypeVar
 
-from cassmantle_tpu.chaos import fault_point
+from cassmantle_tpu.chaos import ChaosInjected, fault_point
 from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.obs.trace import current_ctx, run_with_ctx, tracer
+from cassmantle_tpu.serving.overload import (
+    PRIORITY_BACKGROUND,
+    PRIORITY_INTERACTIVE,
+    note_shed,
+)
 from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
@@ -60,6 +83,22 @@ class QueueFull(Exception):
 
 class QueueStopped(QueueFull):
     """The queue shut down with this item still pending."""
+
+
+class OverloadShed(QueueFull):
+    """Rejected by the adaptive admission controller — not a hard
+    capacity wall but a *decision*, carrying the computed Retry-After
+    the HTTP layer serves and the reason (overload / background /
+    predicted_late / loop_lag / chaos). Subclasses QueueFull so legacy
+    call sites that degrade on backpressure keep degrading."""
+
+    def __init__(self, name: str, *, reason: str = "overload",
+                 retry_after_s: float = 1.0) -> None:
+        super().__init__(f"{name} ({reason}; retry in "
+                         f"{retry_after_s:.1f}s)")
+        self.queue_name = name
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class DeadlineExceeded(Exception):
@@ -188,6 +227,8 @@ class BatchingQueue(Generic[T, R]):
         supervisor=None,
         degraded_max_pending: Optional[int] = None,
         dispatcher: Optional[_DispatchWorker] = None,
+        admission=None,
+        background_every: int = 8,
     ) -> None:
         # ``dispatcher``: a dedicated _DispatchWorker for this queue.
         # Default is the process-global worker (device work serializes
@@ -199,6 +240,7 @@ class BatchingQueue(Generic[T, R]):
         self.handler = handler
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1000.0
+        self.max_pending = max_pending
         self.name = name
         self.default_deadline_s = default_deadline_s
         self.hang_timeout_s = hang_timeout_s
@@ -207,7 +249,22 @@ class BatchingQueue(Generic[T, R]):
             degraded_max_pending if degraded_max_pending is not None
             else max(1, max_pending // 8)
         )
+        # adaptive admission (serving/overload.py AdaptiveLimiter):
+        # None keeps the legacy static max_pending bound exactly
+        self.admission = admission
+        # starvation bound: after this many consecutive batches
+        # dispatched while background work sat pending, the oldest
+        # background item heads the next batch
+        self.background_every = max(1, int(background_every))
+        self._batches_since_bg = 0
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_pending)
+        # background tier rides its own queue so dispatch order can
+        # prefer interactive without scanning
+        self._bg_queue: asyncio.Queue = asyncio.Queue(maxsize=max_pending)
+        # items a racing get() returned after its cancellation was
+        # requested (priority-pop bookkeeping); consulted first by the
+        # collector and drained by stop()
+        self._spill: List = []
         self._task: Optional[asyncio.Task] = None
 
     def start(self) -> None:
@@ -225,11 +282,15 @@ class BatchingQueue(Generic[T, R]):
         # fail anything still queued: a pending future left to dangle
         # hangs its awaiting caller forever (ISSUE 2 satellite)
         stopped = 0
-        while True:
-            try:
-                _, fut = self._queue.get_nowait()
-            except asyncio.QueueEmpty:
-                break
+        pending = list(self._spill)
+        self._spill.clear()
+        for q in (self._queue, self._bg_queue):
+            while True:
+                try:
+                    pending.append(q.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+        for _, fut in pending:
             if not fut.done():
                 fut.set_exception(QueueStopped(self.name))
             stopped += 1
@@ -255,34 +316,138 @@ class BatchingQueue(Generic[T, R]):
                 fut._obs_t = None          # type: ignore[attr-defined]
             fut.set_exception(DeadlineExceeded(self.name))
 
+    def depth(self) -> int:
+        """Pending submissions across both priority tiers."""
+        return (self._queue.qsize() + self._bg_queue.qsize()
+                + len(self._spill))
+
     async def submit(self, item: T, *,
-                     deadline_s: Optional[float] = None) -> R:
+                     deadline_s: Optional[float] = None,
+                     priority: str = PRIORITY_INTERACTIVE) -> R:
         self.start()
         loop = asyncio.get_running_loop()
+        depth = self.depth()
+        deadline_s = (deadline_s if deadline_s is not None
+                      else self.default_deadline_s)
         if self.supervisor is not None and self.supervisor.degraded and \
-                self._queue.qsize() >= self.degraded_max_pending:
+                depth >= self.degraded_max_pending:
             # degraded: admit only a short queue — deep backlogs behind a
             # sick device are all going to miss their deadlines anyway
             metrics.inc(f"{self.name}.rejected_degraded")
             raise QueueFull(f"{self.name} (degraded)")
+        try:
+            # drill lever (docs/CHAOS.md): a fired ``server.admit``
+            # rule forces a mis-admission — the request is shed as if
+            # the limiter had rejected it
+            fault_point("server.admit", peer=self.name)
+        except ChaosInjected:
+            metrics.inc(f"{self.name}.rejected_overload")
+            note_shed()
+            raise OverloadShed(
+                self.name, reason="chaos",
+                retry_after_s=(self.admission.retry_after_s(depth)
+                               if self.admission is not None else 1.0))
+        if self.admission is not None:
+            verdict = self.admission.admit(depth, priority, deadline_s)
+            if verdict is not None:
+                if verdict.reason == "predicted_late":
+                    # doomed work rejected at submit, not at deadline
+                    metrics.inc(f"{self.name}.rejected_predicted_late")
+                elif verdict.reason == "background":
+                    metrics.inc(f"{self.name}.rejected_background")
+                else:
+                    metrics.inc(f"{self.name}.rejected_overload")
+                metrics.gauge(f"{self.name}.predicted_wait_s",
+                              self.admission.predicted_wait_s(depth))
+                note_shed()
+                raise OverloadShed(self.name, reason=verdict.reason,
+                                   retry_after_s=verdict.retry_after_s)
+        if depth >= self.max_pending:
+            # the static wall applies to the COMBINED depth: two
+            # priority tiers must not quietly double the legacy
+            # max_pending bound (each tier queue's own maxsize still
+            # backstops the single-tier case identically)
+            metrics.inc(f"{self.name}.rejected")
+            raise QueueFull(self.name)
         fut: asyncio.Future = loop.create_future()
         # trace propagation rides the future, not the queue tuple: the
         # (item, fut) shape is a stable seam (tests poke it directly),
         # and a future without these attributes simply goes untraced
         fut._obs_ctx = current_ctx()        # type: ignore[attr-defined]
         fut._obs_t = time.perf_counter()    # type: ignore[attr-defined]
+        fut._obs_priority = priority        # type: ignore[attr-defined]
+        q = (self._bg_queue if priority == PRIORITY_BACKGROUND
+             else self._queue)
         try:
-            self._queue.put_nowait((item, fut))
+            q.put_nowait((item, fut))
         except asyncio.QueueFull:
             metrics.inc(f"{self.name}.rejected")
             raise QueueFull(self.name)
-        metrics.gauge(f"{self.name}.depth", self._queue.qsize())
-        deadline_s = (deadline_s if deadline_s is not None
-                      else self.default_deadline_s)
+        metrics.gauge(f"{self.name}.depth", self.depth())
         if deadline_s is not None:
             handle = loop.call_later(deadline_s, self._expire, fut)
             fut.add_done_callback(lambda _f: handle.cancel())
         return await fut
+
+    async def _pop_one(self, timeout: Optional[float]):
+        """One pending item honoring priority: spilled items first,
+        then interactive ahead of background — UNLESS background has
+        sat out ``background_every`` consecutive batches (the
+        starvation bound: its oldest item heads this batch). Both
+        empty: await whichever tier produces first. Returns None on
+        timeout. An item a racing get() returns after losing the
+        FIRST_COMPLETED race (or after cancellation was requested)
+        lands in ``self._spill`` — never lost, consumed next pop."""
+        if self._spill:
+            return self._spill.pop(0)
+        starving = (self._bg_queue.qsize() > 0
+                    and self._batches_since_bg >= self.background_every)
+        order = ((self._bg_queue, self._queue) if starving
+                 else (self._queue, self._bg_queue))
+        for q in order:
+            try:
+                return q.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+        getters = (
+            # asyncio.Queue.get() is a COROUTINE here, not the blocking
+            # queue.Queue.get — it runs as a task and is awaited below
+            # lint: ignore[async-blocking-call] — asyncio.Queue.get coroutine under ensure_future
+            asyncio.ensure_future(self._queue.get()),
+            # lint: ignore[async-blocking-call] — asyncio.Queue.get coroutine under ensure_future
+            asyncio.ensure_future(self._bg_queue.get()),
+        )
+        try:
+            done, pending = await asyncio.wait(
+                set(getters), timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.CancelledError:
+            for t in getters:
+                t.cancel()
+            for t in getters:
+                try:
+                    self._spill.append(await t)
+                except asyncio.CancelledError:
+                    pass
+            raise
+        for t in pending:
+            t.cancel()
+
+            def _salvage(task) -> None:
+                # the cancel can lose the race with an arriving item:
+                # keep it for the next pop instead of dropping it
+                if not task.cancelled() and task.exception() is None:
+                    self._spill.append(task.result())
+
+            t.add_done_callback(_salvage)
+        # lint: ignore[async-blocking-call] — every t here is in done; result() returns immediately
+        items = [t.result() for t in getters
+                 if t in done and not t.cancelled()
+                 and t.exception() is None]
+        if not items:
+            return None
+        self._spill.extend(items[1:])   # both tiers produced at once
+        return items[0]
 
     async def _collect(self) -> List:
         """One entry (blocking) + everything arriving within the window.
@@ -291,7 +456,9 @@ class BatchingQueue(Generic[T, R]):
         futures failed here — stop()'s drain can no longer see them."""
         batch: List = []
         try:
-            batch.append(await self._queue.get())
+            first = await self._pop_one(None)
+            if first is not None:
+                batch.append(first)
             loop = asyncio.get_running_loop()
             opened = loop.time()
             deadline = opened + self.max_delay_s
@@ -299,12 +466,10 @@ class BatchingQueue(Generic[T, R]):
                 timeout = deadline - loop.time()
                 if timeout <= 0:
                     break
-                try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), timeout)
-                    )
-                except asyncio.TimeoutError:
+                nxt = await self._pop_one(timeout)
+                if nxt is None:
                     break
+                batch.append(nxt)
             # how long the window actually held the first item before
             # dispatch: ~0 under load (bucket fills instantly), ~the
             # full max_delay under trickle traffic — the knob's cost
@@ -327,6 +492,14 @@ class BatchingQueue(Generic[T, R]):
                 continue
             items = [item for item, _ in batch]
             futures = [fut for _, fut in batch]
+            # starvation-bound bookkeeping: a batch that carried any
+            # background member resets the counter; one dispatched while
+            # background sat pending ages it toward background_every
+            if any(getattr(f, "_obs_priority", None) == PRIORITY_BACKGROUND
+                   for f in futures):
+                self._batches_since_bg = 0
+            elif self._bg_queue.qsize() > 0:
+                self._batches_since_bg += 1
             metrics.inc(f"{self.name}.batches")
             metrics.inc(f"{self.name}.items", len(items))
             metrics.observe(f"{self.name}.batch_size", len(items),
@@ -434,6 +607,15 @@ class BatchingQueue(Generic[T, R]):
             parent_id=parent.span_id if parent is not None else None,
             start_wall=start_wall, duration_s=service_s, status=status,
             attrs={"queue": self.name, "batch_size": len(futures)})
+        if self.admission is not None and status == "ok":
+            # the AIMD signal: the batch's end-to-end latency is its
+            # service time plus its slowest member's queue wait (error
+            # batches excluded — a handler bug is not a latency signal)
+            waits = [t_dispatch - t
+                     for t in (getattr(f, "_obs_t", None)
+                               for f in futures) if t is not None]
+            self.admission.observe_batch(
+                max(waits) if waits else 0.0, service_s, len(futures))
         for fut in futures:
             t_submit = getattr(fut, "_obs_t", None)
             if t_submit is None:

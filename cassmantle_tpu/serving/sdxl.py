@@ -240,6 +240,8 @@ class SDXLPipeline:
         self._staged = None
         self._staged_init_lock = OrderedLock("pipeline.staged_init",
                                              rank=13)
+        # brownout tier variants (see Text2ImagePipeline._tier_fns)
+        self._tier_fns: dict = {}
 
     # -- conditioning ------------------------------------------------------
 
@@ -255,10 +257,13 @@ class SDXLPipeline:
             pooled = pooled @ params["clip2_proj"]
         return context, pooled
 
-    def _time_ids(self, batch: int) -> jax.Array:
+    def _time_ids(self, batch: int,
+                  image_size: Optional[int] = None) -> jax.Array:
         """SDXL size/crop conditioning: (orig_h, orig_w, crop_t, crop_l,
-        target_h, target_w), each sinusoidally embedded."""
-        s = float(self.cfg.sampler.image_size)
+        target_h, target_w), each sinusoidally embedded. ``image_size``
+        overrides the configured resolution (brownout downshift)."""
+        s = float(image_size if image_size is not None
+                  else self.cfg.sampler.image_size)
         ids = jnp.asarray([s, s, 0.0, 0.0, s, s], dtype=jnp.float32)
         emb = timestep_embedding(ids, self.time_id_dim)  # (6, time_id_dim)
         flat = emb.reshape(-1)
@@ -347,6 +352,51 @@ class SDXLPipeline:
                     )
         return self._staged
 
+    def _build_tier_impl(self, scfg, sampler, dc):
+        """The SDXL sample impl bound to a degraded tier's config —
+        ``_sample_impl`` with (steps, stride, size) swapped, the
+        micro-conditioning time_ids tracking the downshifted size."""
+        from cassmantle_tpu.serving.pipeline import (
+            run_cfg_denoise,
+            spatially_shard_latents,
+        )
+
+        def impl(params, ids, uncond_ids, rng):
+            with annotate("sdxl_encode"):
+                ctx, pooled = self._encode(params, ids)
+                uctx, upooled = self._encode(params, uncond_ids)
+            b = ids.shape[0]
+            time_ids = self._time_ids(b, scfg.image_size)
+            add = jnp.concatenate([pooled, time_ids], axis=-1)
+            uadd = jnp.concatenate([upooled, time_ids], axis=-1)
+            lat = initial_latents(rng, b, scfg.image_size,
+                                  self.vae_scale)
+            lat = spatially_shard_latents(lat, self.mesh)
+            with annotate("sdxl_denoise_scan"):
+                final = run_cfg_denoise(
+                    scfg, sampler, dc, self.unet_apply,
+                    params["unet"], ctx, uctx, lat,
+                    addition_embeds=add,
+                    uncond_addition_embeds=uadd,
+                )
+            with annotate("sdxl_vae_decode"):
+                decoded = self.vae.apply(params["vae"], final)
+            return postprocess_images(decoded)
+
+        return impl
+
+    def _degraded_sampler(self):
+        """Brownout actuation: the shared variant cache
+        (`serving/pipeline.py::degraded_dispatch_variant`) with the
+        SDXL impl builder."""
+        from cassmantle_tpu.serving.pipeline import (
+            degraded_dispatch_variant,
+        )
+
+        return degraded_dispatch_variant(
+            self._tier_fns, self.cfg.sampler, self.mesh,
+            self._build_tier_impl, log)
+
     def generate(self, prompts: Sequence[str], seed: int = 0,
                  deadline_s: Optional[float] = None) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. Batch is padded to a multiple of
@@ -354,25 +404,31 @@ class SDXLPipeline:
         dropped before returning. With ``serving.staged_serving`` on the
         request rides the stage graph (see Text2ImagePipeline.generate);
         meshed serving stays monolithic."""
-        if self._staged_enabled():
+        degraded = self._degraded_sampler()
+        if degraded is None and self._staged_enabled():
             images = self._staged_server().generate(
                 list(prompts), seed, deadline_s=deadline_s)
             metrics.inc("pipeline.sdxl_images", len(prompts))
             return images
+        sample_fn, scfg, ep_counts = (
+            degraded if degraded is not None
+            else (self._sample, self.cfg.sampler, self._encprop_counts))
         from cassmantle_tpu.serving.pipeline import pad_prompts_to_dp
 
         padded, n = pad_prompts_to_dp(prompts, self.dp)
         ids = jnp.asarray(self._tokenize(padded))
         uncond = jnp.asarray(self._tokenize(
-            [self.cfg.sampler.negative_prompt] * len(padded)))
+            [scfg.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
         # metric + device-synchronized trace span in one
         with self._dispatch_lock, block_timer("pipeline.sdxl_s"):
-            images = self._sample(self._params, ids, uncond, rng)
+            images = sample_fn(self._params, ids, uncond, rng)
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
         metrics.inc("pipeline.sdxl_images", n)
+        if degraded is not None:
+            metrics.inc("pipeline.brownout_images", n)
         from cassmantle_tpu.serving.pipeline import note_encprop_counters
 
-        note_encprop_counters(self._encprop_counts, n)
+        note_encprop_counters(ep_counts, n)
         return np.asarray(images[:n])

@@ -15,11 +15,16 @@ import numpy as np
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.ops.blur import device_blur
 from cassmantle_tpu.ops.scorer import EmbeddingScorer
+from cassmantle_tpu.serving.overload import (
+    PRIORITY_BACKGROUND,
+    make_admission,
+)
 from cassmantle_tpu.serving.pipeline import TPUContentBackend
 from cassmantle_tpu.serving.queue import (
     BatchingQueue,
     DeadlineExceeded,
     DispatchTimeout,
+    OverloadShed,
     QueueFull,
 )
 from cassmantle_tpu.serving.supervisor import ServingSupervisor
@@ -79,6 +84,8 @@ class InferenceService:
             hang_timeout_s=cfg.serving.dispatch_hang_s,
             supervisor=self.supervisor,
             degraded_max_pending=cfg.serving.degraded_max_pending,
+            admission=make_admission("score", cfg),
+            background_every=cfg.serving.background_every_batches,
         )
         # Concurrent round generations (double-buffering overlapping a
         # live promotion, or several Game instances sharing one service)
@@ -97,6 +104,8 @@ class InferenceService:
             hang_timeout_s=cfg.serving.dispatch_hang_s,
             supervisor=self.supervisor,
             degraded_max_pending=cfg.serving.degraded_max_pending,
+            admission=make_admission("prompt", cfg),
+            background_every=cfg.serving.background_every_batches,
         )
 
     # handlers run on the dispatch thread
@@ -129,10 +138,18 @@ class InferenceService:
             results = await asyncio.gather(
                 *(self.score_queue.submit(p) for p in pairs)
             )
+        except OverloadShed:
+            # adaptive admission shed this request with a computed
+            # Retry-After: propagate so the HTTP layer answers 503 +
+            # Retry-After in <50 ms (ISSUE 13 acceptance) instead of
+            # silently serving floor scores. Not a breaker failure —
+            # shedding IS the healthy overload response.
+            raise
         except QueueFull:
-            # overload: degrade to the min score rather than failing the
-            # request (skip-don't-crash). Backpressure is load, not a
-            # device failure — it doesn't count against the breaker.
+            # hard backpressure (static bound / degraded bound):
+            # degrade to the min score rather than failing the request
+            # (skip-don't-crash). Backpressure is load, not a device
+            # failure — it doesn't count against the breaker.
             log.warning("score queue full; returning zeros for %d pairs",
                         len(pairs))
             return np.zeros((len(pairs),), dtype=np.float32)
@@ -160,7 +177,13 @@ class InferenceService:
         text = None
         if hasattr(self.backend, "prompt_gen"):
             try:
-                text = await self.prompt_queue.submit(seed)
+                # round generation is BACKGROUND-tier work: interactive
+                # scoring preempts it in dispatch order, and it is the
+                # first shed under pressure (its fallback below keeps
+                # rounds rotating — the starvation bound guarantees the
+                # queue path itself also keeps progressing)
+                text = await self.prompt_queue.submit(
+                    seed, priority=PRIORITY_BACKGROUND)
             except (QueueFull, DeadlineExceeded, DispatchTimeout) as exc:
                 # any queue-path failure (backpressure, missed deadline,
                 # wedged dispatch) degrades to the in-backend decode —

@@ -66,6 +66,10 @@ FAST_MODULES = frozenset({
     "test_game", "test_js_runtime", "test_layers_norm", "test_masking",
     "test_masking_agreement", "test_multihost",
     "test_native_store", "test_obs", "test_obs_cluster", "test_ops",
+    # overload control plane (ISSUE 13): limiter/ladder/priority units
+    # plus the ~10s spawned-worker goodput smoke — the overload
+    # acceptance bar must run in every quick sweep
+    "test_overload",
     "test_pipeline",
     "test_pipeline_parallel", "test_samplers", "test_scoring",
     "test_server", "test_spell", "test_store", "test_store_parity",

@@ -599,6 +599,29 @@ def test_production_locks_are_ordered_and_ranked():
     assert ranks == sorted(ranks)
 
 
+def test_overload_locks_are_ranked():
+    """The overload control plane's two snapshot locks (ISSUE 13) sit
+    leaf-ward of everything they can be read under (supervisor 30,
+    breakers 40) and outward of the chaos plan leaf (60): limiter 54 <
+    brownout 55 — both guard only numeric state, never device work."""
+    from cassmantle_tpu.serving.overload import (
+        AdaptiveLimiter,
+        BrownoutLadder,
+    )
+
+    limiter = AdaptiveLimiter("t_rankcheck")._lock
+    ladder = BrownoutLadder()._lock
+    ranked = [
+        (limiter, "overload.limiter.t_rankcheck", 54),
+        (ladder, "overload.brownout", 55),
+    ]
+    for lock, name, rank in ranked:
+        assert isinstance(lock, OrderedLock)
+        assert (lock.name, lock.rank) == (name, rank)
+    assert 50 < min(r for _, _, r in ranked) and \
+        max(r for _, _, r in ranked) < 60
+
+
 def test_fabric_locks_are_ranked():
     """The fabric's three snapshot locks (ISSUE 8) sit between the
     store-TTL tier (level 0) and the pipeline dispatch tier (10):
