@@ -1809,6 +1809,9 @@ def bench_overload_drill(weights_dir: str) -> dict:
 # explains itself without a rerun.
 _DELTA_COUNTERS = {
     "jit.compiles", "jit.recompiles",
+    # cumulative XLA compile WALL seconds (utils/jit_sentinel.py): a
+    # 100 s recompile is visible in the trajectory, not just countable
+    "jit.compile_seconds",
     "scorer.embed_cache_hits", "scorer.embed_cache_misses",
     "game.image_cache_hits", "game.image_cache_misses",
     "stage.denoise.admissions", "stage.denoise.preemptions",
@@ -2151,6 +2154,11 @@ def main() -> None:
                 json.dump(merged, f, indent=2)
             os.replace(tmp, suite_path)
 
+    # regression sentinel (tools/bench_diff.py): snapshot the PRE-run
+    # suite state so the end-of-run diff compares this run's fresh
+    # numbers against what the file held before we merged into it
+    baseline_before = load_disk()
+    fresh_results: dict = {}
     north_star = None
     for name in names:
         res = _run_entry_isolated(name, weights_dir, entry_timeout,
@@ -2170,7 +2178,23 @@ def main() -> None:
         # the per-entry JSON stream always reports THIS run's outcome,
         # errors included; keep-prior only affects what's persisted
         print(json.dumps(res), file=sys.stderr)
+        fresh_results[name] = res
         persist_entry(name, res)
+    # print the regression-sentinel diff table (ISSUE 14): fresh run vs
+    # the pre-run baseline, noise-aware per-entry tolerances. Advisory
+    # here — the suite's exit semantics stay the north-star guard's;
+    # gate CI on a separate `tools/bench_diff.py` invocation.
+    try:
+        from tools.bench_diff import diff_suites, format_table
+
+        rows = diff_suites(baseline_before, fresh_results,
+                           entries=list(fresh_results))
+        sys.stderr.write("\n[suite] bench_diff vs pre-run baseline "
+                         "(tools/bench_diff.py):\n"
+                         + format_table(rows) + "\n")
+    except Exception as exc:  # the diff must never fail the suite
+        sys.stderr.write(f"[suite] bench_diff table unavailable: "
+                         f"{exc}\n")
     if "sd15" in names and (north_star is None or "error" in north_star):
         # never emit a malformed north-star line with a zero exit
         sys.exit(f"north-star bench failed: {north_star}")

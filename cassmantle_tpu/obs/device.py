@@ -1,0 +1,240 @@
+"""Per-worker device telemetry: HBM occupancy, per-pipeline highwater,
+compile-cost summary (ISSUE 14).
+
+The accelerator is the scarcest resource in the fleet and, until this
+module, the only one `/metrics` said nothing about: a worker could sit
+one allocation from an OOM, or burn minutes in recompiles, and the
+federation view showed healthy queues. The sampler exports, per local
+device:
+
+- ``device.hbm_bytes_in_use`` / ``device.hbm_bytes_limit`` /
+  ``device.hbm_peak_bytes`` gauges (labeled ``device=``), read from
+  ``device.memory_stats()`` — refreshed on every `/metrics` scrape and
+  by a background loop (same cadence knob as ``obs/process.py``,
+  ``ObsConfig.process_sample_interval_s``);
+- ``device.hbm_available`` — an EXPLICIT availability marker: a CPU
+  host (``memory_stats()`` returns None) or an older runtime (method
+  absent) exports ``0`` and **no** ``hbm_*`` gauges at all, never
+  zeros. A dashboard must distinguish "no HBM telemetry here" from
+  "this chip is empty" — an all-zero worker would read as free
+  capacity and attract load (tests/test_obs_device.py pins this);
+- ``device.hbm_highwater_bytes`` (labeled ``pipeline=``): the highest
+  ``bytes_in_use`` observed at that pipeline's dispatch boundaries
+  (``utils/profiling.block_timer`` calls :func:`note_dispatch` right
+  after the device sync, while the dispatch's buffers are still
+  resident) — which pipeline's working set actually crowds the chip.
+
+`/readyz` embeds :func:`device_block`: the same numbers plus the jit
+sentinel's compile summary (count / wall seconds / slowest functions,
+``utils/jit_sentinel.py``), so the page that says a worker is degraded
+also says whether HBM pressure or a compile storm explains it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, Optional
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("obs.device")
+
+#: memory_stats() key -> exported gauge suffix; only present keys
+#: export (a partial stats dict exports what it has, marks available)
+_STAT_GAUGES = (
+    ("bytes_in_use", "device.hbm_bytes_in_use"),
+    ("bytes_limit", "device.hbm_bytes_limit"),
+    ("peak_bytes_in_use", "device.hbm_peak_bytes"),
+)
+
+
+def _memory_stats(device) -> Optional[Dict[str, float]]:
+    """``device.memory_stats()`` with every degradation mode folded to
+    None: method absent (old runtime), returns None (CPU backend),
+    raises, or returns a dict with no byte fields."""
+    stats_fn = getattr(device, "memory_stats", None)
+    if stats_fn is None:
+        return None
+    try:
+        stats = stats_fn()
+    except Exception:
+        return None
+    if not isinstance(stats, dict):
+        return None
+    if not any(k in stats for k, _ in _STAT_GAUGES):
+        return None
+    return stats
+
+
+def _device_label(device) -> str:
+    return f"{getattr(device, 'platform', 'dev')}:" \
+           f"{getattr(device, 'id', 0)}"
+
+
+class DeviceMetrics:
+    """HBM gauges + per-pipeline dispatch-boundary highwater."""
+
+    def __init__(self, registry=None, devices_fn=None) -> None:
+        self._registry = registry if registry is not None else metrics
+        # injectable device list (tests fake memory_stats shapes
+        # without a backend); default reads jax lazily — importing this
+        # module must never initialize a backend
+        self._devices_fn = devices_fn
+        self._lock = threading.Lock()
+        self._highwater: Dict[str, float] = {}
+        self._last: Dict[str, Optional[Dict[str, float]]] = {}
+
+    def _devices(self):
+        if self._devices_fn is not None:
+            return self._devices_fn()
+        import sys
+
+        # a telemetry read must never be the thing that imports jax or
+        # INITIALIZES a backend: --fake drill workers are deliberately
+        # accelerator-free (serving/fake_scorer.py), and on a TPU host
+        # an auxiliary worker grabbing the single-client runtime would
+        # contend with the real serving process. No backend = no
+        # devices to report, honestly — the serving pipelines
+        # initialize it long before any scrape that matters.
+        if "jax" not in sys.modules:
+            return []
+        try:
+            from jax._src import xla_bridge
+
+            if not getattr(xla_bridge, "_backends", None):
+                return []
+        except Exception:  # probe unavailable on a future jax: accept
+            pass           # the import-only signal above
+        import jax
+
+        return jax.local_devices()
+
+    def sample(self) -> Dict[str, Optional[Dict[str, float]]]:
+        """Refresh the per-device gauges; returns {label: stats|None}
+        (None = telemetry unavailable on that device). Cheap — one
+        runtime call per device — so it runs on every scrape."""
+        seen: Dict[str, Optional[Dict[str, float]]] = {}
+        try:
+            devices = self._devices()
+        except Exception:  # backend dead/uninitializable: mark nothing
+            log.exception("device list unavailable; hbm gauges not "
+                          "refreshed")
+            return {}
+        for dev in devices:
+            label = _device_label(dev)
+            stats = _memory_stats(dev)
+            seen[label] = stats
+            labels = {"device": label}
+            if stats is None:
+                # explicit unavailability — never zeros (zeros read as
+                # an empty chip and attract load). Byte gauges this
+                # device exported BEFORE going dark are retracted: a
+                # frozen last reading would serve as current occupancy
+                # to every later scrape, the exact misleading state
+                # the marker exists to prevent
+                self._registry.gauge("device.hbm_available", 0.0,
+                                     labels=labels)
+                for _, gauge in _STAT_GAUGES:
+                    self._registry.remove_gauge(gauge, labels=labels)
+                continue
+            self._registry.gauge("device.hbm_available", 1.0,
+                                 labels=labels)
+            for key, gauge in _STAT_GAUGES:
+                if key in stats:
+                    self._registry.gauge(gauge, float(stats[key]),
+                                         labels=labels)
+                else:
+                    self._registry.remove_gauge(gauge, labels=labels)
+        with self._lock:
+            self._last = seen
+        return seen
+
+    def note_dispatch(self, pipeline: str) -> None:
+        """Dispatch-boundary highwater hook (block_timer exit, right
+        after the device sync): record the worst ``bytes_in_use``
+        across devices against this pipeline. Silently a no-op where
+        HBM telemetry is unavailable — the gauge simply never exists
+        (the availability marker already says why)."""
+        try:
+            worst = 0.0
+            seen_any = False
+            for dev in self._devices():
+                stats = _memory_stats(dev)
+                if stats is None or "bytes_in_use" not in stats:
+                    continue
+                seen_any = True
+                worst = max(worst, float(stats["bytes_in_use"]))
+            if not seen_any:
+                return
+            with self._lock:
+                prev = self._highwater.get(pipeline, 0.0)
+                if worst <= prev:
+                    return
+                self._highwater[pipeline] = worst
+                # gauge emitted INSIDE the lock: map-update and export
+                # must be atomic, or a preempted smaller sample's late
+                # gauge write would shadow a larger one forever (the
+                # `worst <= prev` early-out never re-emits). The
+                # registry lock is a leaf — same nesting every
+                # metrics-under-dispatch-lock site already does.
+                self._registry.gauge("device.hbm_highwater_bytes",
+                                     worst,
+                                     labels={"pipeline": pipeline})
+        except Exception:  # telemetry must never break a dispatch
+            log.exception("hbm highwater sample failed")
+
+    def highwater(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._highwater)
+
+    def device_block(self) -> Dict[str, object]:
+        """The `/readyz`-adjacent ``device_telemetry`` block: last
+        sampled per-device HBM numbers (or the explicit
+        ``"unavailable"`` marker), per-pipeline dispatch highwater, and
+        the jit sentinel's compile-cost summary."""
+        from cassmantle_tpu.utils import jit_sentinel
+
+        seen = self.sample()
+        devices: Dict[str, object] = {}
+        for label, stats in seen.items():
+            if stats is None:
+                devices[label] = "unavailable"
+            else:
+                devices[label] = {
+                    key: int(stats[key])
+                    for key, _ in _STAT_GAUGES if key in stats
+                }
+        compile_s = jit_sentinel.compile_time_snapshot()
+        slowest = sorted(compile_s.items(), key=lambda kv: -kv[1])[:5]
+        return {
+            "devices": devices,
+            "hbm_highwater_bytes": {
+                k: int(v) for k, v in self.highwater().items()},
+            "compile": {
+                "functions": len(compile_s),
+                "compiles": jit_sentinel.compiles(),
+                "total_s": round(sum(compile_s.values()), 3),
+                "slowest": [{"fn": name, "seconds": round(sec, 3)}
+                            for name, sec in slowest],
+            },
+        }
+
+    async def run(self, interval_s: float = 5.0) -> None:
+        """Background sampler (started beside the process-metrics loop,
+        server/app.py): scrapes also refresh opportunistically, but a
+        worker nobody scrapes must still carry fresh HBM gauges into
+        its membership-driven federation view."""
+        self.sample()
+        while True:
+            await asyncio.sleep(interval_s)
+            self.sample()
+
+
+#: process-global instance — block_timer's dispatch hook and the server
+#: share one highwater map, like the tracer/flight-recorder singletons
+device_metrics = DeviceMetrics()
+
+
+def note_dispatch(pipeline: str) -> None:
+    device_metrics.note_dispatch(pipeline)

@@ -81,6 +81,23 @@ class EmbeddingScorer:
                 cache_path=param_cache_path("minilm", cfg))
         )
         self._encode = jax.jit(model.apply)
+        # roofline attribution (obs/costmodel.py): an encoder forward
+        # costs ~2·N(params) FLOPs per token; resolved lazily from the
+        # committed cost model (production MiniLM) or this tree
+        self._flops_per_row = None
+
+    def _row_flops(self) -> float:
+        """Analytic FLOPs per encoded row (seq_len tokens)."""
+        if self._flops_per_row is None:
+            from cassmantle_tpu.obs import costmodel
+
+            self._flops_per_row = costmodel.flops_per_item(
+                "scorer",
+                costmodel.scorer_signature(self.cfg, self.seq_len),
+                tracer=lambda: 2.0 * costmodel.params_count(self.params)
+                * self.seq_len,
+            ) or 0.0
+        return self._flops_per_row
 
     # -- host-side batching ----------------------------------------------
     def _tokenize_batch(self, texts: Sequence[str], batch: int
@@ -110,8 +127,11 @@ class EmbeddingScorer:
             ids, mask = self._tokenize_batch(chunk, batch)
             # device-synchronized stage span: for a /compute_score
             # request this is the trace's leaf — the MiniLM encode the
-            # whole guess batch waited on
-            with block_timer("scorer.encode_s") as sink:
+            # whole guess batch waited on. flops_est covers the PADDED
+            # batch (the device computes pad rows too)
+            with block_timer("scorer.encode_s",
+                             flops_est=self._row_flops() * batch,
+                             pipeline="scorer") as sink:
                 emb = self._encode(self.params, jnp.asarray(ids),
                                    jnp.asarray(mask))
                 sink.append(emb)

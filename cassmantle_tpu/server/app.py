@@ -28,7 +28,7 @@ Route-for-route parity with the reference (SURVEY.md §1 L4, §3.3-3.5):
                            dispatch watchdog, device health fused; 503 +
                            Retry-After while degraded (new; ISSUE 2)
 - ``POST /debug/trace``    on-demand jax.profiler capture (new; §5.1;
-                            loopback only)
+                            loopback or cluster-token, single-flight)
 - static mounts ``/static`` and ``/data`` (main.py:25-27)
 
 Rate limits mirror the reference: 3/s default, 2/s API routes, per IP.
@@ -53,6 +53,7 @@ from cassmantle_tpu.config import FrameworkConfig, ObsConfig
 from cassmantle_tpu.engine.game import Game
 from cassmantle_tpu.fabric.rooms import RoomFabric
 from cassmantle_tpu.obs import configure_observability, flight_recorder, tracer
+from cassmantle_tpu.obs.device import device_metrics
 from cassmantle_tpu.obs.process import ProcessMetrics
 from cassmantle_tpu.obs.slo import SloEngine, default_objectives
 from cassmantle_tpu.obs.trace import (
@@ -700,6 +701,7 @@ async def handle_metrics(request: web.Request) -> web.Response:
     amplifier against the whole fleet."""
     proc = request.app[_PROCESS]
     proc.sample()            # scrapes always see fresh process gauges
+    device_metrics.sample()  # ...and fresh per-device HBM gauges
     fabric = request.app[_FABRIC]
     fmt_state = request.query.get("format") == "state"
     cluster = request.query.get("scope") == "cluster"
@@ -887,6 +889,13 @@ async def handle_readyz(request: web.Request) -> web.Response:
     # queue's adaptive admission limit — advisory like the SLO block;
     # shedding/browning-out is the system WORKING, not a failure
     status["overload"] = overload.status_block()
+    # device cost & capacity (ISSUE 14, obs/device.py): per-device HBM
+    # (or the explicit "unavailable" marker on hosts without HBM
+    # telemetry — never zeros), per-pipeline dispatch highwater, and
+    # the jit sentinel's compile-cost summary. Advisory: the page that
+    # drains a worker also says whether HBM pressure or a compile
+    # storm explains it
+    status["device_telemetry"] = device_metrics.device_block()
     if ready:
         return web.json_response(status)
     if status.get("state") != "draining":
@@ -900,16 +909,23 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
     """On-demand jax.profiler capture (SURVEY.md §5.1 — the reference has
     no tracing at all): ``POST /debug/trace?seconds=N[&name=subdir]``
     records N seconds of device+host activity to a TensorBoard trace
-    directory while live traffic runs, and returns its path. One capture
-    at a time; loopback only (an operator surface, not a player one).
+    directory while live traffic runs, and returns its path. Gated like
+    `/debugz` — loopback OR the cluster-secret token (ISSUE 14: an
+    operator triaging from another worker's shell, or tooling holding
+    the token, can capture without an ssh hop) — an operator surface,
+    never a player one. Single-flight: the ``active`` flag is
+    checked-and-set before the first await, so a second concurrent
+    capture answers 409 instead of interleaving ``start_trace`` /
+    ``stop_trace`` (the profiler is process-global; interleaved
+    captures corrupt both traces).
 
     The write path is never request-chosen: captures land under a fixed
     root (``CASSMANTLE_TRACE_ROOT`` env or the system tempdir), and the
     optional ``name`` selects only a single sanitized subdirectory —
     a same-host reverse proxy forwarding this route cannot turn it into
     an arbitrary-filesystem-write primitive."""
-    if not _is_loopback(request):
-        raise web.HTTPForbidden(text="loopback only")
+    if not _is_cluster_peer(request, request.app[_FABRIC]):
+        raise web.HTTPForbidden(text="loopback or cluster peers only")
     try:
         seconds = min(60.0, float(request.query.get("seconds", "5")))
     except ValueError:
@@ -940,7 +956,7 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
             await loop.run_in_executor(None, jax.profiler.stop_trace)
     finally:
         trace_state["active"] = False
-    metrics.inc("server.trace_captures")
+    metrics.inc("obs.profiler_captures")
     return web.json_response({"trace_dir": log_dir, "seconds": seconds})
 
 
@@ -1081,6 +1097,11 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
         tasks = app_[_OBS_TASKS]
         tasks.append(loop.create_task(
             app_[_PROCESS].run(cfg.obs.process_sample_interval_s)))
+        # device HBM sampler: same cadence as the process self-metrics
+        # (obs/device.py — a worker nobody scrapes still carries fresh
+        # HBM gauges into its federation view)
+        tasks.append(loop.create_task(
+            device_metrics.run(cfg.obs.process_sample_interval_s)))
         if not _env_flag_set("CASSMANTLE_NO_SLO"):
             tasks.append(loop.create_task(
                 _slo_loop(app_[_SLO], cfg.obs.slo_eval_interval_s)))

@@ -14,6 +14,7 @@ import asyncio
 import contextvars
 import os
 import random
+import threading
 from functools import partial
 from typing import List, Optional, Sequence
 
@@ -472,6 +473,14 @@ class Text2ImagePipeline:
         # tier changes never recompile. Tier 0 uses self._sample
         # untouched: unloaded behavior is bit-for-bit the old path.
         self._tier_fns: dict = {}
+        # roofline attribution (obs/costmodel.py, ISSUE 14): per-image
+        # analytic FLOPs per dispatch variant, resolved lazily on first
+        # dispatch (committed cost model for the production config,
+        # trace-once otherwise; tier variants resolve on a background
+        # thread — see _dispatch_flops)
+        self._flops_cache: dict = {}
+        self._flops_lock = threading.Lock()
+        self._flops_pending: set = set()
         # One in-flight device batch per pipeline: concurrent round
         # buffering calls generate() from multiple executor threads, and
         # the device executes serially regardless — serializing dispatch
@@ -606,6 +615,64 @@ class Text2ImagePipeline:
             self._tier_fns, self.cfg.sampler, self.mesh,
             self._build_tier_impl, log)
 
+    def _dispatch_flops(self, sample_fn, scfg, kind: str = "t2i",
+                        signature=None):
+        """Per-image analytic FLOPs for this dispatch variant (None =
+        no attribution yet): the committed data/cost_model.json entry
+        when the runtime signature matches the artifact, else a
+        trace-once of the actual jitted ``sample_fn`` — exact for any
+        variant (tiers, deepcache, encprop) because the jaxpr is the
+        truth. Shared by the SDXL pipeline (same dispatch shape).
+
+        Resolution is locked (racing executor threads pay one trace,
+        not one each) and tiered by urgency: the pipeline's OWN config
+        resolves inline — its cold dispatch is compile-dominated, so a
+        trace is noise there — but a BROWNOUT-TIER variant engages
+        exactly when the system is shedding latency, so its trace runs
+        on a daemon thread and the first degraded dispatches simply
+        carry no attribution until it lands."""
+        from cassmantle_tpu.obs import costmodel
+
+        key = (scfg.num_steps, scfg.image_size, scfg.encprop,
+               scfg.encprop_stride, scfg.deepcache)
+        if signature is None:
+            signature = costmodel.t2i_signature(self.cfg, scfg)
+
+        def resolve():
+            def trace() -> float:
+                # minimal valid batch (the dp width with a mesh),
+                # scaled back to per-image; tracing is abstract —
+                # nothing runs on device
+                ids = jax.ShapeDtypeStruct((self.dp, self.pad_len),
+                                           jnp.int32)
+                flops, _ = costmodel.trace_cost(
+                    sample_fn, self._params, ids, ids,
+                    jax.random.PRNGKey(0))
+                return flops / self.dp
+
+            return costmodel.flops_per_item(kind, signature,
+                                            tracer=trace)
+
+        with self._flops_lock:
+            if key in self._flops_cache:
+                return self._flops_cache[key]
+            if scfg != self.cfg.sampler:
+                if key not in self._flops_pending:
+                    self._flops_pending.add(key)
+
+                    def run_background():
+                        value = resolve()
+                        with self._flops_lock:
+                            self._flops_cache[key] = value
+
+                    threading.Thread(
+                        target=run_background, daemon=True,
+                        name="cassmantle-costtrace").start()
+                return None
+            per_image = resolve()
+            self._flops_cache[key] = per_image
+            return per_image
+
     def generate(self, prompts: Sequence[str], seed: int = 0,
                  deadline_s: Optional[float] = None) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. One compiled graph per batch.
@@ -634,10 +701,17 @@ class Text2ImagePipeline:
         uncond = jnp.asarray(self._tokenize(
             [scfg.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
+        per_image = self._dispatch_flops(sample_fn, scfg)
         # block_timer = metric + device-synchronized trace span (the
         # whole CLIP->denoise->VAE jit is ONE XLA computation; its
         # internal stages stay visible as profiler TraceAnnotations)
-        with self._dispatch_lock, block_timer("pipeline.t2i_s"):
+        # + roofline attribution: flops_est on the span, live
+        # pipeline.mxu_utilization{pipeline="t2i"} vs the chip ceiling
+        with self._dispatch_lock, block_timer(
+                "pipeline.t2i_s",
+                flops_est=(per_image * len(padded)) if per_image
+                else None,
+                pipeline="t2i"):
             images = sample_fn(self._params, ids, uncond, rng)
             # the dispatch lock exists to serialize device work; blocking
             # on the result under it is the point
@@ -845,6 +919,28 @@ class PromptGenerator:
             log.info("lm_int8: serving %.2f GB quantized param tree",
                      tree_nbytes(self.params) / 1e9)
         self._init_spec_decode(cfg, weights_dir)
+        # roofline attribution (obs/costmodel.py): dense decode costs
+        # 2·N(params) FLOPs per token processed; resolved lazily (the
+        # committed cost model for the production LM, the same formula
+        # over this tree otherwise) and accumulated per dispatch.
+        # THREAD-LOCAL: concurrent generate_batch callers (two rooms
+        # buffering rounds from separate executor threads) must each
+        # read their OWN dispatch's total, and a decode that raises
+        # attributes nothing (reset at decode entry) instead of the
+        # previous successful dispatch's figure
+        self._flops_per_token: Optional[float] = None
+        self._decode_flops_tls = threading.local()
+
+    def _token_flops(self) -> float:
+        """Analytic FLOPs per token processed (prefill or decode)."""
+        if self._flops_per_token is None:
+            from cassmantle_tpu.obs import costmodel
+
+            self._flops_per_token = costmodel.flops_per_item(
+                "prompt", costmodel.lm_signature(self.mcfg),
+                tracer=lambda: 2.0 * costmodel.params_count(self.params),
+            ) or 0.0
+        return self._flops_per_token
 
     def _init_spec_decode(self, cfg: FrameworkConfig, weights_dir) -> None:
         """Build the draft source for speculative decoding
@@ -1011,9 +1107,18 @@ class PromptGenerator:
         out_tokens = np.zeros((len(rows), max_new), dtype=np.int32)
         out_len = np.zeros((len(rows),), dtype=np.int32)
         spec_stats = []
+        dispatch_flops = 0.0
+        self._decode_flops_tls.value = 0.0  # failed decodes attr nothing
         for bucket, idxs in groups.items():
             n = len(idxs)
             n_pad = next((b for b in self.BATCH_BUCKETS if n <= b), n)
+            # roofline attribution: the dispatched shapes are fixed —
+            # n_pad rows prefill `bucket` tokens then run max_new decode
+            # steps regardless of eos (masked, not skipped), so the
+            # device work is exactly these tokens (spec decode bounds
+            # the same budget; greedy-equivalent estimate)
+            dispatch_flops += self._token_flops() * n_pad * (
+                bucket + max_new)
             # pad id normalized into the MODEL's vocab: the byte-fallback
             # tokenizer's pad (258) can exceed a small model vocab, and an
             # out-of-range id NaN-fills flax Embed's take — the NaN then
@@ -1080,6 +1185,7 @@ class PromptGenerator:
             # lint: ignore[host-sync] — per-dispatch sync, not per-item
             out_len[idxs] = np.asarray(gen_len[:n])
         self._record_spec_stats(spec_stats)
+        self._decode_flops_tls.value = dispatch_flops
         return jnp.asarray(out_tokens), jnp.asarray(out_len)
 
     def _record_spec_stats(self, spec_stats) -> None:
@@ -1115,7 +1221,14 @@ class PromptGenerator:
         """Batched greedy continuation: one device dispatch for N texts,
         each trimmed to its first two sentences (reference
         backend.py:253-265)."""
-        with block_timer("pipeline.prompt_s") as sink:
+        # flops_est is a callable: the bucket grouping (and so the
+        # dispatched token count) is only known after decode_ids_batch
+        # runs; block_timer evaluates it at exit, on THIS thread (the
+        # thread-local is written by the decode_ids_batch call below)
+        with block_timer("pipeline.prompt_s",
+                         flops_est=lambda: getattr(
+                             self._decode_flops_tls, "value", 0.0),
+                         pipeline="prompt") as sink:
             out_tokens, gen_len = self.decode_ids_batch(
                 seed_texts, max_new_tokens)
             sink.append(out_tokens)

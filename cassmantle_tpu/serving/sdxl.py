@@ -22,6 +22,7 @@ ICI. The whole CLIP→DDIM→VAE trajectory is still ONE XLA computation.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -242,6 +243,10 @@ class SDXLPipeline:
                                              rank=13)
         # brownout tier variants (see Text2ImagePipeline._tier_fns)
         self._tier_fns: dict = {}
+        # roofline attribution (see Text2ImagePipeline._flops_cache)
+        self._flops_cache: dict = {}
+        self._flops_lock = threading.Lock()
+        self._flops_pending: set = set()
 
     # -- conditioning ------------------------------------------------------
 
@@ -397,6 +402,17 @@ class SDXLPipeline:
             self._tier_fns, self.cfg.sampler, self.mesh,
             self._build_tier_impl, log)
 
+    def _dispatch_flops(self, sample_fn, scfg):
+        """Per-image analytic FLOPs (obs/costmodel.py): the shared
+        Text2ImagePipeline resolver with the SDXL artifact key and
+        signature (dispatch call shape is identical)."""
+        from cassmantle_tpu.obs import costmodel
+        from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+        return Text2ImagePipeline._dispatch_flops(
+            self, sample_fn, scfg, kind="sdxl",
+            signature=costmodel.sdxl_signature(self.cfg, scfg))
+
     def generate(self, prompts: Sequence[str], seed: int = 0,
                  deadline_s: Optional[float] = None) -> np.ndarray:
         """prompts -> (B, H, W, 3) uint8. Batch is padded to a multiple of
@@ -420,8 +436,14 @@ class SDXLPipeline:
         uncond = jnp.asarray(self._tokenize(
             [scfg.negative_prompt] * len(padded)))
         rng = jax.random.PRNGKey(seed)
-        # metric + device-synchronized trace span in one
-        with self._dispatch_lock, block_timer("pipeline.sdxl_s"):
+        per_image = self._dispatch_flops(sample_fn, scfg)
+        # metric + device-synchronized trace span in one, with roofline
+        # attribution (flops_est attr + live mxu vs the chip ceiling)
+        with self._dispatch_lock, block_timer(
+                "pipeline.sdxl_s",
+                flops_est=(per_image * len(padded)) if per_image
+                else None,
+                pipeline="sdxl"):
             images = sample_fn(self._params, ids, uncond, rng)
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)

@@ -222,6 +222,11 @@ class StagedImageServer:
         self.stats = {"steps": 0, "slot_steps": 0, "admissions": 0,
                       "retirements": 0, "preemptions": 0}
         self._on_step = None  # test seam: called once per loop iteration
+        # roofline attribution: per-image denoise FLOPs, traced on a
+        # background thread kicked off at the first retirement (needs
+        # the cond shapes to exist; must never stall the step loop)
+        self._flops_img = None
+        self._flops_trace_started = False
 
     # -- jitted pieces -----------------------------------------------------
 
@@ -652,6 +657,52 @@ class StagedImageServer:
         metrics.gauge("stage.denoise.slot_occupancy",
                       self._active_n / self.capacity)
 
+    def _denoise_flops_per_image(self):
+        """Analytic FLOPs of one request's full denoise residency (CFG
+        denoiser × num_steps), traced once from the actual slot
+        denoiser at width 1 (obs/costmodel.py — exact for this config).
+
+        The jaxpr trace costs seconds for an SDXL-class UNet, and this
+        is called from the single denoise-loop thread — tracing inline
+        would stall EVERY co-resident slot's steps (and burn their
+        step-granularity deadline budget) at the first retirement. So
+        the first call only CAPTURES the shapes (cheap) and hands the
+        trace to a daemon thread; retirements carry no attribution
+        until it lands (None), then every later one uses the cached
+        figure. 0.0 = tried and failed, permanently skipped."""
+        if self._flops_img is not None:
+            return self._flops_img or None
+        if self._cond is None or self._lat is None \
+                or self._flops_trace_started:
+            return None
+        self._flops_trace_started = True
+
+        def one(a):
+            return jax.ShapeDtypeStruct((1,) + a.shape[1:], a.dtype)
+
+        lat1 = one(self._lat)
+        cond1 = {k: one(v) for k, v in self._cond.items()}
+
+        def run_trace():
+            try:
+                from cassmantle_tpu.obs import costmodel
+
+                flops, _ = costmodel.trace_cost(
+                    lambda p, x, t, c: self._denoise(
+                        p, x, t, c["ctx"], c["uctx"],
+                        c.get("add"), c.get("uadd")),
+                    self._params["unet"], lat1,
+                    jax.ShapeDtypeStruct((1,), jnp.int32), cond1)
+                self._flops_img = flops * self.num_steps
+            except Exception:
+                log.exception("staged denoise cost trace failed; "
+                              "retirements carry no FLOPs attribution")
+                self._flops_img = 0.0
+
+        threading.Thread(target=run_trace, daemon=True,
+                         name="cassmantle-stage-costtrace").start()
+        return None
+
     def _retire_finished(self) -> None:
         sup = self._supervisor
         for slot, u in enumerate(self._slots):
@@ -665,18 +716,42 @@ class StagedImageServer:
             flight_recorder.record(
                 "stage.retire", stage="denoise", slot=slot,
                 step=self.stats["steps"], occupancy=self._active_n)
+            # roofline attribution per retirement: the request's
+            # denoise work is num_steps CFG forwards wherever its slot
+            # sat. The mxu figure divides by residency (admit→retire),
+            # a LOWER bound per unit — co-batched slots overlap, so the
+            # per-pipeline gauge approaches truth as occupancy rises
+            # (exactly the stage-serving occupancy argument,
+            # docs/PERF_NOTES.md)
+            unit_flops = self._denoise_flops_per_image()
+            if unit_flops:
+                from cassmantle_tpu.obs.costmodel import chip_peak_flops
+                from cassmantle_tpu.obs.device import note_dispatch
+
+                metrics.inc("request.device_flops", unit_flops,
+                            labels={"pipeline": "staged_denoise"})
+                service_s = now - u.t_admit
+                if service_s > 0:
+                    metrics.gauge(
+                        "pipeline.mxu_utilization",
+                        unit_flops / service_s / chip_peak_flops(),
+                        labels={"pipeline": "staged_denoise"})
+                note_dispatch("staged_denoise")
             if u.ctx is not None and u.ctx.sampled:
                 wait_s = u.t_admit - u.t_ready
                 tracer.record_span(
                     "stage.denoise.wait", tracer.child_ctx(u.ctx),
                     parent_id=u.ctx.span_id, start_wall=u.wall_ready,
                     duration_s=wait_s, attrs={"slot": slot})
+                attrs = {"slot": slot, "steps": self.num_steps}
+                if unit_flops:
+                    attrs["flops_est"] = unit_flops
                 tracer.record_span(
                     "stage.denoise.service", tracer.child_ctx(u.ctx),
                     parent_id=u.ctx.span_id,
                     start_wall=u.wall_ready + wait_s,
                     duration_s=now - u.t_admit,
-                    attrs={"slot": slot, "steps": self.num_steps})
+                    attrs=attrs)
             if sup is not None:
                 sup.note_stage_progress("denoise")
             u.done.set_result(row)

@@ -17,6 +17,20 @@ attaches a counting ``logging.Filter`` to jax's compile log
 compilation) and parses the name out — passing through, unchanged,
 every record the operator's own logging config would have emitted.
 
+Compile **wall time** (ISSUE 14) rides the same mechanism: jax wraps
+every backend compile in ``dispatch.log_elapsed_time``, which emits
+``"Finished XLA compilation of jit(<name>) in <secs> sec"`` on the
+``jax._src.dispatch`` logger. A second filter parses name + seconds
+into the ``jit.compile_s`` histogram (per-function ``fn=`` label), the
+cumulative ``jit.compile_seconds`` counter (bench entries attach its
+per-run delta — a 100 s SDXL recompile is *visible* in the trajectory,
+not just countable), a per-name total (:func:`compile_time_snapshot`,
+surfaced in the `/readyz` ``device_telemetry`` block), and — for
+compiles ≥ 1 s, the same threshold the persistent cache uses — a
+flight-recorder event (`/debugz` kind ``jit.compile``). Sub-second
+compiles stay metric-only so warmup bursts cannot flush the event ring
+of the supervision story an operator is actually triaging.
+
 Known limit: the log line carries only the function's bare
 ``__name__``, so two distinct jitted functions sharing a name (e.g. a
 jitted ``apply`` on two models) share one counter — the second
@@ -62,6 +76,13 @@ log = get_logger("jit_sentinel")
 #: jax's compile-path narration logger; one record per actual compile
 _COMPILE_LOGGER = "jax._src.interpreters.pxla"
 _PREFIX = "Compiling "
+#: the elapsed-time record (dispatch.log_elapsed_time) — fires once per
+#: backend compile with the wall seconds baked into the message
+_FINISHED_LOGGER = "jax._src.dispatch"
+_FINISHED_PREFIX = "Finished XLA compilation of "
+#: flight-recorder threshold: compiles at/over this land in /debugz
+#: (kind jit.compile); matches jax_persistent_cache_min_compile_time
+_RECORDER_MIN_S = 1.0
 
 
 class JitRecompileError(RuntimeError):
@@ -71,8 +92,9 @@ class JitRecompileError(RuntimeError):
 
 _lock = threading.Lock()
 _counts: Dict[str, int] = {}
-_filter: Optional[logging.Filter] = None
-_prior_level: Optional[int] = None
+_compile_s: Dict[str, float] = {}
+# (logger name, attached filter, pre-sentinel level) per listened logger
+_filters: list = []
 
 
 def _record_compile(name: str) -> None:
@@ -90,60 +112,108 @@ def _record_compile(name: str) -> None:
         log.info("jit recompile #%d of %r", n, name)
 
 
-class _CompileLogFilter(logging.Filter):
-    """Counts ``"Compiling <name> with ..."`` records as a logger-level
-    filter (filters run before handlers AND propagation, so nothing
-    needs to be attached downstream). The filter also keeps the
-    sentinel's forced-DEBUG level from changing what operators see:
-    records the PRE-sentinel effective level would have emitted pass
-    through untouched (warnings/errors keep flowing — and if the
-    operator configured DEBUG themselves, the compile narration still
-    prints); only the records our level-forcing newly enabled are
-    swallowed. Counting must never raise — a sentinel that can break
-    compilation is worse than no sentinel."""
+def _normalize_fn_name(name: str) -> str:
+    """The elapsed-time record wraps the name as ``jit(<name>)`` where
+    the Compiling record uses the bare ``<name>`` — strip the wrapper
+    so both feeds key one per-function identity."""
+    if name.startswith("jit(") and name.endswith(")"):
+        return name[4:-1]
+    return name
 
-    def __init__(self, prior_effective: int) -> None:
+
+def _record_compile_time(name: str, seconds: float) -> None:
+    name = _normalize_fn_name(name)
+    with _lock:
+        _compile_s[name] = _compile_s.get(name, 0.0) + seconds
+    metrics.observe("jit.compile_s", seconds, labels={"fn": name})
+    metrics.inc("jit.compile_seconds", seconds)
+    if seconds >= _RECORDER_MIN_S:
+        from cassmantle_tpu.obs.recorder import flight_recorder
+
+        flight_recorder.record("jit.compile", fn=name,
+                               seconds=round(seconds, 3))
+        log.info("jit compile of %r took %.1fs", name, seconds)
+
+
+def _parse_compiling(msg: str) -> None:
+    if msg.startswith(_PREFIX):
+        _record_compile(msg[len(_PREFIX):].split(" ", 1)[0])
+
+
+def _parse_finished(msg: str) -> None:
+    if not msg.startswith(_FINISHED_PREFIX):
+        return
+    # "Finished XLA compilation of jit(fn) in 1.234 sec"
+    body = msg[len(_FINISHED_PREFIX):]
+    name, _, tail = body.rpartition(" in ")
+    if not name:
+        return
+    try:
+        seconds = float(tail.split()[0])
+    except (ValueError, IndexError):
+        return
+    _record_compile_time(name, seconds)
+
+
+class _CompileLogFilter(logging.Filter):
+    """Feeds ``handle(message)`` from a logger-level filter (filters
+    run before handlers AND propagation, so nothing needs to be
+    attached downstream). The filter also keeps the sentinel's
+    forced-DEBUG level from changing what operators see: records the
+    PRE-sentinel effective level would have emitted pass through
+    untouched (warnings/errors keep flowing — and if the operator
+    configured DEBUG themselves, the compile narration still prints);
+    only the records our level-forcing newly enabled are swallowed.
+    Counting must never raise — a sentinel that can break compilation
+    is worse than no sentinel."""
+
+    def __init__(self, prior_effective: int, handle) -> None:
         super().__init__()
         self.prior_effective = prior_effective
+        self._handle = handle
 
     def filter(self, record: logging.LogRecord) -> bool:
         try:
-            msg = record.getMessage()
-            if msg.startswith(_PREFIX):
-                _record_compile(msg[len(_PREFIX):].split(" ", 1)[0])
+            self._handle(record.getMessage())
         except Exception:  # pragma: no cover - defensive
             pass
         return record.levelno >= self.prior_effective
 
 
+#: (logger name, message handler) — the two compile-narration feeds
+_LISTENERS = (
+    (_COMPILE_LOGGER, _parse_compiling),
+    (_FINISHED_LOGGER, _parse_finished),
+)
+
+
 def enable_sentinel() -> None:
-    """Attach the compile-log listener (idempotent). Forces the jax
-    compile logger to DEBUG so the per-compile record actually fires;
-    the previous level is restored by :func:`disable_sentinel`."""
-    global _filter, _prior_level
-    if _filter is not None:
+    """Attach the compile-log listeners (idempotent): compile COUNTS
+    from pxla's Compiling records, compile WALL TIME from dispatch's
+    Finished records. Forces both loggers to DEBUG so the per-compile
+    records actually fire; previous levels are restored by
+    :func:`disable_sentinel`."""
+    if _filters:
         return
-    logger = logging.getLogger(_COMPILE_LOGGER)
-    _prior_level = logger.level
-    _filter = _CompileLogFilter(logger.getEffectiveLevel())
-    logger.addFilter(_filter)
-    logger.setLevel(logging.DEBUG)
+    for logger_name, handle in _LISTENERS:
+        logger = logging.getLogger(logger_name)
+        filt = _CompileLogFilter(logger.getEffectiveLevel(), handle)
+        _filters.append((logger_name, filt, logger.level))
+        logger.addFilter(filt)
+        logger.setLevel(logging.DEBUG)
 
 
 def disable_sentinel() -> None:
-    global _filter, _prior_level
-    if _filter is None:
-        return
-    logger = logging.getLogger(_COMPILE_LOGGER)
-    logger.removeFilter(_filter)
-    if _prior_level is not None:
-        logger.setLevel(_prior_level)
-    _filter = None
-    _prior_level = None
+    global _filters
+    for logger_name, filt, prior_level in _filters:
+        logger = logging.getLogger(logger_name)
+        logger.removeFilter(filt)
+        logger.setLevel(prior_level)
+    _filters = []
 
 
 def sentinel_active() -> bool:
-    return _filter is not None
+    return bool(_filters)
 
 
 def maybe_enable_from_env() -> None:
@@ -157,12 +227,20 @@ def maybe_enable_from_env() -> None:
 def reset_counts() -> None:
     with _lock:
         _counts.clear()
+        _compile_s.clear()
 
 
 def snapshot() -> Dict[str, int]:
     """Compile counts per jitted-function name since the last reset."""
     with _lock:
         return dict(_counts)
+
+
+def compile_time_snapshot() -> Dict[str, float]:
+    """Cumulative compile wall seconds per function since the last
+    reset — the `/readyz` device_telemetry block's compile summary."""
+    with _lock:
+        return dict(_compile_s)
 
 
 def compiles(name: Optional[str] = None) -> int:

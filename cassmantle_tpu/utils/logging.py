@@ -203,6 +203,16 @@ class Metrics:
         with self._lock:
             self._gauges[_series_key(name, labels)] = value
 
+    def remove_gauge(self, name: str,
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        """Retract a gauge series. Gauges are point-in-time readings:
+        when their source disappears (a device whose memory_stats went
+        dark mid-flight, obs/device.py) the honest export is ABSENCE —
+        a frozen last value would be read as current truth by every
+        later scrape. No-op when the series never existed."""
+        with self._lock:
+            self._gauges.pop(_series_key(name, labels), None)
+
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, str]] = None,
                 buckets: Optional[Sequence[float]] = None) -> None:

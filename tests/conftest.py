@@ -58,7 +58,14 @@ def pytest_configure(config):
 # host; see ROADMAP.md for the tier commands.
 
 FAST_MODULES = frozenset({
-    "test_aux", "test_bench_harness", "test_chaos",
+    "test_aux", "test_bench_harness",
+    # bench regression sentinel + device/cost observability (ISSUE 14):
+    # the bench_diff verdict grammar is stdlib-fast; test_obs_device
+    # compiles two tiny pipelines for the roofline acceptance smoke and
+    # regenerates the cost-model artifact (pure eval_shape, ~20s) —
+    # both are acceptance bars that must run in every quick sweep
+    "test_bench_diff", "test_obs_device",
+    "test_chaos",
     "test_check_concurrency",
     "test_check_jax", "test_check_metrics", "test_eval",
     "test_fabric", "test_fault_injection",

@@ -1,0 +1,232 @@
+"""Bench regression sentinel: diff a fresh BENCH_SUITE.json against the
+committed baseline with noise-aware tolerances (ISSUE 14).
+
+BENCH_SUITE.json has carried the repo's hardware evidence since round 1,
+but nothing ever *read* the trajectory — a 20% throughput regression
+shipped as a smaller number in a JSON file nobody compared. This tool
+makes the trajectory self-auditing:
+
+    python tools/bench_diff.py FRESH.json                 # vs committed
+    python tools/bench_diff.py FRESH.json --entry sd15    # one entry
+    python tools/bench_diff.py run.json --baseline OLD.json
+
+Per entry the verdict is one of:
+
+- ``regression``   — the value moved beyond tolerance in the BAD
+  direction (lower for ``*/sec`` units, higher for ``seconds``);
+  **exits nonzero**, naming the entry, and prints the diagnosis
+  ``counter_deltas`` the round-14 bench entries record (a drop arriving
+  with a ``jit.recompiles`` delta explains itself without a rerun);
+- ``improvement``  — beyond tolerance in the good direction;
+- ``within_noise`` — inside the tolerance band;
+- ``missing``      — the baseline has a measured value the fresh file
+  lacks (a vanished entry breaks the trajectory; **exits nonzero**);
+- ``error``        — the fresh run failed where the baseline had a
+  measurement (**exits nonzero**);
+- ``skipped``      — the baseline entry is itself unmeasured (the
+  pending-hardware annotations) — nothing to regress against;
+- ``new``          — fresh entry with no baseline counterpart.
+
+Tolerances are **carried per entry**: a ``noise_tolerance`` field on
+the fresh record, else on the baseline record, else ``--tolerance``
+(default 0.10 — run-to-run variance of the bench entries on shared
+hosts is well under 10%; entries known noisier carry their own).
+
+``bench.py --suite`` prints this diff table at the end of every run
+(non-gating there — the suite's own exit semantics are unchanged), so
+the operator reading a fresh suite sees the trend, not just the values.
+
+stdlib-only: importable without jax (CI, laptops, hooks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_TOLERANCE = 0.10
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(_REPO, "BENCH_SUITE.json")
+
+#: verdicts that make the CLI exit nonzero
+FAILING = ("regression", "error", "missing")
+
+
+def _value(entry) -> Optional[float]:
+    if not isinstance(entry, dict) or "error" in entry:
+        return None
+    v = entry.get("value")
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def higher_is_better(entry: dict) -> bool:
+    """Direction from the entry's unit: throughput units (``*/sec``,
+    ``*/s``) are higher-better; ``seconds`` (latency/recovery clocks)
+    are lower-better. Unknown units default to higher-better."""
+    unit = str(entry.get("unit", "")).lower()
+    return unit not in ("seconds", "second", "sec", "s", "ms")
+
+
+def _tolerance(base, fresh, default: float) -> float:
+    for entry in (fresh, base):
+        if isinstance(entry, dict) and "noise_tolerance" in entry:
+            try:
+                return float(entry["noise_tolerance"])
+            except (TypeError, ValueError):
+                pass
+    return default
+
+
+def _delta_diagnosis(base, fresh) -> Dict[str, object]:
+    """Diagnosis-counter changes between the two records'
+    ``counter_deltas`` blocks: new counters and changed values — the
+    round-14 entries record exactly the counters (jit recompiles,
+    dispatch hangs, cache misses) that explain a throughput move."""
+    base_d = (base or {}).get("counter_deltas") or {}
+    fresh_d = (fresh or {}).get("counter_deltas") or {}
+    out = {}
+    for key in sorted(set(base_d) | set(fresh_d)):
+        if base_d.get(key) != fresh_d.get(key):
+            out[key] = {"baseline": base_d.get(key),
+                        "fresh": fresh_d.get(key)}
+    return out
+
+
+def diff_entry(name: str, base, fresh,
+               default_tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """One entry's verdict row (see module docstring for the grammar)."""
+    row = {"entry": name, "verdict": "within_noise",
+           "tolerance": _tolerance(base, fresh, default_tolerance)}
+    base_v = _value(base)
+    if base_v is None:
+        # the baseline never measured this (pending-hardware rows) or
+        # doesn't know it: nothing to regress against
+        row["verdict"] = "skipped" if isinstance(base, dict) else "new"
+        return row
+    row["baseline"] = base_v
+    row["unit"] = base.get("unit", "")
+    if fresh is None:
+        row["verdict"] = "missing"
+        return row
+    fresh_v = _value(fresh)
+    if fresh_v is None:
+        row["verdict"] = "error"
+        row["error"] = str(fresh.get("error", "no value"))[:200]
+        return row
+    row["fresh"] = fresh_v
+    if base_v == 0:
+        return row
+    change = (fresh_v - base_v) / abs(base_v)
+    row["change_pct"] = round(100.0 * change, 2)
+    signed = change if higher_is_better(base) else -change
+    if signed < -row["tolerance"]:
+        row["verdict"] = "regression"
+        diag = _delta_diagnosis(base, fresh)
+        if diag:
+            row["counter_delta_changes"] = diag
+    elif signed > row["tolerance"]:
+        row["verdict"] = "improvement"
+    return row
+
+
+def diff_suites(baseline: Dict[str, dict], fresh: Dict[str, dict],
+                entries: Optional[List[str]] = None,
+                default_tolerance: float = DEFAULT_TOLERANCE
+                ) -> List[dict]:
+    """Verdict rows for every baseline entry (plus fresh-only ones),
+    restricted to ``entries`` when given."""
+    names = entries if entries is not None else \
+        sorted(set(baseline) | set(fresh))
+    return [diff_entry(name, baseline.get(name), fresh.get(name),
+                       default_tolerance)
+            for name in names]
+
+
+def format_table(rows: List[dict]) -> str:
+    lines = [f"{'entry':22s} {'verdict':13s} {'baseline':>12s} "
+             f"{'fresh':>12s} {'change':>8s}  unit"]
+    for row in rows:
+        base = row.get("baseline")
+        fresh = row.get("fresh")
+        change = row.get("change_pct")
+        lines.append(
+            f"{row['entry']:22s} {row['verdict']:13s} "
+            f"{('%.4g' % base) if base is not None else '-':>12s} "
+            f"{('%.4g' % fresh) if fresh is not None else '-':>12s} "
+            f"{('%+.1f%%' % change) if change is not None else '-':>8s}"
+            f"  {row.get('unit', '')}")
+        for key, delta in (row.get("counter_delta_changes") or {}).items():
+            lines.append(f"    diagnosis {key}: "
+                         f"{delta['baseline']} -> {delta['fresh']}")
+        if row["verdict"] == "error":
+            lines.append(f"    error: {row.get('error', '')}")
+    return "\n".join(lines)
+
+
+def _load(path: str) -> Dict[str, dict]:
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise SystemExit(f"{path}: expected a JSON object")
+    if isinstance(data.get("metric"), str):
+        # a single bench.py --entry record (its "metric" field is the
+        # metric NAME string; a suite mapping's values are all entry
+        # dicts, so a suite can never match this — and a single record
+        # may well carry dict-valued fields like counter_deltas).
+        # Callers pass --entry NAME to say which suite slot it fills.
+        return {"__single__": data}
+    return data
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff a fresh BENCH_SUITE.json against the "
+                    "committed baseline with noise-aware tolerances")
+    ap.add_argument("fresh", help="fresh suite JSON (or a single "
+                                  "--entry record)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline suite (default: the committed "
+                         "BENCH_SUITE.json)")
+    ap.add_argument("--entry", default=None,
+                    help="compare only this entry (the fresh file may "
+                         "be a single bench.py --entry record)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="default relative noise tolerance (entries "
+                         "carrying noise_tolerance override it)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict rows as JSON instead of the "
+                         "table")
+    opts = ap.parse_args(argv)
+    baseline = _load(opts.baseline)
+    fresh = _load(opts.fresh)
+    if "__single__" in fresh:
+        if not opts.entry:
+            raise SystemExit(
+                "the fresh file is a single bench record; pass "
+                "--entry NAME to place it")
+        fresh = {opts.entry: fresh["__single__"]}
+    entries = [opts.entry] if opts.entry else None
+    rows = diff_suites(baseline, fresh, entries=entries,
+                       default_tolerance=opts.tolerance)
+    if opts.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(format_table(rows))
+    failing = [r for r in rows if r["verdict"] in FAILING]
+    if failing:
+        names = ", ".join(f"{r['entry']} ({r['verdict']})"
+                          for r in failing)
+        print(f"FAIL: {names}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,9 +48,15 @@ def cost_table(fn, *args, top: int = 10):
     when compiled on CPU), grouped by (primitive, operand shapes),
     sorted by total FLOPs. The HARDWARE complement is the optimized-HLO
     dump (--dump-hlo) plus PROFILE_UNET.txt timings: this table says
-    where the FLOPs are; the dump says what XLA fused around them."""
+    where the FLOPs are; the dump says what XLA fused around them.
+
+    The per-eqn FLOP math is shared with the runtime cost model
+    (cassmantle_tpu/obs/costmodel.py::eqn_flops), so this table, the
+    committed cost-model artifact, and the live `pipeline.mxu_*`
+    attribution can never disagree on what an op costs."""
     import collections
-    import math
+
+    from cassmantle_tpu.obs.costmodel import eqn_flops
 
     jaxpr = jax.make_jaxpr(fn)(*args)
     groups = collections.defaultdict(lambda: [0, 0.0])  # count, flops
@@ -71,28 +77,11 @@ def cost_table(fn, *args, top: int = 10):
                         if hasattr(s, "jaxpr"):
                             visit(s.jaxpr, inner)
             name = eqn.primitive.name
+            if name not in ("dot_general", "conv_general_dilated"):
+                continue
             shapes = tuple(tuple(getattr(v.aval, "shape", ()))
                            for v in eqn.invars)
-            flops = 0.0
-            if name == "dot_general":
-                dims = eqn.params["dimension_numbers"]
-                (lc, _), (lb, _) = dims
-                a = eqn.invars[0].aval.shape
-                b_shape = eqn.invars[1].aval.shape
-                out = eqn.outvars[0].aval.shape
-                k = math.prod(a[i] for i in lc) or 1
-                flops = 2.0 * math.prod(out) * k
-            elif name == "conv_general_dilated":
-                out = eqn.outvars[0].aval.shape
-                rhs = eqn.invars[1].aval.shape
-                dn = eqn.params["dimension_numbers"]
-                # per output element: 2 * C_in * prod(kernel spatial)
-                rhs_spec = dn.rhs_spec  # (out_c, in_c, *spatial)
-                cin = rhs[rhs_spec[1]]
-                spatial = [rhs[i] for i in rhs_spec[2:]]
-                flops = 2.0 * math.prod(out) * cin * math.prod(spatial)
-            else:
-                continue
+            flops = eqn_flops(eqn)
             key = (name, shapes)
             groups[key][0] += mult
             groups[key][1] += flops * mult
@@ -186,6 +175,173 @@ def print_encprop_accounting(encoder, decoder, total, vae_tf, vae_attn,
           f"{chip_tflops / (full_img + vae_tf):.3f}")
 
 
+def _image_cost_entry(kind: str, cfg) -> dict:
+    """Per-stage analytic cost of one image pipeline (``t2i``/``sdxl``)
+    at batch 1: eval_shape'd params (no init — the SDXL entry covers a
+    2.6B tree in seconds on CPU), stage FLOPs/HBM-bytes from the same
+    jaxpr walk the runtime uses (obs/costmodel.py::trace_cost). CFG
+    factors are baked in per image: conditioning encodes cond+uncond
+    (×2), the denoise stage runs 2·num_steps UNet forwards."""
+    from cassmantle_tpu.models.clip_text import ClipTextEncoder
+    from cassmantle_tpu.models.vae import VAEDecoder
+    from cassmantle_tpu.obs import costmodel
+
+    m = cfg.models
+    s = cfg.sampler
+    dtype = jnp.dtype(m.param_dtype)
+    pad_len = min(s.prompt_pad_len, m.clip_text.max_positions)
+    if kind == "sdxl":
+        pad_len = min(pad_len, m.clip_text_2.max_positions)
+    vae_scale = 2 ** (len(m.vae.channel_mults) - 1)
+    lat_hw = s.image_size // vae_scale
+    rng = jax.random.PRNGKey(0)
+    ids = jax.ShapeDtypeStruct((1, pad_len), jnp.int32)
+    lat = jax.ShapeDtypeStruct((1, lat_hw, lat_hw, 4), dtype)
+    ts = jax.ShapeDtypeStruct((1,), jnp.int32)
+    ctx = jax.ShapeDtypeStruct((1, pad_len, m.unet.context_dim), dtype)
+
+    clip = ClipTextEncoder(m.clip_text)
+    clip_params = jax.eval_shape(clip.init, rng, ids)
+    enc_f, enc_b = costmodel.trace_cost(
+        lambda p, i: clip.apply(p, i), clip_params, ids)
+    unet = UNet(m.unet)
+    if kind == "sdxl":
+        clip2 = ClipTextEncoder(m.clip_text_2)
+        clip2_params = jax.eval_shape(clip2.init, rng, ids)
+        f2, b2 = costmodel.trace_cost(
+            lambda p, i: clip2.apply(p, i), clip2_params, ids)
+        enc_f, enc_b = enc_f + f2, enc_b + b2
+        add = jax.ShapeDtypeStruct((1, m.unet.addition_embed_dim), dtype)
+        unet_params = jax.eval_shape(unet.init, rng, lat, ts, ctx, add)
+        unet_f, unet_b = costmodel.trace_cost(
+            lambda p, l, t, c, a: unet.apply(p, l, t, c, a),
+            unet_params, lat, ts, ctx, add)
+        signature = costmodel.sdxl_signature(cfg)
+    else:
+        unet_params = jax.eval_shape(unet.init, rng, lat, ts, ctx)
+        unet_f, unet_b = costmodel.trace_cost(
+            lambda p, l, t, c: unet.apply(p, l, t, c),
+            unet_params, lat, ts, ctx)
+        signature = costmodel.t2i_signature(cfg)
+    vae = VAEDecoder(m.vae)
+    vae_params = jax.eval_shape(vae.init, rng, lat)
+    vae_f, vae_b = costmodel.trace_cost(
+        lambda p, z: vae.apply(p, z), vae_params, lat)
+
+    stages = {
+        # cond + uncond conditioning per image
+        "clip_encode": {"flops": int(2 * enc_f),
+                        "hbm_bytes": int(2 * enc_b)},
+        # CFG doubles every denoise forward
+        "denoise": {"flops": int(2 * s.num_steps * unet_f),
+                    "hbm_bytes": int(2 * s.num_steps * unet_b)},
+        "vae_decode": {"flops": int(vae_f), "hbm_bytes": int(vae_b)},
+    }
+    total_f = sum(st["flops"] for st in stages.values())
+    total_b = sum(st["hbm_bytes"] for st in stages.values())
+    buckets = (1, 2, 4, 8)
+    return {
+        "signature": signature,
+        "image_size": s.image_size,
+        "num_steps": s.num_steps,
+        "sampler": s.kind,
+        "stages": stages,
+        "flops_per_item": total_f,
+        "hbm_bytes_per_item": total_b,
+        # batch-linear (dot/conv flops scale with B): per-bucket totals
+        "buckets": {str(b): total_f * b for b in buckets},
+    }
+
+
+def _lm_cost_entry(cfg) -> dict:
+    """Prompt-LM analytic cost: dense decode reads every weight per
+    token — 2·N FLOPs and N·itemsize HBM bytes per token processed
+    (PERF_NOTES "LM decode accounting"); N from an eval_shape init."""
+    from cassmantle_tpu.models.gpt2 import GPT2LM
+    from cassmantle_tpu.obs import costmodel
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    m = cfg.models.gpt2
+    model = GPT2LM(m)
+    params = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 8), jnp.int32))
+    n = costmodel.params_count(params)
+    per_token = 2 * n
+    itemsize = jnp.dtype(cfg.models.param_dtype).itemsize
+    return {
+        "signature": costmodel.lm_signature(m),
+        "model": "gpt2",
+        "params": n,
+        "flops_per_item": per_token,           # per token processed
+        "hbm_bytes_per_item": n * itemsize,    # weight read per token
+        "prompt_buckets": list(PromptGenerator.PROMPT_BUCKETS),
+        "batch_buckets": list(PromptGenerator.BATCH_BUCKETS),
+        "buckets": {str(b): per_token * b
+                    for b in PromptGenerator.PROMPT_BUCKETS},
+    }
+
+
+def _scorer_cost_entry(cfg, seq_len: int = 16) -> dict:
+    """MiniLM scorer analytic cost per encoded row (seq_len tokens)."""
+    from cassmantle_tpu.models.minilm import MiniLMEncoder
+    from cassmantle_tpu.obs import costmodel
+
+    m = cfg.models.minilm
+    model = MiniLMEncoder(m)
+    seq_len = min(seq_len, m.max_positions)
+    ids = jax.ShapeDtypeStruct((1, seq_len), jnp.int32)
+    mask = jax.ShapeDtypeStruct((1, seq_len), jnp.int32)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0), ids, mask)
+    n = costmodel.params_count(params)
+    per_row = 2 * n * seq_len
+    return {
+        "signature": costmodel.scorer_signature(m, seq_len),
+        "model": "minilm",
+        "params": n,
+        "seq_len": seq_len,
+        "flops_per_item": per_row,             # per encoded row
+        "hbm_bytes_per_item": n * 4,           # fp32 weight read
+        "buckets": {str(b): per_row * b
+                    for b in cfg.serving.score_batch_sizes},
+    }
+
+
+def emit_cost_model(path: str) -> dict:
+    """``--emit-cost-model``: write the machine-readable analytic cost
+    model (FLOPs + HBM-bytes proxy per pipeline/stage/bucket for the
+    PRODUCTION configs) the serving pipelines load at dispatch time
+    (obs/costmodel.py). Everything is shape-derived under eval_shape —
+    deterministic integers, no weights, runs on any backend in seconds —
+    so the committed ``data/cost_model.json`` doubles as a drift gate
+    (tests/test_obs_device.py regenerates and compares)."""
+    from cassmantle_tpu.config import FrameworkConfig, sdxl_config
+    from cassmantle_tpu.obs import costmodel
+
+    model = {
+        "version": 1,
+        "generated_by": "python tools/profile_unet.py --emit-cost-model",
+        "chip_tflops": costmodel.DEFAULT_CHIP_TFLOPS,
+        "note": ("analytic dot/conv FLOPs (obs/costmodel.py trace_cost; "
+                 "same math as --cost-table); hbm_bytes is a roofline "
+                 "proxy (operand+result buffer bytes, fusion ignored — "
+                 "an upper bound on true traffic)"),
+        "pipelines": {
+            "t2i": _image_cost_entry("t2i", FrameworkConfig()),
+            "sdxl": _image_cost_entry("sdxl", sdxl_config()),
+            "prompt": _lm_cost_entry(FrameworkConfig()),
+            "scorer": _scorer_cost_entry(FrameworkConfig()),
+        },
+    }
+    import json
+
+    with open(path, "w") as f:
+        json.dump(model, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"cost model -> {path}")
+    return model
+
+
 def main():
     import argparse
 
@@ -203,6 +359,13 @@ def main():
                          "scan body costs multiplied by its trip count, "
                          "+ VAE decode) instead of one UNet forward")
     ap.add_argument("--platform", default="auto", choices=("auto", "cpu"))
+    ap.add_argument("--emit-cost-model", metavar="PATH",
+                    help="write the machine-readable analytic cost model "
+                         "(FLOPs + HBM bytes per pipeline/stage/bucket, "
+                         "production configs, eval_shape only) the "
+                         "serving pipelines load for live roofline "
+                         "attribution, then exit; the committed copy is "
+                         "data/cost_model.json")
     ap.add_argument("--sdxl", action="store_true",
                     help="with --cost-table: analyze the SDXL-base "
                          "geometry at 1024 instead of SD1.5-512 — the "
@@ -215,6 +378,9 @@ def main():
 
         pin_cpu_platform(virtual_devices=False)
     enable_compile_cache()
+    if opts.emit_cost_model:
+        emit_cost_model(opts.emit_cost_model)
+        return
     batch = opts.batch
     if opts.sdxl:
         # Analytic-only path: abstract params via eval_shape (make_jaxpr
