@@ -451,6 +451,28 @@ def _encprop_smoke_geometry() -> bool:
         "1", "true", "yes", "on")
 
 
+def _smoke_clip_harness(weights_dir: str, smoke: bool):
+    """The quality-report harness the A/B entries share: real CLIP
+    weights off-smoke, the tiny fixed test geometry on the CPU smoke
+    (one definition so the encprop and lcm entries can never gate with
+    different harnesses)."""
+    from cassmantle_tpu.eval.clip_parity import ClipSimilarityHarness
+
+    if not smoke:
+        return ClipSimilarityHarness(weights_dir=weights_dir)
+
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.models.clip_vision import ClipVisionConfig
+
+    return ClipSimilarityHarness(
+        text_cfg=test_config().models.clip_text,
+        vision_cfg=ClipVisionConfig(
+            image_size=32, patch_size=8, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=4,
+            projection_dim=64),
+        pad_len=16)
+
+
 def _bench_encprop_ab(metric: str, weights_dir: str, sdxl: bool) -> dict:
     """Same-seed A/B for encoder propagation (the `sd15_encprop` /
     `sdxl_encprop` entries): ONE harness builds the full-forward arm
@@ -472,10 +494,7 @@ def _bench_encprop_ab(metric: str, weights_dir: str, sdxl: bool) -> dict:
     import dataclasses as _dc
 
     jax = _setup_jax()
-    from cassmantle_tpu.eval.clip_parity import (
-        ClipSimilarityHarness,
-        encprop_quality_report,
-    )
+    from cassmantle_tpu.eval.clip_parity import encprop_quality_report
     from cassmantle_tpu.ops.ddim import encprop_key_indices
 
     smoke = _encprop_smoke_geometry()
@@ -527,19 +546,7 @@ def _bench_encprop_ab(metric: str, weights_dir: str, sdxl: bool) -> dict:
     full_ips, full_imgs = run_arm(full_pipe)
     enc_ips, enc_imgs = run_arm(enc_pipe)
 
-    if smoke:
-        from cassmantle_tpu.config import test_config
-        from cassmantle_tpu.models.clip_vision import ClipVisionConfig
-
-        harness = ClipSimilarityHarness(
-            text_cfg=test_config().models.clip_text,
-            vision_cfg=ClipVisionConfig(
-                image_size=32, patch_size=8, hidden_size=64,
-                intermediate_size=128, num_layers=2, num_heads=4,
-                projection_dim=64),
-            pad_len=16)
-    else:
-        harness = ClipSimilarityHarness(weights_dir=weights_dir)
+    harness = _smoke_clip_harness(weights_dir, smoke)
     quality = encprop_quality_report(harness, enc_imgs, full_imgs, prompts)
 
     s = enc_cfg.sampler
@@ -598,6 +605,125 @@ def bench_sdxl_encprop(weights_dir: str) -> dict:
     res["encprop_ceiling_ips"] = SDXL_ENCPROP_CEILING_IPS
     # see bench_sd15_encprop: no ceiling fraction from the 64px smoke
     return res if _encprop_smoke_geometry() else _sdxl_ceiling_context(res)
+
+
+def _lcm_smoke_geometry() -> bool:
+    return os.environ.get("BENCH_LCM_SMOKE_GEOMETRY", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def bench_sd15_lcm(weights_dir: str) -> dict:
+    """Same-seed A/B for few-step consistency serving (the `sd15_lcm`
+    entry, ISSUE 15): teacher arm = the fixed DDIM-50 SD1.5 config,
+    student arm = config.lcm_serving_config() — FOUR direct x0
+    predictions per image through the boundary-parameterized
+    consistency sampler (ops/samplers.py). Both arms run the SAME
+    prompts and seeds; the record carries img/s per arm, the
+    UNet-forwards-per-image delta (teacher's schedule length vs the
+    `pipeline.consistency_steps` counter, verified in-entry), and the
+    eval/clip_parity.py consistency quality report between the arms'
+    same-seed outputs. On hardware the student arm should load a
+    DISTILLED checkpoint (parallel/train.py::ConsistencyDistillTrainer
+    — same tree layout as the teacher's, so it drops into weights_dir
+    as unet.safetensors of its own deployment); here the arms share
+    one param tree, so the quality report measures the plumbing, and
+    only counts as a gate once real distilled weights are in play.
+
+    Env: BENCH_LCM_SMOKE_GEOMETRY=1 swaps in the 64px test geometry
+    (teacher at 20 steps — the few-step accounting anchor in
+    docs/PERF_NOTES.md — student at 4) so the CPU smoke exercises the
+    real sampler structure; those numbers exercise the scan and the
+    counter plumbing, not the MXU, and are NOT hardware evidence.
+    BENCH_LCM_REPS overrides the timed rep count. ``noise_tolerance``
+    is carried on the record so tools/bench_diff.py treats the smoke's
+    run-to-run variance honestly."""
+    import dataclasses as _dc
+
+    jax = _setup_jax()
+    from cassmantle_tpu.eval.clip_parity import (
+        consistency_quality_report,
+    )
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils.logging import metrics
+
+    smoke = _lcm_smoke_geometry()
+    if smoke:
+        from cassmantle_tpu.config import test_config
+
+        base = test_config()
+        base = base.replace(sampler=_dc.replace(base.sampler,
+                                                num_steps=20))
+        lcm_cfg = base.replace(sampler=_dc.replace(
+            base.sampler, consistency=True, num_steps=4,
+            consistency_teacher_steps=20))
+    else:
+        from cassmantle_tpu.config import (
+            FrameworkConfig,
+            lcm_serving_config,
+        )
+
+        base = FrameworkConfig()
+        lcm_cfg = lcm_serving_config()
+
+    full_pipe = Text2ImagePipeline(base, weights_dir=weights_dir)
+    lcm_pipe = Text2ImagePipeline(lcm_cfg, weights_dir=weights_dir,
+                                  share_params_with=full_pipe)
+
+    batch = 1 if smoke else BATCH
+    reps = int(os.environ.get("BENCH_LCM_REPS", "3"))
+    prompts = (PROMPTS * ((batch + len(PROMPTS) - 1) // len(PROMPTS))
+               )[:batch]
+
+    def run_arm(pipe):
+        steps_before = metrics.counter_total("pipeline.consistency_steps")
+        imgs = pipe.generate(prompts, seed=0)     # warmup compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            imgs = pipe.generate(prompts, seed=1)  # same seed both arms
+        elapsed = time.perf_counter() - t0
+        ips = reps * len(prompts) / elapsed / max(
+            1, jax.local_device_count())
+        images = (reps + 1) * len(prompts)
+        forwards = (metrics.counter_total("pipeline.consistency_steps")
+                    - steps_before) / images
+        return ips, imgs, forwards
+
+    full_ips, full_imgs, full_counted = run_arm(full_pipe)
+    lcm_ips, lcm_imgs, lcm_counted = run_arm(lcm_pipe)
+    assert full_counted == 0.0, "teacher arm must not tick the counter"
+    assert lcm_counted == lcm_cfg.sampler.num_steps, (
+        f"counter says {lcm_counted} consistency forwards/image, "
+        f"config says {lcm_cfg.sampler.num_steps}")
+
+    harness = _smoke_clip_harness(weights_dir, smoke)
+    quality = consistency_quality_report(harness, lcm_imgs, full_imgs,
+                                         prompts)
+
+    return {
+        "metric": "sd15_512px_lcm4_images_per_sec_per_chip",
+        "value": round(lcm_ips, 4),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "ab_versus": "teacher arm (same prompts/seed, shared params)",
+        "full_images_per_sec": round(full_ips, 4),
+        "speedup_vs_full": (round(lcm_ips / full_ips, 4)
+                            if full_ips else None),
+        "batch": batch,
+        "timed_rounds": reps,
+        # the CPU smoke measures scheduler wall clock on a shared
+        # 2-core host at toy geometry — noisier than the MXU entries
+        "noise_tolerance": 0.35,
+        "unet_forwards_per_image": {
+            "teacher": base.sampler.num_steps,
+            "student": int(lcm_counted),
+            "counter": "pipeline.consistency_steps",
+        },
+        "consistency": {
+            "num_steps": lcm_cfg.sampler.num_steps,
+            "teacher_steps": lcm_cfg.sampler.consistency_teacher_steps,
+        },
+        "quality": quality,
+    }
 
 
 def bench_scorer(weights_dir: str) -> dict:
@@ -1869,6 +1995,7 @@ SUITE = {
     "sd15_int8": bench_sd15_int8,
     "sd15_staged": bench_sd15_staged,
     "sd15_encprop": bench_sd15_encprop,
+    "sd15_lcm": bench_sd15_lcm,
     "sd15_b8": bench_sd15_b8,
     "sdxl": bench_sdxl,
     "sdxl_encprop": bench_sdxl_encprop,

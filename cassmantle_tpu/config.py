@@ -266,6 +266,36 @@ class SamplerConfig:
     # stride 3 this yields 20 encoder forwards per trajectory (the
     # encoder is skipped on 60% of steps).
     encprop_dense_steps: int = 5
+    # Few-step consistency serving (ops/samplers.py::consistency_sample;
+    # ISSUE 15): sample with a consistency/LCM-distilled student —
+    # ``num_steps`` (1-8) direct x0 predictions through the boundary
+    # c_skip/c_out parameterization instead of a long ODE solve. The
+    # student shares the teacher's UNetConfig arch and checkpoint
+    # layout (parallel/train.py::ConsistencyDistillTrainer), so it
+    # loads through the unchanged utils/checkpoint.py / share_compatible
+    # machinery. Does NOT compose with deepcache/encprop (the student
+    # is trained for direct few-step prediction — there is no long loop
+    # to cache into); composes with the staged continuous-batching path
+    # (a consistency slot stepper) and the execution-level levers
+    # (fused_conv, int8). CASSMANTLE_NO_CONSISTENCY=1 is the runtime
+    # kill switch: it reverts serving bit-exactly to the TEACHER path —
+    # the plain ``kind`` sampler at ``consistency_teacher_steps``.
+    # Quality gates via eval/clip_parity.py::consistency_quality_report.
+    consistency: bool = False
+    # The deployed UNet checkpoint IS a consistency-distilled student,
+    # even though serving defaults to the teacher schedule — the signal
+    # that lets the brownout ladder's few-step tier step INTO
+    # consistency sampling under SLO burn (serving/overload.py). Stock
+    # (undistilled) checkpoints MUST leave this False: 4-step
+    # boundary-parameterized sampling through an eps-net that was never
+    # distilled produces near-noise, so without this flag the ladder
+    # skips the few-step delta and falls through to the resolution tier
+    # instead. ``consistency=True`` implies a student checkpoint and
+    # does not need this flag.
+    consistency_available: bool = False
+    # The teacher schedule the kill switch reverts to — and the solver
+    # discretization the distillation trainer integrates.
+    consistency_teacher_steps: int = 50
     # Text decode (reference decodes 32-96 new tokens, backend.py:250-255;
     # its hosted call samples greedily — temperature 0 is reference
     # parity, >0 enables top-k Gumbel sampling for story variety).
@@ -574,6 +604,10 @@ class QualityGateConfig:
         # encoder propagation reuses key-step encoder features on 60%
         # of steps; like deepcache it claims near-anchor quality
         ("encprop", 0.95),
+        # the 4-step consistency student trades the most quality for
+        # the biggest step-count win (LCM-class results, PAPERS.md
+        # Efficient Diffusion Models survey)
+        ("lcm", 0.90),
     )
     # absolute floor for the anchor itself: catches a pipeline bug that
     # degrades every preset uniformly (ratios would all still pass)
@@ -709,6 +743,26 @@ def encprop_serving_config() -> FrameworkConfig:
         models=dataclasses.replace(
             base.models,
             vae=dataclasses.replace(base.models.vae, fused_conv=True)))
+
+
+def lcm_serving_config() -> FrameworkConfig:
+    """Few-step image serving (ROADMAP item 3a, ISSUE 15): a
+    consistency/LCM-distilled student of the zoo UNet sampled at FOUR
+    direct x0 predictions per image instead of the 50-step DDIM solve —
+    the step-COUNT lever the Efficient Diffusion Models survey
+    (PAPERS.md) names as the largest remaining family, ~9x fewer
+    per-image FLOPs than the north star (docs/PERF_NOTES.md "Few-step
+    accounting"). The student shares the teacher's param tree and
+    checkpoint layout (distill with
+    parallel/train.py::ConsistencyDistillTrainer, serve its checkpoint
+    through the unchanged weights path); quality gates via
+    eval/clip_parity.py::consistency_quality_report and the `lcm` row
+    of QualityGateConfig. This is the ON arm of the `sd15_lcm` bench
+    A/B; CASSMANTLE_NO_CONSISTENCY=1 reverts bit-exactly to the
+    teacher's DDIM-50 path."""
+
+    return FrameworkConfig(
+        sampler=SamplerConfig(consistency=True, num_steps=4))
 
 
 def deepcache_serving_config() -> FrameworkConfig:

@@ -183,6 +183,46 @@ class ClipSimilarityHarness:
 ENCPROP_IMAGE_SIM_FLOOR = 0.95
 
 
+# Image-quality floor for few-step consistency serving: mean
+# CLIP-vision similarity between the 4-step student's images and the
+# teacher's SAME-SEED full-schedule images must stay above this. Lower
+# than the encprop floor — the student is a learned approximation of
+# the whole trajectory, not a feature-reuse of it (LCM-class quality,
+# the `lcm` row of QualityGateConfig). Enforced only on real-weights
+# runs, advisory on random init, like every other gate.
+CONSISTENCY_IMAGE_SIM_FLOOR = 0.90
+
+
+def consistency_quality_report(
+    harness: ClipSimilarityHarness,
+    images_student: np.ndarray,
+    images_teacher: np.ndarray,
+    prompts: Sequence[str],
+    floor: float = CONSISTENCY_IMAGE_SIM_FLOOR,
+) -> dict:
+    """The few-step quality gate (ISSUE 15): same-seed student (4-step
+    consistency) vs teacher (full-schedule) outputs compared in
+    CLIP-vision space, plus both arms' prompt CLIP-sim for the record —
+    the encprop gate's structure applied to the distilled student.
+    ``passes_floor`` is the gate verdict; ``gate_enforced`` says
+    whether it is a real-weights measurement or plumbing-only."""
+    pair = harness.image_similarity(images_student, images_teacher)
+    return {
+        "image_sim_mean": float(np.mean(pair)),
+        "image_sim_min": float(np.min(pair)),
+        "floor": float(floor),
+        "passes_floor": bool(np.mean(pair) >= floor),
+        "exact": bool(np.array_equal(images_student, images_teacher)),
+        "clip_sim_student": float(
+            np.mean(harness.similarity(images_student, prompts))),
+        "clip_sim_teacher": float(
+            np.mean(harness.similarity(images_teacher, prompts))),
+        "n": int(images_teacher.shape[0]),
+        "real_weights": harness.loaded_real_weights,
+        "gate_enforced": harness.loaded_real_weights,
+    }
+
+
 def encprop_quality_report(
     harness: ClipSimilarityHarness,
     images_encprop: np.ndarray,

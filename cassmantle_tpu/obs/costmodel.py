@@ -179,12 +179,15 @@ def _digest(*parts) -> str:
 
 def t2i_signature(cfg, sampler_cfg=None) -> str:
     """SD1.5 text→image dispatch signature: everything the analytic
-    per-image FLOPs depend on (model archs + the sampler geometry)."""
+    per-image FLOPs depend on (model archs + the sampler geometry —
+    ``consistency`` included, since the few-step path runs num_steps
+    direct forwards of the same UNet)."""
     s = sampler_cfg if sampler_cfg is not None else cfg.sampler
     m = cfg.models
     return _digest("t2i", m.unet.arch(), m.vae.arch(), m.clip_text,
                    s.image_size, s.num_steps, s.kind, s.deepcache,
-                   s.encprop, s.encprop_stride, s.encprop_dense_steps)
+                   s.encprop, s.encprop_stride, s.encprop_dense_steps,
+                   s.consistency)
 
 
 def sdxl_signature(cfg, sampler_cfg=None) -> str:
@@ -193,7 +196,7 @@ def sdxl_signature(cfg, sampler_cfg=None) -> str:
     return _digest("sdxl", m.unet.arch(), m.vae.arch(), m.clip_text,
                    m.clip_text_2, s.image_size, s.num_steps, s.kind,
                    s.deepcache, s.encprop, s.encprop_stride,
-                   s.encprop_dense_steps)
+                   s.encprop_dense_steps, s.consistency)
 
 
 def lm_signature(mcfg) -> str:
@@ -235,10 +238,20 @@ def load_cost_model(path: Optional[str] = None) -> Dict:
 
 def committed_entry(kind: str, signature: str) -> Optional[Dict]:
     """The artifact's entry for this pipeline kind IF its signature
-    matches the runtime config (production presets); None otherwise."""
-    entry = load_cost_model().get("pipelines", {}).get(kind)
+    matches the runtime config (production presets); None otherwise.
+    Preset VARIANT entries (e.g. ``t2i_lcm`` — the same pipeline kind
+    at a different committed sampler geometry) are found by signature
+    scan, so the lcm preset resolves without tracing too: signatures
+    are digests over the kind prefix + full config, so a cross-kind
+    collision cannot occur."""
+    pipelines = load_cost_model().get("pipelines", {})
+    entry = pipelines.get(kind)
     if isinstance(entry, dict) and entry.get("signature") == signature:
         return entry
+    for other in pipelines.values():
+        if isinstance(other, dict) and \
+                other.get("signature") == signature:
+            return other
     return None
 
 

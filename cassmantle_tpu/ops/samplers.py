@@ -35,6 +35,159 @@ from cassmantle_tpu.ops.ddim import (
 
 SAMPLER_KINDS = ("ddim", "euler", "dpmpp_2m")
 
+#: PRNG seed of the deterministic re-noise ladder multistep consistency
+#: sampling uses between f-evaluations: step noise is
+#: ``normal(fold_in(PRNGKey(seed), t), latent_row_shape)`` — a pure
+#: function of the TIMESTEP, shared across batch rows. That makes the
+#: sampler deterministic (no carried key chain), batch-invariant (a
+#: request's trajectory does not depend on what it batched with), and
+#: replayable at step granularity by the staged slot stepper (each slot
+#: folds its own current timestep), which is what lets few-step
+#: requests ride the continuous-batching path (eta>0-style carried
+#: chains cannot — see make_slot_sampler's rejection).
+CONSISTENCY_NOISE_SEED = 0x1C3
+
+
+def consistency_disabled() -> bool:
+    """Operator kill switch (docs/DEPLOY.md §6): any truthy
+    CASSMANTLE_NO_CONSISTENCY reverts consistency-configured serving to
+    the TEACHER path — the plain configured sampler kind at
+    ``SamplerConfig.consistency_teacher_steps`` — bit-exactly (read at
+    pipeline build/trace time, like CASSMANTLE_NO_ENCPROP: set it
+    before serving starts)."""
+    import os
+
+    return os.environ.get("CASSMANTLE_NO_CONSISTENCY", "").lower() \
+        not in ("", "0", "false", "no", "off")
+
+
+def consistency_boundary(sigma, sigma_min, sigma_data: float = 0.5):
+    """The consistency-model boundary-condition parameterization
+    (c_skip, c_out) at noise level ``sigma`` (k-space,
+    sqrt((1-ᾱ)/ᾱ)): f(x, σ) = c_skip(σ)·x + c_out(σ)·x0_pred(x, σ).
+    At σ = σ_min this is EXACTLY (1, 0) — f is the identity at the
+    clean boundary, the constraint that makes the distilled student a
+    consistency function rather than a free-form few-step net.
+
+    Written with ``** 0.5`` (not jnp.sqrt) so host-side schedule
+    precomputation stays numpy even when it happens inside a jit trace
+    (run_cfg_denoise builds the schedule at pipeline trace time) while
+    the SAME expression serves traced sigmas in the distillation
+    step."""
+    c_skip = sigma_data**2 / ((sigma - sigma_min) ** 2 + sigma_data**2)
+    c_out = (sigma_data * (sigma - sigma_min)
+             / (sigma**2 + sigma_data**2) ** 0.5)
+    return c_skip, c_out
+
+
+def consistency_renoise(t, shape, dtype=jnp.float32):
+    """The deterministic per-step re-noise draw (see
+    CONSISTENCY_NOISE_SEED): one latent ROW of noise keyed on the
+    timestep, broadcast across the batch. Shared verbatim by the
+    monolithic scan, the slot stepper, and the reference loop in
+    tests/test_samplers.py."""
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(CONSISTENCY_NOISE_SEED), t)
+    return jax.random.normal(key, shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsistencySchedule:
+    """Few-step consistency/LCM sampling schedule, all step math
+    precomputed host-side. Timesteps are drawn FROM THE TEACHER SOLVER
+    DISCRETIZATION — the same ``strided_timesteps(teacher_steps)`` grid
+    ``ConsistencyDistillTrainer`` trains on (the LCM recipe: the student
+    only ever sees schedule positions of the teacher's ODE
+    discretization, so serving must query exactly those points, never
+    interpolate past them). Within that grid the selection is TRAILING
+    (start at the grid's noisiest point, stride down, never reach the
+    grid's final t=0 entry) so the LAST f-evaluation sits at a genuinely
+    noisy timestep and its output IS the final x0 — touching t=0 would
+    spend the final UNet forward evaluating f where the boundary
+    condition makes it the identity."""
+
+    timesteps: jnp.ndarray        # (T,) int32 descending, last > 0
+    alpha_bars: jnp.ndarray       # (T,) float32 ᾱ at each f-eval step
+    alpha_bars_next: jnp.ndarray  # (T,) ᾱ of the re-noise target; last=1
+    c_skip: jnp.ndarray           # (T,) boundary coefficients
+    c_out: jnp.ndarray            # (T,)
+
+    @staticmethod
+    def create(num_steps: int, teacher_steps: int = 50,
+               num_train_steps: int = 1000,
+               sigma_data: float = 0.5) -> "ConsistencySchedule":
+        from cassmantle_tpu.ops.ddim import strided_timesteps
+
+        assert num_steps >= 1
+        ab_full = _alpha_bars(num_train_steps)
+        # the trainer's grid, minus its final t=0 point (the trainer
+        # never queries the student there — skip ≥ 1 — and f is the
+        # identity there by the boundary condition)
+        grid = strided_timesteps(teacher_steps, num_train_steps)[:-1]
+        assert num_steps <= len(grid), (
+            f"consistency needs num_steps {num_steps} <= "
+            f"teacher_steps-1 = {len(grid)} (the student is only "
+            f"trained on the teacher discretization's query points)")
+        ts = grid[(len(grid) // num_steps)
+                  * np.arange(num_steps)].astype(np.int32)
+        ab = ab_full[ts]
+        ab_next = np.concatenate([ab[1:], [1.0]])
+        sigma = np.sqrt((1.0 - ab) / ab)
+        sigma_min = float(np.sqrt((1.0 - ab_full[0]) / ab_full[0]))
+        c_skip, c_out = consistency_boundary(sigma, sigma_min, sigma_data)
+        f32 = lambda a: jnp.asarray(np.asarray(a, np.float32))  # noqa: E731
+        return ConsistencySchedule(
+            timesteps=jnp.asarray(ts), alpha_bars=f32(ab),
+            alpha_bars_next=f32(ab_next), c_skip=f32(c_skip),
+            c_out=f32(c_out))
+
+
+def consistency_sample(
+    denoise: Callable[[jax.Array, jax.Array], jax.Array],
+    latents: jax.Array,
+    schedule: ConsistencySchedule,
+) -> jax.Array:
+    """Multistep consistency sampling: per step, ONE UNet forward maps
+    the current state straight to an x0 estimate through the boundary
+    parameterization, then the state re-noises to the next (lower)
+    evaluation timestep — num_steps total UNet forwards per image,
+    which is the whole point (docs/PERF_NOTES.md "Few-step
+    accounting"). ``latents`` standard normal (VP convention, same as
+    every other sampler); one ``lax.scan``, deterministic (see
+    consistency_renoise). The final step's ᾱ_next is 1.0, so its
+    update reduces exactly to the x0 estimate."""
+
+    def step(x, per):
+        t, ab, ab_next, c_skip, c_out = per
+        eps = denoise(x, t)
+        x0 = (x - jnp.sqrt(1.0 - ab) * eps) / jnp.sqrt(ab)
+        f = c_skip * x + c_out * x0
+        noise = consistency_renoise(t, x.shape[1:], x.dtype)
+        x = jnp.sqrt(ab_next) * f + jnp.sqrt(1.0 - ab_next) * noise
+        return x, None
+
+    final, _ = jax.lax.scan(
+        step, latents,
+        (schedule.timesteps, schedule.alpha_bars,
+         schedule.alpha_bars_next, schedule.c_skip, schedule.c_out),
+    )
+    return final
+
+
+def make_consistency_sampler(num_steps: int, teacher_steps: int = 50):
+    """num_steps (1–8) -> ``sample(denoise, latents, rng=None)`` — the
+    few-step counterpart of :func:`make_sampler` (rng accepted for
+    signature parity and ignored: the re-noise ladder is deterministic
+    by construction). ``teacher_steps`` is the solver discretization
+    the student was distilled on (``SamplerConfig.
+    consistency_teacher_steps``) — the grid the schedule queries."""
+    schedule = ConsistencySchedule.create(num_steps, teacher_steps)
+
+    def sample(denoise, latents, rng=None):
+        return consistency_sample(denoise, latents, schedule)
+
+    return sample
+
 
 @dataclasses.dataclass(frozen=True)
 class EulerSchedule:
@@ -340,7 +493,8 @@ def make_encprop_sampler(kind: str, num_steps: int, stride: int,
                      f"choose from {SAMPLER_KINDS}")
 
 
-def make_slot_sampler(kind: str, num_steps: int, eta: float = 0.0):
+def make_slot_sampler(kind: str, num_steps: int, eta: float = 0.0,
+                      teacher_steps: int = 50):
     """Step-granular counterpart of :func:`make_sampler` for the staged
     serving path (serving/stages.py): instead of one ``lax.scan``
     position shared by the whole batch, every slot carries its OWN step
@@ -440,8 +594,36 @@ def make_slot_sampler(kind: str, num_steps: int, eta: float = 0.0):
 
         return prepare, slot_step, num_steps
 
+    if kind == "consistency":
+        # the few-step student rides the staged continuous-batching
+        # path: each slot folds its OWN timestep into the deterministic
+        # re-noise ladder, so the per-slot arithmetic is exactly
+        # consistency_sample's scan body and a solo staged trajectory
+        # is bit-identical to the monolithic scan
+        cschedule = ConsistencySchedule.create(num_steps, teacher_steps)
+
+        def prepare(latents):
+            return latents, jnp.zeros_like(latents)
+
+        def slot_step(denoise, x, aux, idx):
+            t = cschedule.timesteps[idx]
+            ab = _b(cschedule.alpha_bars[idx])
+            ab_next = _b(cschedule.alpha_bars_next[idx])
+            c_skip = _b(cschedule.c_skip[idx])
+            c_out = _b(cschedule.c_out[idx])
+            eps = denoise(x, t)
+            x0 = (x - jnp.sqrt(1.0 - ab) * eps) / jnp.sqrt(ab)
+            f = c_skip * x + c_out * x0
+            noise = jax.vmap(
+                lambda ti: consistency_renoise(ti, x.shape[1:], x.dtype)
+            )(t)
+            return jnp.sqrt(ab_next) * f + \
+                jnp.sqrt(1.0 - ab_next) * noise, aux
+
+        return prepare, slot_step, num_steps
+
     raise ValueError(f"unknown sampler kind {kind!r}; "
-                     f"choose from {SAMPLER_KINDS}")
+                     f"choose from {SAMPLER_KINDS} or 'consistency'")
 
 
 def make_img2img_sampler(kind: str, num_steps: int, start: int,

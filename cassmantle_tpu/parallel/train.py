@@ -22,7 +22,7 @@ step. Design:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -122,3 +122,197 @@ class DiffusionTrainer:
 
     def step(self, params, opt_state, batch, rng):
         return self._step(params, opt_state, batch, rng)
+
+
+class ConsistencyDistillTrainer:
+    """Consistency/LCM distillation of a zoo UNet into a few-step
+    student (ROADMAP item 3a, ISSUE 15) on the same train infrastructure
+    as :class:`DiffusionTrainer`.
+
+    - **teacher**: the frozen zoo UNet plus ONE deterministic DDIM
+      solver step (:func:`~cassmantle_tpu.ops.ddim.ddim_update`) over a
+      ``solver_steps``-point discretization as the ODE-step oracle —
+      ``skip`` > 1 strides the oracle step over several schedule
+      positions (LCM's skip-step trick: one teacher forward covers a
+      wider λ interval, so the student sees larger consistency hops for
+      the same compute).
+    - **student**: the SAME ``UNetConfig`` architecture, initialized
+      from the teacher tree — identical param pytree, so
+      ``utils/checkpoint.py`` and ``share_compatible`` work unchanged
+      and a distilled checkpoint drops into the serving weights path
+      as-is (tests/test_distill.py pins the layout).
+    - **EMA target network**: the consistency target is evaluated by an
+      exponential moving average of the student (``ema_decay``), the
+      stabilizer from the consistency-models recipe; its update rides
+      inside the jitted step.
+    - **loss**: skip-step consistency loss — noise clean latents to a
+      random schedule position n, run the teacher oracle one (strided)
+      step down the ODE, and pull the student's boundary-parameterized
+      x0 estimate at n toward the EMA target's estimate at n+skip
+      (``consistency_boundary`` c_skip/c_out, the same parameterization
+      the serving sampler applies).
+
+    ``max_serve_steps`` declares the largest ``num_steps`` the student
+    will be served at — the constructor rejects skip/solver
+    combinations whose trained query range does not cover every
+    ``ConsistencySchedule`` up to it (the serving-coverage contract;
+    the schedule only ever queries the teacher discretization, and
+    training must have visited those points).
+
+    With ``mesh`` the batch shards over dp/sp and params shard per
+    sharding rules (exactly DiffusionTrainer's layout); ``mesh=None``
+    runs a plain jit — the CPU toy-geometry path tier-1 exercises.
+    ``donate_argnums`` updates student/EMA/optimizer state in place;
+    the teacher tree is a plain (non-donated) argument and is never
+    written.
+    """
+
+    def __init__(
+        self,
+        cfg: FrameworkConfig,
+        mesh: "Mesh | None" = None,
+        lr: float = 1e-4,
+        solver_steps: Optional[int] = None,
+        skip: int = 1,
+        ema_decay: float = 0.95,
+        sigma_data: float = 0.5,
+        num_train_steps: int = 1000,
+        remat: bool = False,
+        max_serve_steps: int = 8,
+    ) -> None:
+        import numpy as np
+
+        from cassmantle_tpu.ops.ddim import (
+            DDIMSchedule,
+            alpha_bars_full,
+        )
+
+        solver_steps = (solver_steps if solver_steps is not None
+                        else cfg.sampler.consistency_teacher_steps)
+        assert 1 <= skip < solver_steps, (
+            f"skip {skip} outside [1, {solver_steps})")
+        # Serving-coverage contract: ConsistencySchedule queries grid
+        # indices (L//m)·j, j < m, over the t>0 grid (L = solver_steps−1
+        # points, ops/samplers.py), while training only queries student
+        # positions n ≤ solver_steps−1−skip (the randint below) — large
+        # skip narrows the trained range. Every schedule this student
+        # may be served at (num_steps ≤ max_serve_steps) must stay
+        # inside it; reject the combination at TRAIN time instead of
+        # silently serving untrained noise levels.
+        grid_len = solver_steps - 1
+        worst = max((grid_len // m) * (m - 1)
+                    for m in range(1, min(max_serve_steps, grid_len) + 1))
+        assert worst <= solver_steps - 1 - skip, (
+            f"skip {skip} leaves serving schedules uncovered: a "
+            f"num_steps<={max_serve_steps} ConsistencySchedule queries "
+            f"grid index {worst} but training only queries up to "
+            f"{solver_steps - 1 - skip}; lower skip or max_serve_steps")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.unet = UNet(cfg.models.unet)
+        self._apply = (jax.checkpoint(self.unet.apply) if remat
+                       else self.unet.apply)
+        self.optimizer = make_optimizer(lr)
+        self.solver_steps = solver_steps
+        self.skip = skip
+        self.ema_decay = float(ema_decay)
+        self.sigma_data = float(sigma_data)
+        sched = DDIMSchedule.create(solver_steps, num_train_steps)
+        self.timesteps = sched.timesteps        # (T,) int32 descending
+        self.alpha_bars = sched.alpha_bars      # (T,) float32
+        ab_full = alpha_bars_full(num_train_steps)
+        self.sigma_min = float(np.sqrt((1.0 - ab_full[0]) / ab_full[0]))
+        self._step = jax.jit(
+            self._distill_step_impl, donate_argnums=(0, 1, 2)
+        )
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, teacher_params) -> Tuple[Any, Any, Any]:
+        """(student, ema, opt_state) from a frozen teacher tree. Student
+        and EMA start as COPIES (standard distillation init — and the
+        donated buffers must not alias the teacher's)."""
+        def copy_tree(tree):
+            return jax.tree_util.tree_map(jnp.array, tree)
+
+        student = copy_tree(teacher_params)
+        ema = copy_tree(teacher_params)
+        if self.mesh is not None:
+            student = shard_params(student, self.mesh)
+            ema = shard_params(ema, self.mesh)
+        opt_state = self.optimizer.init(student)
+        return student, ema, opt_state
+
+    def batch_sharding(self) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P("dp", "sp"))
+
+    def shard_batch(self, batch: Dict[str, jax.Array]
+                    ) -> Dict[str, jax.Array]:
+        if self.mesh is None:
+            return batch
+        lat_sh = self.batch_sharding()
+        ctx_sh = NamedSharding(self.mesh, P("dp"))
+        return {
+            "latents": jax.device_put(batch["latents"], lat_sh),
+            "context": jax.device_put(batch["context"], ctx_sh),
+        }
+
+    # -- step -------------------------------------------------------------
+    def _consistency_f(self, params, x, t, ab, context):
+        """The boundary-parameterized consistency function f(x, t):
+        c_skip·x + c_out·x0_pred, the exact form the serving sampler
+        evaluates (ops/samplers.py::consistency_sample)."""
+        from cassmantle_tpu.ops.samplers import consistency_boundary
+
+        eps = self._apply(params, x, t, context)
+        x0 = (x - jnp.sqrt(1.0 - ab) * eps) / jnp.sqrt(ab)
+        sigma = jnp.sqrt((1.0 - ab) / ab)
+        c_skip, c_out = consistency_boundary(
+            sigma, self.sigma_min, self.sigma_data)
+        return c_skip * x + c_out * x0
+
+    def _distill_step_impl(self, student, ema, opt_state, teacher,
+                           batch, rng):
+        from cassmantle_tpu.ops.ddim import ddim_update
+
+        latents = batch["latents"]
+        context = batch["context"]
+        b = latents.shape[0]
+        rng_n, rng_eps = jax.random.split(rng)
+        # per-sample schedule position n; the oracle maps n -> n+skip
+        n = jax.random.randint(
+            rng_n, (b,), 0, self.timesteps.shape[0] - self.skip)
+        t_n = self.timesteps[n]
+        ab_n = self.alpha_bars[n][:, None, None, None]
+        t_k = self.timesteps[n + self.skip]
+        ab_k = self.alpha_bars[n + self.skip][:, None, None, None]
+        noise = jax.random.normal(rng_eps, latents.shape, latents.dtype)
+        x_n = jnp.sqrt(ab_n) * latents + jnp.sqrt(1.0 - ab_n) * noise
+        # the ODE-step oracle: one teacher forward + one deterministic
+        # DDIM transition down the schedule (eta=0 — the same update
+        # the serving sampler's scan body applies)
+        eps_teacher = self._apply(teacher, x_n, t_n, context)
+        x_k = ddim_update(x_n, eps_teacher, ab_n, ab_k)
+        target = jax.lax.stop_gradient(
+            self._consistency_f(ema, x_k, t_k, ab_k, context))
+
+        def loss_fn(p):
+            pred = self._consistency_f(p, x_n, t_n, ab_n, context)
+            return jnp.mean((pred - target) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(student)
+        updates, new_opt = self.optimizer.update(grads, opt_state, student)
+        new_student = optax.apply_updates(student, updates)
+        d = self.ema_decay
+        new_ema = jax.tree_util.tree_map(
+            lambda e, s: d * e + (1.0 - d) * s, ema, new_student)
+        return new_student, new_ema, new_opt, loss
+
+    def step(self, student, ema, opt_state, teacher, batch, rng):
+        """One distillation step; returns (student, ema, opt_state,
+        loss) with loss still on device — callers accumulating a loss
+        curve should collect device scalars and transfer ONCE at the
+        end, never per step (the host-sync lint's train-loop shape,
+        tests/test_check_jax.py)."""
+        return self._step(student, ema, opt_state, teacher, batch, rng)

@@ -25,7 +25,8 @@ mechanisms fix that:
 - :class:`BrownoutLadder` — a consumer of the SLO burn-rate engine
   (obs/slo.py): on sustained fast-window burn it steps through ordered
   quality tiers (diffusion step-count reduction → encprop stride
-  increase → resolution downshift → blur-ladder coarsening), each tier
+  increase → the few-step consistency student → resolution downshift →
+  blur-ladder coarsening), each tier
   a config *delta* the pipelines compile once and reuse (bucketed like
   every other serving variant — a tier change never recompiles in
   steady state). The active tier is counted
@@ -288,6 +289,19 @@ class BrownoutTier:
     # added to SamplerConfig.encprop_stride when encprop is on (more
     # propagated decoder-only steps per full encoder forward)
     encprop_stride_add: int = 0
+    # step INTO the few-step consistency student
+    # (SamplerConfig.consistency, ops/samplers.py::consistency_sample)
+    # at CONSISTENCY_BROWNOUT_STEPS — the biggest step-count lever in
+    # the ladder, taken BEFORE any resolution downshift: a 4-forward
+    # image at full resolution beats a half-resolution 30-forward one
+    # on both latency and user-visible quality. Only engages when the
+    # deployment declares a distilled student checkpoint
+    # (SamplerConfig.consistency or .consistency_available — an
+    # UNDISTILLED eps-net sampled 4-step is near-noise, worse than any
+    # resolution downshift), and ignored while CASSMANTLE_NO_CONSISTENCY
+    # pins the student off; otherwise the rung degrades like the
+    # previous one and the ladder falls through to the resolution tier.
+    consistency: bool = False
     # image resolution multiplier (quadratic compute lever)
     image_size_scale: float = 1.0
     # blur-ladder quantization in px: coarser buckets = fewer distinct
@@ -295,17 +309,22 @@ class BrownoutTier:
     blur_bucket_px: float = 0.5
 
 
+#: step count the few-step brownout tier serves (the lcm preset's 4)
+CONSISTENCY_BROWNOUT_STEPS = 4
+
 # Ordered mild → severe; tier 0 is full quality. Every tier includes
 # the previous tiers' deltas so stepping up only ever removes compute.
 DEFAULT_TIERS: Tuple[BrownoutTier, ...] = (
     BrownoutTier("full"),
     BrownoutTier("fewer-steps", num_steps_scale=0.6),
     BrownoutTier("stride", num_steps_scale=0.6, encprop_stride_add=2),
+    BrownoutTier("few-step", num_steps_scale=0.6, encprop_stride_add=2,
+                 consistency=True),
     BrownoutTier("low-res", num_steps_scale=0.6, encprop_stride_add=2,
-                 image_size_scale=0.5),
+                 consistency=True, image_size_scale=0.5),
     BrownoutTier("coarse-blur", num_steps_scale=0.6,
-                 encprop_stride_add=2, image_size_scale=0.5,
-                 blur_bucket_px=2.0),
+                 encprop_stride_add=2, consistency=True,
+                 image_size_scale=0.5, blur_bucket_px=2.0),
 )
 
 
@@ -313,9 +332,18 @@ def degraded_sampler_cfg(sampler_cfg, tier: BrownoutTier):
     """Apply a tier's deltas to a SamplerConfig, respecting the
     config's structural invariants (deepcache pairing needs even ddim
     step counts, encprop's dense prefix must fit the step count, the
-    latent grid needs image_size on a /16 boundary). Returns a config
-    EQUAL to the input at tier 0 (callers skip the degraded path)."""
-    s = sampler_cfg
+    latent grid needs image_size on a /16 boundary, consistency does
+    not compose with deepcache/encprop). Returns a config EQUAL to the
+    input at tier 0 (callers skip the degraded path)."""
+    from cassmantle_tpu.ops.samplers import consistency_disabled
+    from cassmantle_tpu.serving.pipeline import effective_sampler_cfg
+
+    # with the kill switch set serving already reverted to the teacher
+    # path (kind @ consistency_teacher_steps); tiers degrade THAT — the
+    # config the pipeline is actually dispatching (one shared revert,
+    # so the brownout path can never diverge from the pinned bit-exact
+    # teacher revert the pipeline/staged paths take)
+    s = effective_sampler_cfg(sampler_cfg)
     steps = max(2, int(round(s.num_steps * tier.num_steps_scale)))
     if s.deepcache and s.kind == "ddim":
         steps += steps % 2
@@ -326,6 +354,22 @@ def degraded_sampler_cfg(sampler_cfg, tier: BrownoutTier):
     if tier.image_size_scale != 1.0:
         size = max(32, (int(s.image_size * tier.image_size_scale)
                         // 16) * 16)
+    if (tier.consistency and not consistency_disabled()
+            and (s.consistency or s.consistency_available)):
+        # the few-step tier swaps the whole sampling loop for the
+        # consistency student; deepcache/encprop don't compose with it
+        # and eta is meaningless for the deterministic re-noise ladder,
+        # so the delta clears all three — and touches NOTHING else, so
+        # at the default geometry the delta's cost-model signature is
+        # exactly the committed `t2i_lcm` entry's (no runtime jaxpr
+        # trace while the system is shedding). A config ALREADY serving
+        # the student keeps its (≤ CONSISTENCY_BROWNOUT_STEPS) step
+        # count — there is no cheaper rung than the few-step path.
+        few = (min(CONSISTENCY_BROWNOUT_STEPS, s.num_steps)
+               if s.consistency else CONSISTENCY_BROWNOUT_STEPS)
+        return dataclasses.replace(
+            s, consistency=True, num_steps=few, deepcache=False,
+            encprop=False, eta=0.0, image_size=size)
     dense = min(s.encprop_dense_steps, steps)
     return dataclasses.replace(
         s, num_steps=steps, encprop_stride=stride, image_size=size,

@@ -178,15 +178,102 @@ def encprop_plan(sampler_cfg):
             len(keys))
 
 
+def consistency_plan(sampler_cfg) -> int:
+    """Validate a few-step consistency sampler config and return its
+    step count (shared by the SD1.5 and SDXL pipelines, like
+    deepcache_schedule/encprop_plan). Consistency serving IS the
+    few-step path — 1-8 direct x0 predictions — and does not compose
+    with deepcache or encprop: the student is trained for direct
+    few-step prediction, so there is no long solver loop to cache
+    into. eta>0 is rejected (the re-noise ladder is deterministic by
+    construction — what lets few-step requests ride the staged
+    slot stepper)."""
+    s = sampler_cfg
+    assert s.eta == 0.0, \
+        "consistency sampling is deterministic (eta=0)"
+    assert 1 <= s.num_steps <= 8, (
+        f"consistency serving is the few-step path (1-8 steps), got "
+        f"{s.num_steps}; the teacher schedule lives in "
+        f"consistency_teacher_steps")
+    assert not s.deepcache, \
+        "consistency does not compose with deepcache (no paired loop)"
+    assert not s.encprop, \
+        "consistency does not compose with encprop (no key schedule)"
+    assert s.consistency_teacher_steps > s.num_steps, (
+        f"consistency_teacher_steps ({s.consistency_teacher_steps}) must "
+        f"exceed num_steps ({s.num_steps}): the student only ever trains "
+        f"on the teacher discretization's query points "
+        f"(ops/samplers.py::ConsistencySchedule), and the kill switch "
+        f"reverts to this schedule")
+    return s.num_steps
+
+
+def effective_sampler_cfg(sampler_cfg):
+    """The sampler config the pipeline is ACTUALLY dispatching: with
+    consistency configured but KILLED (CASSMANTLE_NO_CONSISTENCY=1)
+    serving reverts to the teacher path — the configured kind at
+    ``consistency_teacher_steps``. Cost-model signatures must digest
+    THIS config, not the nominal one: the lcm preset under the kill
+    switch runs ~9x the student's FLOPs, and resolving the committed
+    student entry would under-report mxu_utilization exactly during
+    the quality incident the switch exists for."""
+    import dataclasses as _dc
+
+    from cassmantle_tpu.ops.samplers import consistency_disabled
+
+    if sampler_cfg.consistency and consistency_disabled():
+        return _dc.replace(sampler_cfg, consistency=False,
+                           num_steps=sampler_cfg.consistency_teacher_steps)
+    return sampler_cfg
+
+
+def effective_sampler_steps(sampler_cfg) -> int:
+    """The step count the pipeline's plain ``make_sampler`` schedule
+    should use (the revert is bit-exact — the pinned contract,
+    tests/test_samplers.py). Shared by both pipelines and the staged
+    slot stepper so every dispatch path reverts identically."""
+    return effective_sampler_cfg(sampler_cfg).num_steps
+
+
+def note_consistency_counter(sampler_cfg, n_images: int) -> None:
+    """Diagnosis counter for few-step serving (host-side, derived from
+    the static schedule like note_encprop_counters): how many
+    consistency UNet forwards the dispatch performed —
+    ``pipeline.consistency_steps`` / images = UNet forwards per image,
+    the number the `sd15_lcm` bench A/B attaches. Silent when the knob
+    or the kill switch has consistency off, so A/B counter deltas
+    separate the arms."""
+    from cassmantle_tpu.ops.samplers import consistency_disabled
+
+    if sampler_cfg.consistency and not consistency_disabled():
+        metrics.inc("pipeline.consistency_steps",
+                    sampler_cfg.num_steps * n_images)
+
+
 def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
                     params, ctx, uncond_ctx, lat,
                     addition_embeds=None, uncond_addition_embeds=None):
-    """The denoise stage both image pipelines share: plain CFG sampling,
-    the deepcache full/shallow pairing, or encoder propagation (full
-    forwards at key steps, batched decoder-only forwards in between —
-    possibly composed with deepcache) when configured."""
+    """The denoise stage both image pipelines share: few-step
+    consistency sampling (the distilled-student path), plain CFG
+    sampling, the deepcache full/shallow pairing, or encoder
+    propagation (full forwards at key steps, batched decoder-only
+    forwards in between — possibly composed with deepcache) when
+    configured."""
     from cassmantle_tpu.ops.ddim import encprop_disabled
+    from cassmantle_tpu.ops.samplers import consistency_disabled
 
+    if sampler_cfg.consistency and not consistency_disabled():
+        from cassmantle_tpu.ops.samplers import make_consistency_sampler
+
+        denoise = make_cfg_denoiser(
+            unet_apply, params, ctx, uncond_ctx,
+            sampler_cfg.guidance_scale,
+            addition_embeds=addition_embeds,
+            uncond_addition_embeds=uncond_addition_embeds,
+        )
+        return make_consistency_sampler(
+            sampler_cfg.num_steps,
+            sampler_cfg.consistency_teacher_steps)(denoise, lat)
     if sampler_cfg.encprop and not encprop_disabled():
         from cassmantle_tpu.ops.ddim import make_cfg_denoiser_encprop
         from cassmantle_tpu.ops.samplers import make_encprop_sampler
@@ -270,9 +357,12 @@ def degraded_dispatch_variant(cache: dict, sampler_cfg, mesh,
         scfg = overload.degraded_sampler_cfg(sampler_cfg, tier)
         if scfg == sampler_cfg:
             return None
-        key = (scfg.num_steps, scfg.encprop_stride, scfg.image_size)
+        key = (scfg.num_steps, scfg.encprop_stride, scfg.image_size,
+               scfg.consistency)
         entry = cache.get(key)
         if entry is None:
+            if scfg.consistency:
+                consistency_plan(scfg)
             dc = deepcache_schedule(scfg) if scfg.deepcache else None
             counts = None
             if scfg.encprop:
@@ -282,8 +372,11 @@ def degraded_dispatch_variant(cache: dict, sampler_cfg, mesh,
                 counts = encprop_step_counts(
                     scfg.num_steps, scfg.encprop_stride,
                     scfg.encprop_dense_steps, scfg.deepcache)
-            sampler = make_sampler(scfg.kind, scfg.num_steps,
-                                   eta=scfg.eta)
+            # consistency tiers dispatch their own sampler inside
+            # run_cfg_denoise; a plain schedule here would be dead code
+            sampler = (None if scfg.consistency
+                       else make_sampler(scfg.kind, scfg.num_steps,
+                                         eta=scfg.eta))
             fn, _ = dp_sharded_sampler(build_impl(scfg, sampler, dc),
                                        mesh)
             entry = (fn, scfg, counts)
@@ -456,9 +549,20 @@ class Text2ImagePipeline:
             self._encprop_counts = encprop_step_counts(
                 cfg.sampler.num_steps, cfg.sampler.encprop_stride,
                 cfg.sampler.encprop_dense_steps, cfg.sampler.deepcache)
-        self.sample_latents = make_sampler(
-            cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
-        )
+        # fail fast on invalid few-step consistency configs; with the
+        # kill switch set the plain schedule below IS the teacher path
+        # (run_cfg_denoise falls through to it), so the revert is
+        # bit-exact against a non-consistency teacher config. With
+        # consistency ACTIVE there is no plain schedule at all —
+        # run_cfg_denoise dispatches its own consistency sampler and
+        # would silently ignore one built here
+        if cfg.sampler.consistency:
+            consistency_plan(cfg.sampler)
+        self.sample_latents = (
+            None if effective_sampler_cfg(cfg.sampler).consistency
+            else make_sampler(
+                cfg.sampler.kind, effective_sampler_steps(cfg.sampler),
+                eta=cfg.sampler.eta))
         # Params enter the jit as ARGUMENTS (device buffers), never as
         # captured constants — capturing bakes ~4 GB of weights into the
         # HLO, blowing up compile payloads (fatal through a remote-compile
@@ -633,10 +737,13 @@ class Text2ImagePipeline:
         carry no attribution until it lands."""
         from cassmantle_tpu.obs import costmodel
 
-        key = (scfg.num_steps, scfg.image_size, scfg.encprop,
-               scfg.encprop_stride, scfg.deepcache)
+        # attribution follows what is DISPATCHED: under the consistency
+        # kill switch the effective config is the teacher schedule
+        eff = effective_sampler_cfg(scfg)
+        key = (eff.num_steps, eff.image_size, eff.encprop,
+               eff.encprop_stride, eff.deepcache, eff.consistency)
         if signature is None:
-            signature = costmodel.t2i_signature(self.cfg, scfg)
+            signature = costmodel.t2i_signature(self.cfg, eff)
 
         def resolve():
             def trace() -> float:
@@ -692,6 +799,7 @@ class Text2ImagePipeline:
             images = self._staged_server().generate(
                 list(prompts), seed, deadline_s=deadline_s)
             metrics.inc("pipeline.images", len(prompts))
+            note_consistency_counter(self.cfg.sampler, len(prompts))
             return images
         sample_fn, scfg, ep_counts = (
             degraded if degraded is not None
@@ -721,6 +829,7 @@ class Text2ImagePipeline:
         if degraded is not None:
             metrics.inc("pipeline.brownout_images", n)
         note_encprop_counters(ep_counts, n)
+        note_consistency_counter(scfg, n)
         return np.asarray(images[:n])
 
     # -- img2img ----------------------------------------------------------
@@ -796,6 +905,13 @@ class Text2ImagePipeline:
                 "tails start mid-schedule, where the dense-prefix key "
                 "accounting no longer holds); use a non-encprop config "
                 "for image-conditioned generation"
+            )
+        if self.cfg.sampler.consistency:
+            raise NotImplementedError(
+                "img2img does not support the few-step consistency "
+                "sampler (the student is trained to map noise states on "
+                "the schedule, not arbitrary strength tails); use a "
+                "non-consistency config for image-conditioned generation"
             )
         self._ensure_encoder()
         steps = self.cfg.sampler.num_steps

@@ -215,9 +215,24 @@ class SDXLPipeline:
 
         if fc_describe(m.unet):
             log.info("%s", fc_describe(m.unet))
-        self.sample_latents = make_sampler(
-            cfg.sampler.kind, cfg.sampler.num_steps, eta=cfg.sampler.eta
+        from cassmantle_tpu.serving.pipeline import (
+            consistency_plan,
+            effective_sampler_cfg,
+            effective_sampler_steps,
         )
+
+        # few-step consistency serving (see Text2ImagePipeline): fail
+        # fast on invalid configs; the plain schedule below is the
+        # teacher path the kill switch reverts to bit-exactly, and with
+        # consistency ACTIVE run_cfg_denoise dispatches its own sampler
+        # (no plain schedule to build)
+        if cfg.sampler.consistency:
+            consistency_plan(cfg.sampler)
+        self.sample_latents = (
+            None if effective_sampler_cfg(cfg.sampler).consistency
+            else make_sampler(
+                cfg.sampler.kind, effective_sampler_steps(cfg.sampler),
+                eta=cfg.sampler.eta))
         # Params are jit ARGUMENTS (device buffers), not captured constants
         # (see Text2ImagePipeline note on compile payloads).
         self._params = {
@@ -407,11 +422,18 @@ class SDXLPipeline:
         Text2ImagePipeline resolver with the SDXL artifact key and
         signature (dispatch call shape is identical)."""
         from cassmantle_tpu.obs import costmodel
-        from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+        from cassmantle_tpu.serving.pipeline import (
+            Text2ImagePipeline,
+            effective_sampler_cfg,
+        )
 
+        # sign what is DISPATCHED: under the consistency kill switch
+        # the effective config is the teacher schedule (same contract
+        # as the shared resolver's t2i signature path)
         return Text2ImagePipeline._dispatch_flops(
             self, sample_fn, scfg, kind="sdxl",
-            signature=costmodel.sdxl_signature(self.cfg, scfg))
+            signature=costmodel.sdxl_signature(
+                self.cfg, effective_sampler_cfg(scfg)))
 
     def generate(self, prompts: Sequence[str], seed: int = 0,
                  deadline_s: Optional[float] = None) -> np.ndarray:
@@ -420,11 +442,16 @@ class SDXLPipeline:
         dropped before returning. With ``serving.staged_serving`` on the
         request rides the stage graph (see Text2ImagePipeline.generate);
         meshed serving stays monolithic."""
+        from cassmantle_tpu.serving.pipeline import (
+            note_consistency_counter,
+        )
+
         degraded = self._degraded_sampler()
         if degraded is None and self._staged_enabled():
             images = self._staged_server().generate(
                 list(prompts), seed, deadline_s=deadline_s)
             metrics.inc("pipeline.sdxl_images", len(prompts))
+            note_consistency_counter(self.cfg.sampler, len(prompts))
             return images
         sample_fn, scfg, ep_counts = (
             degraded if degraded is not None
@@ -453,4 +480,5 @@ class SDXLPipeline:
         from cassmantle_tpu.serving.pipeline import note_encprop_counters
 
         note_encprop_counters(ep_counts, n)
+        note_consistency_counter(scfg, n)
         return np.asarray(images[:n])

@@ -176,8 +176,23 @@ class StagedImageServer:
             self._step_widths.append(w)
             w *= 2
         self._step_widths.append(self.capacity)
+        # few-step consistency serving rides the slot stepper through
+        # its own make_slot_sampler variant (the deterministic re-noise
+        # ladder folds each slot's OWN timestep, so mid-flight
+        # admission replays exactly); with the kill switch set the
+        # effective step count reverts to the teacher schedule, the
+        # same bit-exact revert the monolithic path takes
+        from cassmantle_tpu.ops.samplers import consistency_disabled
+        from cassmantle_tpu.serving.pipeline import (
+            effective_sampler_steps,
+        )
+
+        slot_kind = ("consistency"
+                     if s.consistency and not consistency_disabled()
+                     else s.kind)
         self._prepare, self._slot_step, self.num_steps = make_slot_sampler(
-            s.kind, s.num_steps, eta=s.eta)
+            slot_kind, effective_sampler_steps(s), eta=s.eta,
+            teacher_steps=s.consistency_teacher_steps)
         self._denoise = make_slot_denoiser(unet_apply, s.guidance_scale)
         # jit surfaces — each compiles once per shape bucket and is the
         # ONLY dispatcher of its computation (one thread each), so no

@@ -20,6 +20,7 @@ import pytest
 from cassmantle_tpu.analysis.bufferescape import BufferEscapePass
 from cassmantle_tpu.analysis.core import parse_source, run_passes
 from cassmantle_tpu.analysis.envflags import EnvFlagPass
+from cassmantle_tpu.analysis.hostsync import HostSyncPass
 from cassmantle_tpu.analysis.recompile import RecompilePass
 from cassmantle_tpu.analysis.tracerleak import TracerLeakPass
 from cassmantle_tpu.utils import jit_sentinel
@@ -519,6 +520,46 @@ def test_unmutated_mirror_and_host_reads_are_clean():
                 live = np.flatnonzero(self._alive)   # host read: no sink
                 return jnp.asarray(self._consts)     # never mutated
     """, BufferEscapePass()) == []
+
+
+# -- host-sync: the distill-loop shape (ISSUE 15) ----------------------------
+
+_DISTILL_LOOP_SRC = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    def distill(trainer, student, ema, opt, teacher, batches, rng):
+        losses = []
+        for batch in batches:
+            student, ema, opt, loss = trainer.step(
+                student, ema, opt, teacher, batch, rng)
+            losses.append({loss_expr})
+        return student, ema, {collect}
+"""
+
+
+def test_distill_loop_host_sync_per_step_fails():
+    """Golden fixture pinning the distill-loop shape: transferring the
+    loss to host EVERY train step (``float(loss)`` per iteration)
+    serializes the device pipeline on the training hot loop — exactly
+    the per-iteration sync the host-sync pass exists for. The trainer's
+    own step API documents the clean shape (parallel/train.py)."""
+    findings = lint(
+        _DISTILL_LOOP_SRC.format(loss_expr="float(loss)",
+                                 collect="losses"),
+        HostSyncPass())
+    assert rules(findings) == ["host-sync"]
+    assert "float(" in findings[0].message
+
+
+def test_distill_loop_collect_once_is_clean():
+    """The clean counterpart: device scalars accumulate in the loop
+    and ONE boundary transfer lands the whole curve."""
+    assert lint(
+        _DISTILL_LOOP_SRC.format(
+            loss_expr="loss",
+            collect="np.asarray(jnp.stack(losses))"),
+        HostSyncPass()) == []
 
 
 # -- env-flag registry pass --------------------------------------------------

@@ -1,0 +1,282 @@
+"""Consistency/LCM distillation of the zoo UNet (ISSUE 15).
+
+The contract that makes the few-step student servable:
+1. the skip-step consistency loss DECREASES on toy geometry — the
+   distillation objective is trainable end to end on the existing
+   train infrastructure (parallel/train.py);
+2. the EMA target network update is exactly d·ema + (1−d)·student,
+   inside the jitted step;
+3. the student shares the teacher's checkpoint layout (identical param
+   pytree — structure, shapes, dtypes), so utils/checkpoint.py and the
+   serving weights path (share_compatible, maybe_load) work unchanged;
+4. a toy student distilled IN-PROCESS generates through the REAL
+   pipeline with ≤ 8 UNet forwards per image, counter-verified
+   (`pipeline.consistency_steps` — the acceptance bar);
+5. the brownout ladder's few-step tier sits BEFORE the resolution
+   tier, engages through the pipeline (full resolution, 4 forwards),
+   and its variant compiles once (jit-sentinel pinned).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.models.unet import UNet
+from cassmantle_tpu.models.weights import init_params
+from cassmantle_tpu.parallel.train import ConsistencyDistillTrainer
+
+
+def _teacher_params(cfg):
+    unet = UNet(cfg.models.unet)
+    lat = jnp.zeros((2, 8, 8, 4))
+    t = jnp.zeros((2,), jnp.int32)
+    ctx = jnp.zeros((2, 6, cfg.models.unet.context_dim))
+    return init_params(unet, 0, lat, t, ctx)
+
+
+def _toy_batch(cfg, b=2, hw=8, seq=6, seed=1):
+    d = cfg.models.unet.context_dim
+    return {
+        "latents": jax.random.normal(jax.random.PRNGKey(seed),
+                                     (b, hw, hw, 4)),
+        "context": jax.random.normal(jax.random.PRNGKey(seed + 1),
+                                     (b, seq, d)),
+    }
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return _tiny_config()
+
+
+@pytest.fixture(scope="module")
+def teacher(cfg):
+    return _teacher_params(cfg)
+
+
+@pytest.fixture(scope="module")
+def trainer(cfg):
+    """ONE trainer (one jitted distill step) shared by the loss, EMA,
+    layout, and acceptance tests — the UNet fwd+bwd compile is the
+    module's wall-clock cost and every test here uses the same toy
+    geometry."""
+    return ConsistencyDistillTrainer(cfg, mesh=None, lr=3e-3,
+                                     solver_steps=8, skip=2,
+                                     ema_decay=0.9, max_serve_steps=4)
+
+
+# -- 1. the loss decreases ----------------------------------------------------
+
+
+def test_distill_loss_decreases_on_toy_geometry(cfg, teacher, trainer):
+    """Fixed batch + fixed rng = a deterministic objective; a handful
+    of optimizer steps must reduce it. Losses are collected as device
+    scalars and transferred ONCE (the collect-once shape the host-sync
+    lint pins, tests/test_check_jax.py)."""
+    student, ema, opt = trainer.init_state(teacher)
+    batch = _toy_batch(cfg)
+    rng = jax.random.PRNGKey(3)
+    losses = []
+    for _ in range(8):
+        student, ema, opt, loss = trainer.step(
+            student, ema, opt, teacher, batch, rng)
+        losses.append(loss)
+    curve = np.asarray(jnp.stack(losses))
+    assert np.isfinite(curve).all()
+    assert curve[-1] < curve[0], f"loss did not decrease: {curve}"
+
+
+def test_skip_step_bounds_validated(cfg):
+    with pytest.raises(AssertionError, match="skip"):
+        ConsistencyDistillTrainer(cfg, solver_steps=8, skip=8)
+    with pytest.raises(AssertionError, match="skip"):
+        ConsistencyDistillTrainer(cfg, solver_steps=8, skip=0)
+    # serving-coverage contract: a skip that narrows the trained range
+    # below what a max_serve_steps schedule would query is rejected at
+    # train time (the student would be served untrained noise levels)
+    with pytest.raises(AssertionError, match="uncovered"):
+        ConsistencyDistillTrainer(cfg, solver_steps=8, skip=2,
+                                  max_serve_steps=8)
+
+
+# -- 2. EMA target update math ------------------------------------------------
+
+
+def test_ema_target_update_math(cfg, teacher, trainer):
+    d = trainer.ema_decay
+    student, ema, opt = trainer.init_state(teacher)
+    # the step donates its state buffers: snapshot the EMA on host first
+    ema_before = jax.device_get(ema)
+    new_student, new_ema, _, _ = trainer.step(
+        student, ema, opt, teacher, _toy_batch(cfg), jax.random.PRNGKey(0))
+    expect = jax.tree_util.tree_map(
+        lambda e, s: d * e + (1.0 - d) * np.asarray(s),
+        ema_before, jax.device_get(new_student))
+    flat_got = jax.tree_util.tree_leaves(jax.device_get(new_ema))
+    flat_want = jax.tree_util.tree_leaves(expect)
+    assert len(flat_got) == len(flat_want)
+    for got, want in zip(flat_got, flat_want):
+        np.testing.assert_allclose(got, want, atol=1e-6, rtol=1e-6)
+
+
+# -- 3. checkpoint-layout compatibility with the teacher tree -----------------
+
+
+def test_student_tree_matches_teacher_layout(cfg, teacher, trainer):
+    """Identical pytree structure, shapes, and dtypes — the property
+    that lets a distilled checkpoint flow through utils/checkpoint.py,
+    convert/maybe_load, and ``share_compatible`` unchanged (the student
+    IS a zoo UNet checkpoint)."""
+    student, ema, _ = trainer.init_state(teacher)
+    for tree in (student, ema):
+        assert jax.tree_util.tree_structure(tree) == \
+            jax.tree_util.tree_structure(teacher)
+        for a, b in zip(jax.tree_util.tree_leaves(tree),
+                        jax.tree_util.tree_leaves(teacher)):
+            assert a.shape == b.shape and a.dtype == b.dtype
+    # the donated buffers must not alias the frozen teacher's
+    sa = jax.tree_util.tree_leaves(student)[0]
+    ta = jax.tree_util.tree_leaves(teacher)[0]
+    assert sa is not ta
+
+
+# -- 4. the acceptance bar: few-step serving through the real pipeline --------
+
+
+def test_toy_student_serves_few_step_through_real_pipeline(
+        cfg, teacher, trainer):
+    """Distill in-process at toy geometry, drop the student tree into
+    the REAL Text2ImagePipeline under the lcm-style config, and verify
+    ≤ 8 UNet forwards per image end-to-end via the
+    `pipeline.consistency_steps` counter (the ISSUE 15 acceptance
+    criterion). The swap itself is the checkpoint-layout property:
+    the student tree IS a valid zoo UNet tree."""
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils.logging import metrics
+
+    student, ema, opt = trainer.init_state(teacher)
+    batch = _toy_batch(cfg)
+    rng = jax.random.PRNGKey(3)
+    for _ in range(4):
+        student, ema, opt, _ = trainer.step(
+            student, ema, opt, teacher, batch, rng)
+
+    # serve on the SAME solver discretization the trainer distilled on
+    # (ops/samplers.py::ConsistencySchedule queries a subset of that
+    # grid — the student is never evaluated at an untrained noise level)
+    lcm_cfg = cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, consistency=True, num_steps=4,
+        consistency_teacher_steps=trainer.solver_steps))
+    pipe = Text2ImagePipeline(lcm_cfg)
+    # serve the EMA student (the consistency-models serving convention)
+    pipe._params = dict(pipe._params, unet=ema)
+    before = metrics.counter_total("pipeline.consistency_steps")
+    imgs = pipe.generate(["a quiet harbor at dawn",
+                          "a stormy night at sea"], seed=5)
+    forwards_per_image = (
+        metrics.counter_total("pipeline.consistency_steps") - before
+    ) / imgs.shape[0]
+    assert imgs.dtype == np.uint8 and imgs.shape[0] == 2
+    assert np.isfinite(imgs.astype(np.float32)).all()
+    assert forwards_per_image == lcm_cfg.sampler.num_steps
+    assert forwards_per_image <= 8
+
+
+# -- 5. the brownout few-step tier --------------------------------------------
+
+
+def test_few_step_tier_ordered_before_resolution_tier():
+    from cassmantle_tpu.serving.overload import DEFAULT_TIERS
+
+    consistency_at = min(i for i, t in enumerate(DEFAULT_TIERS)
+                         if t.consistency)
+    lowres_at = min(i for i, t in enumerate(DEFAULT_TIERS)
+                    if t.image_size_scale < 1.0)
+    assert consistency_at < lowres_at
+    # severity invariant: once engaged, consistency stays engaged on
+    # every later rung (stepping up only ever removes compute)
+    assert all(t.consistency for t in DEFAULT_TIERS[consistency_at:])
+
+
+def test_few_step_tier_engages_full_res_and_compiles_once(
+        cfg, monkeypatch):
+    """The few-step tier through the real pipeline: full resolution
+    (the resolution tier has NOT engaged yet), 4 consistency forwards
+    counter-verified, and the tier variant compiles ONCE — the second
+    degraded generate runs under the jit sentinel's zero-new-compiles
+    pin."""
+    monkeypatch.delenv("CASSMANTLE_NO_BROWNOUT", raising=False)
+    monkeypatch.delenv("CASSMANTLE_NO_CONSISTENCY", raising=False)
+    from cassmantle_tpu.serving import overload
+    from cassmantle_tpu.serving.overload import (
+        BrownoutLadder,
+        CONSISTENCY_BROWNOUT_STEPS,
+        DEFAULT_TIERS,
+    )
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils import jit_sentinel
+    from cassmantle_tpu.utils.logging import metrics
+
+    # a deployment that DECLARES its checkpoint distilled — the gate
+    # that lets the few-step tier engage on a teacher-serving config
+    # (without consistency_available the rung degrades steps only)
+    pipe = Text2ImagePipeline(cfg.replace(sampler=dataclasses.replace(
+        cfg.sampler, consistency_available=True)))
+    full = pipe.generate(["a storm rolls in"], seed=1)
+    ladder = BrownoutLadder(DEFAULT_TIERS)
+    monkeypatch.setattr(overload, "_LADDER", ladder)
+    tier = min(i for i, t in enumerate(DEFAULT_TIERS) if t.consistency)
+    with ladder._lock:
+        ladder._step_to(tier, "test")
+    before = metrics.counter_total("pipeline.consistency_steps")
+    degraded = pipe.generate(["a storm rolls in"], seed=1)
+    assert degraded.shape[1] == cfg.sampler.image_size  # full res
+    assert metrics.counter_total("pipeline.consistency_steps") \
+        - before == CONSISTENCY_BROWNOUT_STEPS
+    assert len(pipe._tier_fns) == 1
+    with jit_sentinel.no_new_compiles():
+        pipe.generate(["a storm rolls in"], seed=1)
+    assert len(pipe._tier_fns) == 1
+    with ladder._lock:
+        ladder._step_to(0, "test")
+    back = pipe.generate(["a storm rolls in"], seed=1)
+    assert (back == full).all()
+
+
+# -- real-geometry distillation (slow tier) -----------------------------------
+
+
+@pytest.mark.slow
+def test_distill_step_compiles_at_larger_geometry():
+    """A closer-to-real geometry (deeper channels, 16² latents, longer
+    solver schedule) through the same jitted distill step — the compile
+    path the toy smoke doesn't stress. Slow tier: one extra UNet-pair
+    compile (~a minute on a small host)."""
+    base = _tiny_config()
+    cfg = base.replace(models=dataclasses.replace(
+        base.models, unet=dataclasses.replace(
+            base.models.unet, base_channels=64)))
+    unet = UNet(cfg.models.unet)
+    lat = jnp.zeros((2, 16, 16, 4))
+    t = jnp.zeros((2,), jnp.int32)
+    ctx = jnp.zeros((2, 6, cfg.models.unet.context_dim))
+    teacher = init_params(unet, 0, lat, t, ctx)
+    trainer = ConsistencyDistillTrainer(cfg, mesh=None, lr=1e-3,
+                                        solver_steps=50, skip=5)
+    student, ema, opt = trainer.init_state(teacher)
+    batch = {
+        "latents": jax.random.normal(jax.random.PRNGKey(1),
+                                     (2, 16, 16, 4)),
+        "context": jax.random.normal(
+            jax.random.PRNGKey(2), (2, 6, cfg.models.unet.context_dim)),
+    }
+    losses = []
+    for i in range(2):
+        student, ema, opt, loss = trainer.step(
+            student, ema, opt, teacher, batch, jax.random.PRNGKey(i))
+        losses.append(loss)
+    assert np.isfinite(np.asarray(jnp.stack(losses))).all()

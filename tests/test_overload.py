@@ -427,6 +427,52 @@ def test_degraded_sampler_cfg_respects_invariants():
     assert degraded_sampler_cfg(s, BrownoutTier("full")) == s
 
 
+def test_degraded_sampler_cfg_few_step_tier(monkeypatch):
+    """The few-step tier swaps the sampling loop for the consistency
+    student at 4 steps, clears the non-composing deepcache/encprop
+    flags, carries the resolution delta of later rungs, ONLY engages
+    when the deployment declares a distilled student checkpoint
+    (consistency_available — an undistilled eps-net sampled 4-step is
+    near-noise), and defers to the CASSMANTLE_NO_CONSISTENCY kill
+    switch (degrading the TEACHER schedule instead)."""
+    monkeypatch.delenv("CASSMANTLE_NO_CONSISTENCY", raising=False)
+    from cassmantle_tpu.serving.overload import (
+        CONSISTENCY_BROWNOUT_STEPS,
+    )
+
+    cfg = _tiny_config()
+    # a stock (undistilled) deployment: the few-step delta must NOT
+    # engage — the rung degrades like the previous one instead
+    stock = dataclasses.replace(cfg.sampler, num_steps=50,
+                                image_size=512)
+    d_stock = degraded_sampler_cfg(
+        stock, BrownoutTier("t", num_steps_scale=0.6, consistency=True))
+    assert not d_stock.consistency and d_stock.num_steps == 30
+    s = dataclasses.replace(cfg.sampler, num_steps=50, encprop=True,
+                            encprop_stride=3, image_size=512,
+                            consistency_available=True)
+    tier = BrownoutTier("t", num_steps_scale=0.6, consistency=True)
+    d = degraded_sampler_cfg(s, tier)
+    assert d.consistency and d.num_steps == CONSISTENCY_BROWNOUT_STEPS
+    assert not d.deepcache and not d.encprop
+    assert d.image_size == 512                    # few-step BEFORE low-res
+    low = BrownoutTier("t2", consistency=True, image_size_scale=0.5)
+    assert degraded_sampler_cfg(s, low).image_size == 256
+    # a config already serving the student keeps its step count
+    s_lcm = dataclasses.replace(cfg.sampler, consistency=True,
+                                num_steps=2)
+    assert degraded_sampler_cfg(s_lcm, tier).num_steps == 2
+    # kill switch: the tier degrades the teacher path instead
+    monkeypatch.setenv("CASSMANTLE_NO_CONSISTENCY", "1")
+    d_off = degraded_sampler_cfg(s, tier)
+    assert not d_off.consistency and d_off.num_steps == 30
+    s_lcm4 = dataclasses.replace(cfg.sampler, consistency=True,
+                                 num_steps=4,
+                                 consistency_teacher_steps=50)
+    d_off2 = degraded_sampler_cfg(s_lcm4, tier)
+    assert not d_off2.consistency and d_off2.num_steps == 30
+
+
 def test_peer_advert_reflects_shed_and_tier(monkeypatch):
     monkeypatch.delenv("CASSMANTLE_NO_BROWNOUT", raising=False)
     overload._LAST_SHED_T = None
@@ -455,7 +501,7 @@ def test_pipeline_actuates_brownout_tier_and_reverts_bit_exact(
     ladder = make_ladder(clock)
     monkeypatch.setattr(overload, "_LADDER", ladder)
     with ladder._lock:
-        ladder._step_to(3, "test")      # low-res: steps x0.6, size x0.5
+        ladder._step_to(4, "test")  # low-res: few-step student, size x0.5
     degraded = pipe.generate(["a storm rolls in"], seed=1)
     assert degraded.shape[1] == max(32, cfg.sampler.image_size // 2)
     assert len(pipe._tier_fns) == 1
@@ -480,7 +526,7 @@ async def test_fake_backend_and_blur_ladder_honor_tiers(monkeypatch):
     assert content.image.shape[0] == 64
     assert overload.blur_bucket_px() == 0.5
     with ladder._lock:
-        ladder._step_to(4, "test")      # coarse-blur tier: all deltas
+        ladder._step_to(5, "test")      # coarse-blur tier: all deltas
     content = await backend.generate("seed", True)
     assert content.image.shape[0] == 32
     assert overload.blur_bucket_px() == 2.0
@@ -504,7 +550,7 @@ def test_blur_quantize_coarse_tiers_round_up_only(monkeypatch):
     ladder = make_ladder(clock)
     monkeypatch.setattr(overload, "_LADDER", ladder)
     with ladder._lock:
-        ladder._step_to(4, "test")              # quantum 2.0 px
+        ladder._step_to(5, "test")              # quantum 2.0 px
     assert quantize_blur_radius(0.9) == 2.0     # up, not down to sharp
     assert quantize_blur_radius(2.1) == 4.0
     assert quantize_blur_radius(0.0) == 0.0     # a true winner stays sharp

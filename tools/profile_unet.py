@@ -245,6 +245,10 @@ def _image_cost_entry(kind: str, cfg) -> dict:
         "image_size": s.image_size,
         "num_steps": s.num_steps,
         "sampler": s.kind,
+        # few-step consistency preset (ISSUE 15): num_steps direct
+        # forwards of the same UNet — the denoise math above already
+        # covers it (2·num_steps CFG forwards)
+        "consistency": bool(s.consistency),
         "stages": stages,
         "flops_per_item": total_f,
         "hbm_bytes_per_item": total_b,
@@ -315,7 +319,11 @@ def emit_cost_model(path: str) -> dict:
     deterministic integers, no weights, runs on any backend in seconds —
     so the committed ``data/cost_model.json`` doubles as a drift gate
     (tests/test_obs_device.py regenerates and compares)."""
-    from cassmantle_tpu.config import FrameworkConfig, sdxl_config
+    from cassmantle_tpu.config import (
+        FrameworkConfig,
+        lcm_serving_config,
+        sdxl_config,
+    )
     from cassmantle_tpu.obs import costmodel
 
     model = {
@@ -328,6 +336,10 @@ def emit_cost_model(path: str) -> dict:
                  "an upper bound on true traffic)"),
         "pipelines": {
             "t2i": _image_cost_entry("t2i", FrameworkConfig()),
+            # the few-step consistency preset: same pipeline kind, the
+            # committed 4-step geometry (resolved by signature scan —
+            # obs/costmodel.py::committed_entry)
+            "t2i_lcm": _image_cost_entry("t2i", lcm_serving_config()),
             "sdxl": _image_cost_entry("sdxl", sdxl_config()),
             "prompt": _lm_cost_entry(FrameworkConfig()),
             "scorer": _scorer_cost_entry(FrameworkConfig()),
