@@ -22,6 +22,7 @@ its own regressions without a rerun.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
 import time
@@ -763,6 +764,9 @@ def bench_scorer(weights_dir: str) -> dict:
         "value": round(gps, 1),
         "unit": "pairs/sec",
         "vs_baseline": None,
+        # bench_diff regression gate (tools/bench_diff.py): best-of-5
+        # coalesced batches still swing with host contention
+        "noise_tolerance": 0.25,
     }
 
 
@@ -937,9 +941,10 @@ def bench_e2e_round(weights_dir: str) -> dict:
 
     async def run() -> float:
         svc.score_queue.start()
-        # warmup both paths
+        # warmup both paths; OOV tokens so the embed table's rung 0
+        # can't serve the pair — the point is compiling the DEVICE path
         await svc.content_backend.generate("An old ship left the harbor", True)
-        await svc.similarity([("stormy", "windy")] * 64)
+        await svc.similarity([("qzwarmupx", "qzwarmupy")] * 64)
         t0 = time.perf_counter()
         content_task = asyncio.ensure_future(
             svc.content_backend.generate("The market opened at dawn", False)
@@ -973,7 +978,8 @@ async def soak_run(svc, rounds: int, workers: int = 32):
 
     svc.score_queue.start()
     await svc.content_backend.generate("An old ship left the harbor", True)
-    await svc.similarity([("stormy", "windy")] * 64)
+    # OOV warmup pair: must compile the device scorer, not hit the table
+    await svc.similarity([("qzwarmupx", "qzwarmupy")] * 64)
 
     latencies: list = []
     stop = asyncio.Event()
@@ -1046,10 +1052,16 @@ def bench_soak(weights_dir: str) -> dict:
 
 def _rooms_worker_main(port: int, store_addr: str, num_rooms: int,
                        worker_id: str, advertise: str,
-                       round_seconds: float) -> None:
+                       round_seconds: float,
+                       score_batch_ms: float = 0.0) -> None:
     """Child process for the rooms_load harness: one fabric worker
     (fake content backend — the harness measures the GAME fabric, not
-    the diffusion path) over the shared native (or replicated) store."""
+    the diffusion path) over the shared native (or replicated) store.
+    ``score_batch_ms`` > 0 puts the fake scorer behind a real batching
+    queue with that simulated per-batch device cost (the embed-table
+    A/B arms need a device cost for the table rung to beat); the
+    table arms themselves are selected via CASSMANTLE_FAKE_EMBED_TABLE
+    / CASSMANTLE_NO_EMBED_TABLE in the spawn environment."""
     import dataclasses
 
     from aiohttp import web
@@ -1068,6 +1080,9 @@ def _rooms_worker_main(port: int, store_addr: str, num_rooms: int,
             cfg.fabric, num_rooms=num_rooms, heartbeat_s=0.5,
             membership_ttl_s=2.5),
     )
+    if score_batch_ms > 0:
+        cfg = cfg.replace(serving=dataclasses.replace(
+            cfg.serving, fake_score_batch_ms=score_batch_ms))
     fabric = build_fabric(cfg, fake=True, store_addr=store_addr,
                           worker_id=worker_id, advertise_addr=advertise)
     web.run_app(create_app(fabric, cfg), host="127.0.0.1", port=port,
@@ -1075,10 +1090,13 @@ def _rooms_worker_main(port: int, store_addr: str, num_rooms: int,
 
 
 async def _rooms_load_drive(base_urls, sessions: int, seconds: float,
-                            ws_conns: int) -> dict:
+                            ws_conns: int, guess_words=None) -> dict:
     """The synthetic load: N sessions in a sustained guess loop + M WS
     /clock subscriptions, spread across every worker (cross-worker 307s
-    followed transparently); returns raw counters + latencies."""
+    followed transparently); returns raw counters + latencies.
+    ``guess_words`` replaces the default out-of-vocabulary ``guessN``
+    stream with a fixed word cycle (the embed-table A/B arms drive
+    in-vocabulary guesses through the same deterministic sequence)."""
     import asyncio
 
     import aiohttp
@@ -1118,10 +1136,12 @@ async def _rooms_load_drive(base_urls, sessions: int, seconds: float,
             g = 0
             while time.monotonic() < deadline:
                 t0 = time.perf_counter()
+                guess = (guess_words[g % len(guess_words)]
+                         if guess_words else f"guess{g}")
                 try:
                     async with http.post(
                         base + "/compute_score" + q,
-                        json={"inputs": {str(masks[0]): f"guess{g}"}},
+                        json={"inputs": {str(masks[0]): guess}},
                     ) as res:
                         if res.status == 200:
                             await res.json()
@@ -1155,18 +1175,33 @@ async def _rooms_load_drive(base_urls, sessions: int, seconds: float,
         t0 = time.perf_counter()
         await asyncio.gather(*tasks, return_exceptions=True)
         elapsed = time.perf_counter() - t0
+        # post-load attribution scrape: workers start at zero, so their
+        # /metrics counter totals ARE this run's deltas (the embed-table
+        # arms read scorer.table_hits / score.items here)
+        worker_counters: dict = {}
+        for url in base_urls:
+            try:
+                async with http.get(url + "/metrics") as res:
+                    counters = (await res.json()).get("counters", {})
+            except Exception:
+                continue
+            for name, value in counters.items():
+                worker_counters[name] = \
+                    worker_counters.get(name, 0) + value
     return {
         "elapsed": elapsed,
         "latencies": latencies,
         "guesses": guesses[0],
         "ws_ticks": ws_ticks[0],
         "errors": errors[0],
+        "worker_counters": worker_counters,
     }
 
 
 def rooms_load_spawn_workers(workers: int, rooms: int, base_port: int,
                              store_addr: str,
-                             round_seconds: float = 8.0) -> tuple:
+                             round_seconds: float = 8.0,
+                             score_batch_ms: float = 0.0) -> tuple:
     """(procs, base_urls): N fabric worker processes over one shared
     store address, each advertised for cross-worker redirects, all
     confirmed /healthz-ready."""
@@ -1187,7 +1222,7 @@ def rooms_load_spawn_workers(workers: int, rooms: int, base_port: int,
         p = ctx.Process(
             target=_rooms_worker_main,
             args=(port, store_addr, rooms, f"bench-w{w}", url,
-                  round_seconds),
+                  round_seconds, score_batch_ms),
             daemon=True)
         p.start()
         procs.append(p)
@@ -1213,7 +1248,9 @@ def rooms_load_run(workers: int = 2, rooms: int = 4, sessions: int = 8,
                    seconds: float = 6.0, ws_conns: int = 4,
                    base_port: int = 8461, store_port: int = 7461,
                    round_seconds: float = 8.0,
-                   store_addr: str = None) -> dict:
+                   store_addr: str = None,
+                   score_batch_ms: float = 0.0,
+                   guess_words=None) -> dict:
     """Spawn one shared mantlestore + N fabric worker processes, drive
     sustained guess + WS clock load across M rooms, return raw stats.
     ``store_addr`` overrides the store (e.g. ``repl:...`` against an
@@ -1233,9 +1270,11 @@ def rooms_load_run(workers: int = 2, rooms: int = 4, sessions: int = 8,
     procs = []
     try:
         procs, base_urls = rooms_load_spawn_workers(
-            workers, rooms, base_port, store_addr, round_seconds)
+            workers, rooms, base_port, store_addr, round_seconds,
+            score_batch_ms=score_batch_ms)
         raw = asyncio.run(
-            _rooms_load_drive(base_urls, sessions, seconds, ws_conns))
+            _rooms_load_drive(base_urls, sessions, seconds, ws_conns,
+                              guess_words=guess_words))
     finally:
         for p in procs:
             p.terminate()
@@ -1291,6 +1330,9 @@ def bench_rooms_load(weights_dir: str) -> dict:
         "request_p99_ms": round(p99, 1),
         "p99_slo_ms": slo_ms,
         "slo_ok": bool(p99 <= slo_ms),
+        # bench_diff regression gate: multi-process closed-loop load on
+        # a shared host swings hard with core count and contention
+        "noise_tolerance": 0.35,
     }
 
 
@@ -1724,12 +1766,17 @@ def _overload_worker_main(port: int, batch_ms: float, bucket: int,
                 print=None)
 
 
-async def _overload_drive(base_url: str, phases, sessions: int) -> dict:
+async def _overload_drive(base_url: str, phases, sessions: int,
+                          guess_words=None) -> dict:
     """Open-loop synthetic load: each phase fires /compute_score POSTs
     at a fixed arrival rate WITHOUT waiting for completions (a closed
     loop would self-throttle and never overload anything). Tracks per
     phase: accepted latencies, rejection latencies + their Retry-After
-    values, and the brownout tier (sampled from /metrics)."""
+    values, and the brownout tier (sampled from /metrics).
+    ``guess_words`` replaces the all-OOV ``guessN`` stream with a fixed
+    cycle (the embed-table drill mixes in-vocabulary words with OOV
+    tokens so the table rung and the admission-controlled queue carry
+    their designed shares of the same flood)."""
     import asyncio
 
     import aiohttp
@@ -1766,11 +1813,13 @@ async def _overload_drive(base_url: str, phases, sessions: int) -> dict:
 
         async def one_request(i: int, rec: dict) -> None:
             sid = sids[i % len(sids)]
+            guess = (guess_words[i % len(guess_words)]
+                     if guess_words else f"guess{i}")
             t0 = time.perf_counter()
             try:
                 async with http.post(
                     base_url + f"/compute_score?session={sid}",
-                    json={"inputs": {str(masks[0]): f"guess{i}"}},
+                    json={"inputs": {str(masks[0]): guess}},
                 ) as res:
                     ms = (time.perf_counter() - t0) * 1000.0
                     if res.status == 200:
@@ -1819,9 +1868,13 @@ async def _overload_drive(base_url: str, phases, sessions: int) -> dict:
             body = await res.json()
         out["overload_block"] = body.get("overload", {})
         async with http.get(base_url + "/metrics") as res:
-            gauges = (await res.json())["gauges"]
+            body = await res.json()
+        gauges = body["gauges"]
         out["final_tier"] = float(gauges.get("overload.brownout_tier",
                                              0.0))
+        # the worker started at zero, so its counter totals ARE this
+        # drill's deltas (table_served / score.batches attribution)
+        out["worker_counters"] = dict(body.get("counters", {}))
     return out
 
 
@@ -1829,7 +1882,8 @@ def overload_drill_run(batch_ms: float = 100.0, bucket: int = 4,
                        base_port: int = 8571, sessions: int = 6,
                        baseline_s: float = 3.0, overload_s: float = 5.0,
                        recovery_s: float = 5.0,
-                       round_seconds: float = 30.0) -> dict:
+                       round_seconds: float = 30.0,
+                       guess_words=None) -> dict:
     """Spawn the drill worker and ramp: ~0.4x capacity (baseline), 2x
     (overload), ~0.2x (recovery). Capacity = bucket / batch_s. Shared
     by ``bench.py overload_drill`` and the tier-1 goodput smoke
@@ -1863,7 +1917,8 @@ def overload_drill_run(batch_ms: float = 100.0, bucket: int = 4,
             if time.monotonic() >= deadline:
                 raise RuntimeError("overload worker never became healthy")
             time.sleep(0.1)
-        raw = asyncio.run(_overload_drive(url, phases, sessions))
+        raw = asyncio.run(_overload_drive(url, phases, sessions,
+                                          guess_words=guess_words))
     finally:
         p.terminate()
         p.join(timeout=5.0)
@@ -1927,6 +1982,173 @@ def bench_overload_drill(weights_dir: str) -> dict:
     }
 
 
+# -- embed-table A/B arms (ISSUE 16): the zero-device guess path vs ------
+# -- the queued device path under identical load -------------------------
+
+@contextlib.contextmanager
+def _arm_env(extra: dict):
+    """Temporarily set the arm-selection env flags. The rooms/overload
+    workers are spawn children, so flags set here are inherited at
+    Process.start() — no per-worker plumbing needed."""
+    saved = {k: os.environ.get(k) for k in extra}
+    os.environ.update(extra)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# both arms build + consult the SAME hash-embed table code path; the
+# kill switch (the production bit-exact revert) is the only difference,
+# so the delta is purely "rung 0 serves" vs "everything queues"
+_TABLE_ARM_ENV = {"CASSMANTLE_FAKE_EMBED_TABLE": "1",
+                  "CASSMANTLE_NO_EMBED_TABLE": "0"}
+_DEVICE_ARM_ENV = {"CASSMANTLE_FAKE_EMBED_TABLE": "1",
+                   "CASSMANTLE_NO_EMBED_TABLE": "1"}
+
+
+def _invocab_guesses(n: int = 512, oov_every: int = 0):
+    """Deterministic guess cycle drawn from the real wordlist (the
+    embed-table arms need in-vocabulary traffic; the default guessN
+    stream is 100% OOV by construction). ``oov_every`` > 0 interleaves
+    a synthetic OOV token every k-th slot."""
+    from cassmantle_tpu.server.assets import load_wordlist
+
+    words = list(load_wordlist())
+    out = []
+    for j in range(n):
+        if oov_every and j % oov_every == oov_every - 1:
+            out.append(f"qzoov{j}")
+        else:
+            out.append(words[(j * 97) % len(words)])
+    return out
+
+
+def bench_rooms_load_table(weights_dir: str) -> dict:
+    """ISSUE 16's tentpole proof: the rooms_load rung re-run as an A/B
+    pair under identical geometry and an identical in-vocabulary guess
+    stream, with the fake scorer behind a REAL batching queue that
+    holds the dispatch thread BENCH_ROOMS_TABLE_BATCH_MS per batch (the
+    simulated device cost). Table arm: hash-embed table armed
+    (CASSMANTLE_FAKE_EMBED_TABLE=1) — every guess completes as a host
+    int8 dot, zero queue submits. Device arm: same table built, kill
+    switch on (CASSMANTLE_NO_EMBED_TABLE=1) — every guess rides the
+    queue. value = table-arm guesses/s; the acceptance bar is
+    speedup_vs_device_arm >= 2.0, and each arm's counter_deltas carry
+    the attribution (scorer.table_hits up / score.items ~0 in the
+    table arm, the reverse in the device arm)."""
+    import numpy as np
+
+    env = os.environ.get
+    batch_ms = float(env("BENCH_ROOMS_TABLE_BATCH_MS", "200"))
+    knobs = dict(
+        workers=int(env("BENCH_ROOMS_WORKERS", "2")),
+        rooms=int(env("BENCH_ROOMS_COUNT", "4")),
+        sessions=int(env("BENCH_ROOMS_SESSIONS", "8")),
+        seconds=float(env("BENCH_ROOMS_SECONDS", "6")),
+        ws_conns=int(env("BENCH_ROOMS_WS", "4")),
+        score_batch_ms=batch_ms,
+        guess_words=_invocab_guesses(),
+    )
+    arms = {}
+    for arm, extra, bport, sport in (
+            ("table", _TABLE_ARM_ENV, 8481, 7481),
+            ("device", _DEVICE_ARM_ENV, 8491, 7491),
+    ):
+        with _arm_env(extra):
+            raw = rooms_load_run(base_port=bport, store_port=sport,
+                                 **knobs)
+        if not raw["latencies"]:
+            raise RuntimeError(
+                f"rooms_load_table {arm} arm produced no guesses "
+                f"({raw['errors']} errors)")
+        ms = np.sort(np.asarray(raw["latencies"])) * 1000.0
+        arms[arm] = {
+            "guesses_per_s": round(raw["guesses"] / raw["elapsed"], 1),
+            "guesses": raw["guesses"],
+            "request_errors": raw["errors"],
+            "request_p50_ms": round(float(ms[len(ms) // 2]), 1),
+            "request_p99_ms": round(float(ms[int(len(ms) * 0.99)]), 1),
+            "counter_deltas": _counter_deltas(
+                {}, raw.get("worker_counters", {})),
+        }
+    table, device = arms["table"], arms["device"]
+    speedup = (round(table["guesses_per_s"] / device["guesses_per_s"], 2)
+               if device["guesses_per_s"] else None)
+    return {
+        "metric": "rooms_load_table_arm_guesses_per_sec",
+        "value": table["guesses_per_s"],
+        "unit": "guesses/sec",
+        "vs_baseline": None,
+        "speedup_vs_device_arm": speedup,
+        "speedup_floor": 2.0,
+        "speedup_ok": bool(speedup is not None and speedup >= 2.0),
+        "score_batch_ms": batch_ms,
+        "workers": knobs["workers"],
+        "sessions": knobs["sessions"],
+        "arms": arms,
+        # the table arm's attribution doubles as the entry-level record
+        "counter_deltas": dict(table["counter_deltas"]),
+        "noise_tolerance": 0.35,
+    }
+
+
+def bench_overload_drill_table(weights_dir: str) -> dict:
+    """The overload drill re-run with the embed table armed and a
+    half-in-vocabulary flood: the in-vocab share completes at rung 0
+    (bypassing admission entirely — overload.table_served counts it)
+    while the OOV share still saturates the queue and exercises the
+    limiter. value = table-arm goodput at 2x offered; the device arm
+    (kill switch) plateaus at queue capacity, so goodput_vs_device > 1
+    is table-served headroom the limiter never had to police."""
+    env = os.environ.get
+    seconds = float(env("BENCH_OVERLOAD_SECONDS", "5"))
+    knobs = dict(
+        batch_ms=float(env("BENCH_OVERLOAD_BATCH_MS", "100")),
+        bucket=int(env("BENCH_OVERLOAD_BUCKET", "4")),
+        baseline_s=max(3.0, seconds * 0.6),
+        overload_s=seconds,
+        recovery_s=seconds,
+        guess_words=_invocab_guesses(oov_every=2),
+    )
+    arms = {}
+    for arm, extra, bport in (("table", _TABLE_ARM_ENV, 8581),
+                              ("device", _DEVICE_ARM_ENV, 8591)):
+        with _arm_env(extra):
+            raw = overload_drill_run(base_port=bport, **knobs)
+        over = raw["phases"]["overload"]
+        arms[arm] = {
+            "goodput_at_2x_per_s": round(over["goodput_per_s"], 1),
+            "accepted": len(over["accepted_ms"]),
+            "rejected": len(over["rejected_ms"]),
+            "accepted_p99_ms": round(_pctl(over["accepted_ms"], 0.99), 1),
+            "max_brownout_tier": over["max_tier"],
+            "counter_deltas": _counter_deltas(
+                {}, raw.get("worker_counters", {})),
+        }
+    table, device = arms["table"], arms["device"]
+    ratio = (round(table["goodput_at_2x_per_s"]
+                   / device["goodput_at_2x_per_s"], 2)
+             if device["goodput_at_2x_per_s"] else None)
+    capacity = knobs["bucket"] / (knobs["batch_ms"] / 1000.0)
+    return {
+        "metric": "overload_drill_table_goodput_at_2x_per_s",
+        "value": table["goodput_at_2x_per_s"],
+        "unit": "accepted req/s",
+        "vs_baseline": None,
+        "capacity_per_s": capacity,
+        "goodput_vs_device_arm": ratio,
+        "invocab_share": 0.5,
+        "arms": arms,
+        "counter_deltas": dict(table["counter_deltas"]),
+        "noise_tolerance": 0.35,
+    }
+
+
 # Counters whose per-entry deltas carry diagnostic weight: recompiles,
 # cache effectiveness, staged-serving churn, and every supervision
 # counter (suffix match). Attached to each BENCH_SUITE.json record so
@@ -1951,6 +2173,11 @@ _DELTA_COUNTERS = {
     "overload.brownout_trips", "overload.brownout_recoveries",
     "overload.score_shed", "overload.loop_lag_sheds",
     "pipeline.brownout_images",
+    # embed-table scoring ladder (ISSUE 16): rung-0 serves vs queued
+    # device dispatch — the A/B arms' attribution lives in these plus
+    # the score queue totals (flat score.items IS the zero-device proof)
+    "scorer.table_hits", "scorer.table_oov", "scorer.table_pins",
+    "overload.table_served", "score.batches", "score.items",
 }
 _DELTA_SUFFIXES = (".dispatch_hangs", ".deadline_expired", ".rejected",
                    ".rejected_degraded", ".failures", ".loop_errors",
@@ -2009,6 +2236,8 @@ SUITE = {
     "rooms_load": bench_rooms_load,
     "chaos_drill": bench_chaos_drill,
     "overload_drill": bench_overload_drill,
+    "rooms_load_table": bench_rooms_load_table,
+    "overload_drill_table": bench_overload_drill_table,
 }
 
 # ``--north-star-only`` measures exactly these, with BENCH_ROUNDS=1

@@ -11,6 +11,8 @@ door; this one wraps every runnable surface:
 - ``quantize-weights`` offline int8 LM checkpoints (tools/quantize_weights.py)
 - ``clip-report``      CLIP-sim quality gate across presets (tools/clip_report.py)
 - ``build-wordlist``   regenerate the spellcheck lexicon (tools/build_wordlist.py)
+- ``build-embed-table`` emit the int8 wordlist scoring table
+                       (tools/build_embed_table.py --emit)
 - ``lm-int8-ab``       fp-vs-int8 LM decode A/B (tools/lm_int8_ab.py)
 - ``weights-drill``    fetch -> quantize -> CLIP gate -> LM A/B -> one
                        LM-decoded game round, fail-fast (the whole
@@ -105,6 +107,11 @@ def cmd_clip_report(argv) -> int:
 
 def cmd_build_wordlist(argv) -> int:
     return _run_script(os.path.join("tools", "build_wordlist.py"), argv)
+
+
+def cmd_build_embed_table(argv) -> int:
+    return _run_script(os.path.join("tools", "build_embed_table.py"),
+                       argv)
 
 
 def cmd_lm_int8_ab(argv) -> int:
@@ -442,6 +449,7 @@ COMMANDS = {
     "quantize-weights": cmd_quantize_weights,
     "clip-report": cmd_clip_report,
     "build-wordlist": cmd_build_wordlist,
+    "build-embed-table": cmd_build_embed_table,
     "lm-int8-ab": cmd_lm_int8_ab,
     "weights-drill": cmd_weights_drill,
     "train-diffusion": cmd_train_diffusion,

@@ -53,6 +53,7 @@ class Game:
         blur_fn: Optional[BlurFn] = None,
         supervisor: Optional[ServingSupervisor] = None,
         room: Optional[str] = None,
+        pin_answers=None,
     ) -> None:
         game_cfg = cfg.game
         self.cfg = cfg
@@ -90,6 +91,10 @@ class Game:
             lock_timeout=game_cfg.lock_timeout,
             acquire_timeout=game_cfg.acquire_timeout,
             on_promote=self._reset_sessions,
+            # answer pin hook (ops/embed_table.py): production wires
+            # InferenceService.pin_answers; fake fabrics wire the
+            # hash-table pin; None keeps rounds pin-free
+            on_answers=pin_answers,
             reserve=self.reserve,
             breaker=self.supervisor.content_breaker,
             metric_labels=self._metric_labels,

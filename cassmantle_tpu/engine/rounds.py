@@ -91,6 +91,7 @@ class RoundManager:
         retry_backoff_s: float = 2.0,
         rng: Optional[random.Random] = None,
         on_promote: Optional[Callable[[], object]] = None,
+        on_answers: Optional[Callable[[Sequence[str]], object]] = None,
         reserve: Optional[RoundReserve] = None,
         breaker: Optional[CircuitBreaker] = None,
         metric_labels: Optional[Dict[str, str]] = None,
@@ -111,6 +112,11 @@ class RoundManager:
         # async callback run after each promotion (the game layer resets
         # sessions there, mirroring server.py:168).
         self.on_promote = on_promote
+        # sync hook fed the new round's masked answer words whenever a
+        # round becomes current (startup, promotion, reserve rotation):
+        # the serving layer pins them into the scorer's int8 embed
+        # table off the guess path (ops/embed_table.py)
+        self.on_answers = on_answers
         # supervision seam (ISSUE 2): archive every generated round into
         # the reserve ring; fail generation fast while the breaker is
         # open so a dark device costs nothing per round and promotion
@@ -213,6 +219,7 @@ class RoundManager:
                                   _uuid.uuid4().hex)
         if slot == "current":
             await self._bump_image_version()
+            await self._notify_answers(prompt_state)
         if self.reserve is not None:
             # archive exactly the bytes a promotion writes; a reserve
             # hiccup must never fail the generation that just succeeded
@@ -222,6 +229,29 @@ class RoundManager:
             except Exception:
                 log.exception("reserve archive failed")
                 metrics.inc("reserve.archive_failures")
+
+    async def _notify_answers(self, prompt_state) -> None:
+        """Feed the round's masked answer words to ``on_answers``
+        (production: InferenceService.pin_answers → the scorer's int8
+        table) so answers are embedded and pinned at promotion time,
+        not on the first guess. The hook is sync and may device-embed,
+        so it runs on a worker thread; any failure is swallowed
+        (``rounds.answer_pin_failures``) — pinning is an optimization,
+        never round-lifecycle-critical."""
+        if self.on_answers is None or prompt_state is None:
+            return
+        try:
+            if isinstance(prompt_state, bytes):
+                prompt_state = json.loads(prompt_state.decode())
+            elif isinstance(prompt_state, str):
+                prompt_state = json.loads(prompt_state)
+            tokens = prompt_state["tokens"]
+            answers = [str(tokens[int(i)]) for i in prompt_state["masks"]]
+            await asyncio.to_thread(self.on_answers, answers)
+        except Exception:
+            log.exception("answer pin hook failed")
+            metrics.inc("rounds.answer_pin_failures",
+                        labels=self.metric_labels)
 
     async def _bump_image_version(self) -> None:
         """Monotonic counter, bumped AFTER every current-image write (so
@@ -275,6 +305,8 @@ class RoundManager:
                 if await self.store.hget(PROMPT_KEY, "current") is not None \
                         and await self.store.hget(IMAGE_KEY, "current") is not None:
                     log.info("resuming in-flight round from store")
+                    await self._notify_answers(
+                        await self.store.hget(PROMPT_KEY, "current"))
                     return
                 title = self.select_seed()
                 await self.init_story(title)
@@ -357,6 +389,8 @@ class RoundManager:
                     log.warning("buffer was already promoted by a "
                                 "crashed worker; finished its cleanup "
                                 "without re-promoting")
+                    await self._notify_answers(
+                        await self.store.hget(PROMPT_KEY, "current"))
                     return
                 if prompt_next is None or image_next is None:
                     # generation is dark (breaker open / buffer failed):
@@ -407,6 +441,7 @@ class RoundManager:
                 await self.store.hincrby(STORY_KEY, "episode", 1)
                 metrics.inc("rounds.promoted", labels=self.metric_labels)
                 flight_recorder.record("round.promoted")
+                await self._notify_answers(prompt_next)
                 log.info("buffer promotion complete")
         except LockTimeout:
             log.info("promotion lock held elsewhere; skipping")
@@ -446,6 +481,7 @@ class RoundManager:
         await self.store.hset(PROMPT_KEY, "seed", text)
         metrics.inc("rounds.reserve_promotions", labels=self.metric_labels)
         flight_recorder.record("round.reserve_promotion")
+        await self._notify_answers(prompt_state)
         log.warning("generation dark; promoted reserve round "
                     "(fresh-content degraded mode)")
         return True

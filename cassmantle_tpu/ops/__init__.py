@@ -1,1 +1,19 @@
-from cassmantle_tpu.ops.attention import multi_head_attention  # noqa: F401
+"""Device kernels + the host-side scoring table.
+
+The package import itself stays jax-free: ``ops.embed_table`` must be
+importable from --fake workers (bench.py rooms_load / overload drills)
+that never pay — or hang on — an accelerator backend import, the same
+contract as serving/fake_scorer.py. The ``multi_head_attention``
+re-export resolves lazily (PEP 562) so ``from cassmantle_tpu.ops import
+multi_head_attention`` keeps working without an eager ``ops.attention``
+(jax) import at package-import time.
+"""
+
+
+def __getattr__(name):
+    if name == "multi_head_attention":
+        from cassmantle_tpu.ops.attention import multi_head_attention
+
+        return multi_head_attention
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

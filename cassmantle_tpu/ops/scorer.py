@@ -25,13 +25,24 @@ from cassmantle_tpu.models.weights import (
     init_params_cached,
     maybe_load,
 )
+from cassmantle_tpu.ops.embed_table import (
+    EMBED_TABLE_PATH,
+    EmbedTable,
+    embed_table_disabled,
+    normalize_key,
+    read_header,
+    table_signature,
+    weights_fingerprint,
+)
 from cassmantle_tpu.utils.compile_cache import (
     enable_compile_cache,
     param_cache_path,
 )
-from cassmantle_tpu.utils.logging import metrics
+from cassmantle_tpu.utils.logging import get_logger, metrics
 from cassmantle_tpu.utils.profiling import block_timer
 from cassmantle_tpu.utils.tokenizers import Tokenizer, load_tokenizer
+
+log = get_logger("scorer")
 
 
 def _pick_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -51,6 +62,7 @@ class EmbeddingScorer:
         seq_len: int = 16,
         batch_buckets: Sequence[int] = (8, 64, 256, 1024),
         embed_cache_size: int = 2048,
+        table="auto",
     ) -> None:
         self.cfg = cfg
         # Text -> unit-embedding LRU: /compute_score re-embeds the
@@ -85,6 +97,45 @@ class EmbeddingScorer:
         # costs ~2·N(params) FLOPs per token; resolved lazily from the
         # committed cost model (production MiniLM) or this tree
         self._flops_per_row = None
+        # rung 0 of the scoring ladder: the committed int8 wordlist
+        # table (ops/embed_table.py). ``table="auto"`` arms it only
+        # when the artifact's signature matches THIS scorer's config +
+        # wordlist + weights identity, so a test-config scorer or a
+        # stale artifact silently keeps the LRU/device path. Pass an
+        # EmbedTable to inject, or False/None to disable outright.
+        if table == "auto":
+            self.table = self._autoload_table(weights_dir)
+        elif isinstance(table, EmbedTable):
+            if table.dim != cfg.hidden_size:
+                raise ValueError(
+                    f"embed table dim {table.dim} != scorer hidden "
+                    f"size {cfg.hidden_size}")
+            self.table = table
+        else:
+            self.table = None
+        if self.table is not None:
+            metrics.gauge("scorer.table_rows", len(self.table))
+
+    def _autoload_table(self, weights_dir):
+        try:
+            header = read_header(EMBED_TABLE_PATH)
+        except (OSError, ValueError):
+            return None
+        from cassmantle_tpu.server.assets import load_wordlist
+
+        expect = table_signature(
+            self.cfg, self.seq_len,
+            [normalize_key(w) for w in load_wordlist()],
+            weights_fingerprint(weights_dir))
+        if header["signature"] != expect:
+            # info, not warning: every non-production scorer config
+            # (tests, tools) lands here by design
+            log.info(
+                "embed table not armed: committed signature %s != "
+                "expected %s", header["signature"], expect)
+            return None
+        return EmbedTable.load(EMBED_TABLE_PATH,
+                               expected_signature=expect)
 
     def _row_flops(self) -> float:
         """Analytic FLOPs per encoded row (seq_len tokens)."""
@@ -140,22 +191,46 @@ class EmbeddingScorer:
         return np.concatenate(out_chunks, axis=0)
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
-        """(n,) texts -> (n, D) unit embeddings.
+        """(n,) texts -> (n, D) unit embeddings via the scoring ladder:
+        int8 table -> LRU -> device.
 
-        Cache-aware: rows already in the LRU (or duplicated within this
-        call) never reach the device — only the unique uncached texts
-        form the padded encode batch. ``scorer.embed_cache_misses``
-        therefore counts device rows actually embedded;
-        ``scorer.embed_cache_hits`` counts rows served without device
-        work. The returned array is always freshly assembled — callers
-        may mutate it."""
+        Rung 0 is the committed wordlist table (when armed and the
+        ``CASSMANTLE_NO_EMBED_TABLE`` kill switch is off): in-table
+        texts are served as host int8 dequants with zero device work,
+        counted by ``scorer.table_hits``; the rest count
+        ``scorer.table_oov`` and fall through. The LRU/device rungs are
+        unchanged and bit-exact when the table is skipped: rows already
+        in the LRU (or duplicated within this call) never reach the
+        device — only the unique uncached texts form the padded encode
+        batch. ``scorer.embed_cache_misses`` therefore counts device
+        rows actually embedded; ``scorer.embed_cache_hits`` counts rows
+        served from the LRU. The returned array is always freshly
+        assembled — callers may mutate it."""
         n = len(texts)
         if n == 0:
             return np.zeros((0, self.cfg.hidden_size), dtype=np.float32)
         out = np.zeros((n, self.cfg.hidden_size), dtype=np.float32)
+        table = self.table \
+            if self.table is not None and not embed_table_disabled() \
+            else None
+        if table is not None:
+            rest: List[int] = []
+            hits = 0
+            for i, text in enumerate(texts):
+                row = table.lookup(text)
+                if row is None:
+                    rest.append(i)
+                else:
+                    out[i] = row
+                    hits += 1
+            metrics.inc("scorer.table_hits", hits)
+            metrics.inc("scorer.table_oov", len(rest))
+        else:
+            rest = list(range(n))
         miss_rows: "OrderedDict[str, list]" = OrderedDict()
         with self._embed_cache_lock:
-            for i, text in enumerate(texts):
+            for i in rest:
+                text = texts[i]
                 emb = self._embed_cache.get(text)
                 if emb is not None:
                     self._embed_cache.move_to_end(text)
@@ -177,7 +252,7 @@ class EmbeddingScorer:
                             self._embed_cache.popitem(last=False)
         metrics.inc("scorer.texts", n)
         metrics.inc("scorer.embed_cache_misses", len(miss_rows))
-        metrics.inc("scorer.embed_cache_hits", n - len(miss_rows))
+        metrics.inc("scorer.embed_cache_hits", len(rest) - len(miss_rows))
         return out
 
     def similarity(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
@@ -190,13 +265,49 @@ class EmbeddingScorer:
         n = len(pairs)
         return np.sum(emb[:n] * emb[n:], axis=-1)
 
+    def table_scores(self, pairs: Sequence[Tuple[str, str]]):
+        """Rung-0 fused scoring for the service fast path:
+        [(guess, answer)] -> (scores, served-mask) via the int8 table,
+        or None when no table is armed / the kill switch is set. Pairs
+        with ``served[i]`` True completed with zero device dispatches;
+        the caller runs the full ladder for the rest only."""
+        if self.table is None or embed_table_disabled():
+            return None
+        return self.table.score_pairs(list(pairs))
+
+    def pin_answers(self, words: Sequence[str]) -> int:
+        """Pin round answers into the armed table at promotion time:
+        words not already in the table are embedded once through the
+        normal LRU/device ladder, quantized with the committed scheme,
+        and overlaid — so by the time guesses arrive, every (guess,
+        answer) pair over the game vocabulary is rung-0-servable.
+        Returns the number of rows pinned (``scorer.table_pins``)."""
+        if self.table is None or embed_table_disabled():
+            return 0
+        todo: List[str] = []
+        seen = set()
+        for w in words:
+            key = normalize_key(w)
+            if key and key not in seen and not self.table.contains(key):
+                seen.add(key)
+                todo.append(key)
+        if not todo:
+            return 0
+        rows = self.embed(todo)
+        for w, row in zip(todo, rows):
+            self.table.pin(w, row)
+        return len(todo)
+
     def most_similar(self, word: str, candidates: Sequence[str],
                      top_k: int = 5) -> List[Tuple[str, float]]:
         """k nearest candidate words by embedding cosine (the reference's
         word2vec ``most_similar`` surface, backend.py:297-301, over an
         explicit candidate list instead of a fixed gensim vocabulary).
 
-        One padded device batch embeds the query and all candidates.
+        Rides :meth:`embed`, so candidate ranking climbs the same
+        table -> LRU -> device ladder: in-vocabulary candidates are
+        served from the int8 table and only OOV text pays a padded
+        device batch.
         """
         if not candidates:
             return []

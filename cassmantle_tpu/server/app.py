@@ -960,29 +960,44 @@ async def handle_debug_trace(request: web.Request) -> web.Response:
     return web.json_response({"trace_dir": log_dir, "seconds": seconds})
 
 
-@functools.lru_cache(maxsize=1)
+# (wordlist tuple, payload bytes, quoted ETag) — keyed on the IDENTITY
+# of load_wordlist()'s cached tuple. The strong reference pins the tuple
+# alive, so its id can never be reused by a successor; payload and ETag
+# (one sha256 over ~0.4 MB) are computed exactly once per lexicon
+# object, not per request, and recompute if the assets cache is ever
+# cleared and rebuilt (tests regenerating the lexicon).
+_WORDLIST_CACHE: Optional[tuple] = None
+
+
 def _wordlist_payload() -> bytes:
     """The ~38k-word response serialized ONCE: the lexicon is immutable
     at runtime and /wordlist is hit per page load — re-serializing
-    ~0.4 MB of JSON on the event loop per request would stall the 1 Hz
-    WS clock pushes."""
+    ~0.4 MB of JSON (or re-hashing it for the ETag) on the event loop
+    per request would stall the 1 Hz WS clock pushes."""
+    global _WORDLIST_CACHE
+    import hashlib
     import json
 
     from cassmantle_tpu.engine.masking import STOPWORDS
     from cassmantle_tpu.server.assets import load_wordlist
 
-    return json.dumps({
-        "words": list(load_wordlist()),
+    words = load_wordlist()
+    cache = _WORDLIST_CACHE
+    if cache is not None and cache[0] is words:
+        return cache[1]
+    payload = json.dumps({
+        "words": list(words),
         "stopwords": sorted(STOPWORDS),
         "min_len": 2,
     }).encode()
+    etag = '"' + hashlib.sha256(payload).hexdigest()[:16] + '"'
+    _WORDLIST_CACHE = (words, payload, etag)
+    return payload
 
 
-@functools.lru_cache(maxsize=1)
 def _wordlist_etag() -> str:
-    import hashlib
-
-    return '"' + hashlib.sha256(_wordlist_payload()).hexdigest()[:16] + '"'
+    _wordlist_payload()
+    return _WORDLIST_CACHE[2]
 
 
 async def handle_wordlist(request: web.Request) -> web.Response:
@@ -1185,10 +1200,12 @@ def _build_store(store_addr: Optional[str], cfg: FrameworkConfig):
 
 def _serving_components(cfg: FrameworkConfig, fake: bool,
                         weights_dir: Optional[str], supervisor):
-    """(backend, embed, similarity, blur_fn) — built ONCE per worker and
-    shared by every room's game, so N rooms' round generation funnels
-    into the same batched device path (the fabric scales the game, not
-    the model count)."""
+    """(backend, embed, similarity, blur_fn, pin_answers) — built ONCE
+    per worker and shared by every room's game, so N rooms' round
+    generation funnels into the same batched device path (the fabric
+    scales the game, not the model count). ``pin_answers`` is the
+    RoundManager promotion hook that pins round answers into the int8
+    embed table (ops/embed_table.py), or None when no table is armed."""
     if fake:
         from cassmantle_tpu.engine.content import (
             FakeContentBackend,
@@ -1197,6 +1214,7 @@ def _serving_components(cfg: FrameworkConfig, fake: bool,
         )
 
         similarity = hash_similarity
+        pin_answers = None
         if cfg.serving.fake_score_batch_ms > 0:
             # overload-drill wiring (bench.py overload_drill): the fake
             # scorer rides a REAL BatchingQueue whose handler simulates
@@ -1207,14 +1225,32 @@ def _serving_components(cfg: FrameworkConfig, fake: bool,
             )
 
             similarity = FakeQueuedScorer(cfg, supervisor).similarity
+        from cassmantle_tpu.ops.embed_table import fake_table_enabled
+
+        if fake_table_enabled():
+            # A/B arm for the table rung on jax-free drill workers
+            # (CASSMANTLE_FAKE_EMBED_TABLE=1, docs/DEPLOY.md §6): the
+            # same EmbedTable + int8 math as production, rows from
+            # hash_embed instead of MiniLM, in FRONT of whatever fake
+            # ladder is armed above — in-vocabulary pairs skip the
+            # queue exactly like production rung 0
+            from cassmantle_tpu.ops.embed_table import (
+                TableFirstSimilarity,
+                build_fake_table,
+                pin_answers_hash,
+            )
+
+            table = build_fake_table()
+            similarity = TableFirstSimilarity(table, similarity)
+            pin_answers = functools.partial(pin_answers_hash, table)
         return FakeContentBackend(image_size=256), hash_embed, \
-            similarity, None
+            similarity, None, pin_answers
     from cassmantle_tpu.serving.service import InferenceService
 
     service = InferenceService(cfg, weights_dir=weights_dir,
                                supervisor=supervisor)
     return service.content_backend, service.embed, service.similarity, \
-        service.blur
+        service.blur, service.pin_answers
 
 
 def build_game(cfg: FrameworkConfig, fake: bool = False,
@@ -1234,10 +1270,11 @@ def build_game(cfg: FrameworkConfig, fake: bool = False,
     # the same /readyz verdict
     supervisor = ServingSupervisor()
     store = _build_store(store_addr, cfg)
-    backend, embed, similarity, blur_fn = _serving_components(
-        cfg, fake, weights_dir, supervisor)
+    backend, embed, similarity, blur_fn, pin_answers = \
+        _serving_components(cfg, fake, weights_dir, supervisor)
     return Game(cfg, store, backend, embed=embed, similarity=similarity,
-                blur_fn=blur_fn, supervisor=supervisor)
+                blur_fn=blur_fn, supervisor=supervisor,
+                pin_answers=pin_answers)
 
 
 def apply_fabric_env(cfg: FrameworkConfig) -> FrameworkConfig:
@@ -1276,8 +1313,8 @@ def build_fabric(cfg: FrameworkConfig, fake: bool = False,
                       or cfg.fabric.advertise_addr)
     supervisor = ServingSupervisor()
     store = _build_store(store_addr, cfg)
-    backend, embed, similarity, blur_fn = _serving_components(
-        cfg, fake, weights_dir, supervisor)
+    backend, embed, similarity, blur_fn, pin_answers = \
+        _serving_components(cfg, fake, weights_dir, supervisor)
 
     def game_factory(room: str, room_store) -> Game:
         # room= labels the game's engine metric series (game.guesses,
@@ -1285,7 +1322,8 @@ def build_fabric(cfg: FrameworkConfig, fake: bool = False,
         # distinguishable on /metrics (docs/OBSERVABILITY.md)
         return Game(cfg, room_store, backend, embed=embed,
                     similarity=similarity, blur_fn=blur_fn,
-                    supervisor=supervisor, room=room)
+                    supervisor=supervisor, room=room,
+                    pin_answers=pin_answers)
 
     return RoomFabric(cfg, store, game_factory, worker_id=worker_id,
                       advertise_addr=advertise_addr,

@@ -533,6 +533,17 @@ def note_shed() -> None:
     _LAST_SHED_T = time.monotonic()
 
 
+def note_table_served(n: int) -> None:
+    """Scoring work served from the host int8 embed table
+    (ops/embed_table.py) never reached this module's limiter — by
+    construction it costs no device time, so admitting it would only
+    distort the limiter's wait/service estimates. Counted here
+    (``overload.table_served``) so the interactive tier's capacity math
+    can attribute traffic that bypassed admission entirely."""
+    if n:
+        metrics.inc("overload.table_served", n)
+
+
 def shedding(within_s: float = _SHED_ADVERT_S) -> bool:
     return _LAST_SHED_T is not None and \
         time.monotonic() - _LAST_SHED_T < within_s
@@ -634,4 +645,8 @@ def status_block() -> Dict[str, object]:
         "queues": {name: lim.snapshot()
                    for name, lim in sorted(_LIMITERS.items())},
         "shedding": shedding(),
+        # lifetime count of scoring items the embed-table rung served
+        # without ever reaching a queue limiter (zero device work)
+        "table_served": int(metrics.counter_total(
+            "overload.table_served")),
     }

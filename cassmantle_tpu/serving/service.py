@@ -18,6 +18,7 @@ from cassmantle_tpu.ops.scorer import EmbeddingScorer
 from cassmantle_tpu.serving.overload import (
     PRIORITY_BACKGROUND,
     make_admission,
+    note_table_served,
 )
 from cassmantle_tpu.serving.pipeline import TPUContentBackend
 from cassmantle_tpu.serving.queue import (
@@ -119,13 +120,44 @@ class InferenceService:
     def embed(self, words) -> np.ndarray:
         return self.scorer.embed(list(words))
 
+    def pin_answers(self, words) -> int:
+        """RoundManager promotion hook (engine/rounds.py): embed the
+        round's answers once and pin them into the scorer's int8 table,
+        so every (in-vocabulary guess, answer) pair that follows is
+        rung-0-servable with zero device dispatches."""
+        return self.scorer.pin_answers(list(words))
+
     async def similarity(self, pairs) -> np.ndarray:
-        """SimilarityFn: each pair rides the continuous-batching queue, so
-        concurrent guesses from many players coalesce into one device
-        batch. The score breaker wraps the dispatch: while open, guesses
-        degrade to floor scores instantly (no queue, no device dial) and
-        the HTTP layer sheds with 503 + Retry-After; deadline/watchdog
-        failures count toward tripping it."""
+        """SimilarityFn, ladder rung 0: pairs fully covered by the
+        armed int8 embed table complete right here as host dot products
+        — no queue submit, no admission check, no breaker consult (the
+        limiter's capacity estimates should only ever see true device
+        work; ``overload.table_served`` counts what bypassed it). Pairs
+        with any OOV side keep the entire queued ladder below."""
+        pairs = list(pairs)
+        table = self.scorer.table_scores(pairs)
+        if table is not None:
+            scores, served = table
+            if served.all():
+                note_table_served(len(pairs))
+                return scores
+            if served.any():
+                rest_idx = [i for i, s in enumerate(served) if not s]
+                note_table_served(len(pairs) - len(rest_idx))
+                rest = await self._queued_similarity(
+                    [pairs[i] for i in rest_idx])
+                for j, i in enumerate(rest_idx):
+                    scores[i] = rest[j]
+                return scores
+        return await self._queued_similarity(pairs)
+
+    async def _queued_similarity(self, pairs) -> np.ndarray:
+        """The queued ladder: each pair rides the continuous-batching
+        queue, so concurrent guesses from many players coalesce into one
+        device batch. The score breaker wraps the dispatch: while open,
+        guesses degrade to floor scores instantly (no queue, no device
+        dial) and the HTTP layer sheds with 503 + Retry-After;
+        deadline/watchdog failures count toward tripping it."""
         import asyncio
 
         pairs = list(pairs)

@@ -75,6 +75,13 @@ FAST_MODULES = frozenset({
     # real-geometry distill compile test inside the module is marked
     # slow per-test (the marker loop below keeps it out of `-m fast`)
     "test_distill",
+    # zero-device guess scoring (ISSUE 16): the artifact drift gate,
+    # the int8-parity pin over the full wordlist (~25s tiny-encoder
+    # embed, shared module-scoped), and the zero-queue/zero-device
+    # counter pin are acceptance bars that must run in every quick
+    # sweep — a stale committed table or a fast path that silently
+    # dispatches device work must fail fast
+    "test_embed_table",
     "test_eval",
     "test_fabric", "test_fault_injection",
     "test_flash_attention", "test_frontend", "test_fused_conv",
@@ -162,8 +169,10 @@ def pytest_collection_modifyitems(config, items):
         if name in FAST_MODULES and \
                 item.get_closest_marker("slow") is None:
             # a per-test @pytest.mark.slow inside a fast module (e.g.
-            # test_distill's real-geometry compile) keeps that test out
-            # of the `-m fast` sweep, not just out of tier-1
+            # test_distill's real-geometry compile, test_queue's two
+            # real-pipeline service builds — demoted round 21 when the
+            # default tier outgrew its 870s window again) keeps that
+            # test out of the `-m fast` sweep, not just out of tier-1
             item.add_marker(pytest.mark.fast)
         if name in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)

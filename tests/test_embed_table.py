@@ -1,0 +1,488 @@
+"""Zero-device guess scoring (ISSUE 16): the committed int8 wordlist
+embedding table and the table -> LRU -> device scoring ladder.
+
+Layers covered here:
+
+- quantization fidelity: int8-vs-fp32 cosine parity pinned across the
+  FULL wordlist (tiny test encoder — the quantizer under test is
+  config-independent) and rank preservation on the pos_gold content
+  words;
+- artifact discipline: the committed data/embed_table.bin is
+  signature-gated against what tools/build_embed_table.py would
+  regenerate (the same drift contract as the cost-model artifact), and
+  its structure (row count, unit lookups, mmap int8 rows) is pinned;
+- the ladder: key normalization + OOV/empty/unicode fallbacks, the
+  scorer's table rung counters, answer pinning at promotion
+  (RoundManager._notify_answers -> pin_answers), and the --fake
+  worker's TableFirstSimilarity wrapper;
+- the acceptance bar: a fully in-vocabulary guess completes through
+  InferenceService.similarity with ZERO device dispatch and ZERO queue
+  submits (score.batches/score.items flat while scorer.table_hits and
+  overload.table_served advance), and CASSMANTLE_NO_EMBED_TABLE=1
+  reverts to the queued path bit-exactly.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from cassmantle_tpu.config import test_config as tiny_config
+from cassmantle_tpu.ops.embed_table import (
+    EMBED_TABLE_PATH,
+    EmbedTable,
+    TableFirstSimilarity,
+    build_fake_table,
+    normalize_key,
+    pin_answers_hash,
+    quantize_rows,
+    read_header,
+)
+from cassmantle_tpu.server.assets import load_wordlist
+from cassmantle_tpu.utils.logging import metrics
+
+
+@pytest.fixture(scope="module")
+def wordlist():
+    return [normalize_key(w) for w in load_wordlist()]
+
+
+@pytest.fixture(scope="module")
+def tiny_scorer():
+    from cassmantle_tpu.ops.scorer import EmbeddingScorer
+
+    cfg = tiny_config()
+    # table=False: the fidelity fixtures need the raw fp32 encoder, not
+    # whatever artifact happens to be committed
+    return EmbeddingScorer(cfg.models.minilm, seq_len=8,
+                           batch_buckets=(512,), embed_cache_size=0,
+                           table=False)
+
+
+@pytest.fixture(scope="module")
+def full_emb(tiny_scorer, wordlist):
+    """fp32 embeddings of the ENTIRE wordlist through the tiny encoder
+    (~25 s once per module): the quantization-parity acceptance bar is
+    'across the full wordlist', not a sample."""
+    return np.asarray(tiny_scorer.embed(wordlist), dtype=np.float32)
+
+
+def _unit(rows: np.ndarray) -> np.ndarray:
+    return rows / np.maximum(
+        np.linalg.norm(rows, axis=-1, keepdims=True), 1e-8)
+
+
+def test_int8_cosine_parity_full_wordlist(wordlist, full_emb):
+    """The tentpole's fidelity bound: for every wordlist row, the int8
+    lookup cosine against a spread of probe words stays within 1e-2 of
+    the fp32 cosine (measured 4.8e-3 max / ~2e-4 mean at dim 64 over
+    ~370k pairs; production dim 384 quantizes finer), and the fused
+    score_pairs() int32-dot path agrees with the lookup path to float
+    associativity."""
+    table = EmbedTable.from_embeddings(wordlist, full_emb)
+    assert len(table) == len(wordlist)
+
+    fp32 = _unit(full_emb)
+    q8 = np.stack([table.lookup(w) for w in
+                   wordlist[:: max(1, len(wordlist) // 4096)]])
+    # lookups come out unit-norm (scale cancels; norms stored over q)
+    assert np.allclose(np.linalg.norm(q8, axis=-1), 1.0, atol=1e-5)
+
+    probes = wordlist[:: max(1, len(wordlist) // 64)][:64]
+    p_fp = fp32[[wordlist.index(p) for p in probes[:8]]]
+    p_q8 = np.stack([table.lookup(p) for p in probes[:8]])
+    # full-vocab x probe cosine error, fp32 vs int8 lookup path
+    int8_all = np.stack([table.lookup(w) for w in wordlist])
+    err = np.abs(fp32 @ p_fp.T - int8_all @ p_q8.T)
+    assert float(err.max()) < 1e-2, \
+        f"int8 cosine error {err.max():.2e} exceeds the 1e-2 pin"
+    assert float(err.mean()) < 1e-3, \
+        f"int8 mean cosine error {err.mean():.2e} exceeds the 1e-3 pin"
+
+    # fused int32-dot scoring == lookup-dot scoring (same stored norms)
+    pairs = [(probes[i], probes[(i + 3) % len(probes)])
+             for i in range(len(probes))]
+    fused, served = table.score_pairs(pairs)
+    assert served.all()
+    by_lookup = np.asarray([
+        float(np.dot(table.lookup(a), table.lookup(b)))
+        for a, b in pairs], dtype=np.float32)
+    assert np.allclose(fused, by_lookup, atol=1e-6)
+
+
+def test_rank_preservation_pos_gold(wordlist, full_emb):
+    """Scoring is only consumed as an ordering (closest guess wins the
+    round): for pos_gold content words present in the wordlist, any
+    candidate pair whose fp32 cosines differ by more than 2e-2 (well
+    clear of the ~5e-3 max quantization error at this dim) must keep
+    its relative order under int8 scoring."""
+    import os
+
+    table = EmbedTable.from_embeddings(wordlist, full_emb)
+    fp32 = _unit(full_emb)
+    index = {w: i for i, w in enumerate(wordlist)}
+
+    gold = os.path.join(os.path.dirname(EMBED_TABLE_PATH),
+                        "pos_gold.txt")
+    cands = []
+    with open(gold) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            for tok in line.split():
+                w = normalize_key(tok.rsplit("/", 1)[0])
+                if w in index and w not in cands:
+                    cands.append(w)
+    assert len(cands) >= 40, f"pos_gold yielded only {len(cands)} words"
+
+    anchors = cands[:8]
+    others = cands[8:]
+    flips = []
+    for a in anchors:
+        fp_scores = fp32[[index[o] for o in others]] @ fp32[index[a]]
+        q_scores, served = table.score_pairs([(o, a) for o in others])
+        assert served.all()
+        order = np.argsort(-fp_scores)
+        for r1, r2 in zip(order, order[1:]):
+            if fp_scores[r1] - fp_scores[r2] > 2e-2 \
+                    and q_scores[r1] <= q_scores[r2]:
+                flips.append((a, others[r1], others[r2]))
+    assert not flips, f"int8 flipped well-separated ranks: {flips[:5]}"
+
+
+def test_committed_artifact_drift_gate():
+    """Tier-1 drift gate: the committed data/embed_table.bin signature
+    must match what tools/build_embed_table.py would stamp for the
+    current wordlist + scorer config + weights identity."""
+    from tools.build_embed_table import expected_signature
+
+    header = read_header(EMBED_TABLE_PATH)
+    expect = expected_signature()
+    assert header["signature"] == expect, (
+        f"data/embed_table.bin signature {header['signature']} != "
+        f"expected {expect} — the wordlist, scorer config, or weights "
+        f"changed; rebuild with `python -m cassmantle_tpu "
+        f"build-embed-table --emit` and commit the artifact")
+
+
+def test_committed_artifact_structure():
+    """The committed artifact loads with its own signature, covers the
+    full wordlist, memory-maps int8 rows, and serves unit-norm lookups
+    + self-cosine 1.0 scores."""
+    header = read_header(EMBED_TABLE_PATH)
+    table = EmbedTable.load(EMBED_TABLE_PATH,
+                            expected_signature=header["signature"])
+    assert table is not None
+    words = [normalize_key(w) for w in load_wordlist()]
+    assert len(table) == len(words)
+    assert header["dim"] == 384 and header["version"] == 1
+    assert table._rows.dtype == np.int8
+    assert isinstance(table._rows, np.memmap)
+
+    probe = words[0]
+    vec = table.lookup(probe)
+    assert vec is not None and vec.dtype == np.float32
+    assert abs(float(np.linalg.norm(vec)) - 1.0) < 1e-5
+    scores, served = table.score_pairs([(probe, probe)])
+    assert served.all() and abs(float(scores[0]) - 1.0) < 1e-3
+    # a mismatched signature must refuse to arm (warning path)
+    assert EmbedTable.load(EMBED_TABLE_PATH,
+                           expected_signature="bogus") is None
+
+
+def test_lookup_normalization_and_fallbacks():
+    """Key discipline: NFKC + casefold + strip, so client-typed unicode
+    variants hit the same row; OOV / empty lookups return None and
+    partially-OOV pairs come back unserved with score 0."""
+    words = ["café", "straße", "apple"]
+    rng = np.random.default_rng(3)
+    emb = rng.normal(size=(3, 16)).astype(np.float32)
+    table = EmbedTable.from_embeddings(words, emb)
+    # NFKC composes the combining accent; casefold folds case + ß
+    assert table.lookup("CAFÉ ") is not None
+    assert table.lookup("STRASSE") is not None
+    assert table.lookup(" Apple\n") is not None
+    assert table.lookup("") is None
+    assert table.lookup("   ") is None
+    assert table.lookup("zz-not-in-vocab") is None
+
+    scores, served = table.score_pairs(
+        [("apple", "zz-not-in-vocab"), ("apple", "café")])
+    assert not served[0] and scores[0] == 0.0
+    assert served[1]
+    empty_scores, empty_served = table.score_pairs([])
+    assert len(empty_scores) == 0 and len(empty_served) == 0
+
+
+def test_scorer_table_rung_counters(tiny_scorer, wordlist, full_emb):
+    """EmbeddingScorer.embed ladder accounting: in-table texts are
+    served from rung 0 (scorer.table_hits; rows bit-identical to
+    table.lookup), misses fall through and count scorer.table_oov, and
+    the fall-through rows still populate/hit the LRU on repeat."""
+    from cassmantle_tpu.ops.scorer import EmbeddingScorer
+
+    cfg = tiny_config()
+    table = EmbedTable.from_embeddings(wordlist[:1024],
+                                       full_emb[:1024])
+    scorer = EmbeddingScorer(cfg.models.minilm, seq_len=8,
+                             batch_buckets=(4, 16), table=table)
+    invocab = wordlist[:3]
+    oov = ["zzqx-one", "zzqx-two"]
+    before = {k: metrics.counter_total(k) for k in
+              ("scorer.table_hits", "scorer.table_oov",
+               "scorer.embed_cache_hits")}
+    rows = scorer.embed(invocab + oov)
+    assert metrics.counter_total("scorer.table_hits") \
+        == before["scorer.table_hits"] + 3
+    assert metrics.counter_total("scorer.table_oov") \
+        == before["scorer.table_oov"] + 2
+    for i, w in enumerate(invocab):
+        assert np.array_equal(rows[i], table.lookup(w))
+    # repeat: the two OOV rows now come from the LRU rung
+    scorer.embed(oov)
+    assert metrics.counter_total("scorer.embed_cache_hits") \
+        == before["scorer.embed_cache_hits"] + 2
+
+
+def test_scorer_pin_answers(tiny_scorer, wordlist, full_emb):
+    """pin_answers embeds only rows the table lacks, pins them through
+    the identical quantizer, dedups, and is idempotent — the promotion
+    hook must be free when answers are already in vocabulary."""
+    from cassmantle_tpu.ops.scorer import EmbeddingScorer
+
+    cfg = tiny_config()
+    table = EmbedTable.from_embeddings(wordlist[:128], full_emb[:128])
+    scorer = EmbeddingScorer(cfg.models.minilm, seq_len=8,
+                             batch_buckets=(4, 16), table=table)
+    assert scorer.pin_answers([wordlist[0], wordlist[1]]) == 0
+    pinned = scorer.pin_answers(["Unseen-Answer", "unseen-answer",
+                                 wordlist[2]])
+    assert pinned == 1
+    assert table.contains("unseen-answer")
+    assert scorer.pin_answers(["unseen-answer"]) == 0
+    scores, served = table.score_pairs(
+        [(wordlist[0], "unseen-answer")])
+    assert served.all()
+    # the pinned row rides the same quantizer as committed rows: its
+    # lookup is unit-norm and self-cosine is 1.0
+    vec = table.lookup("unseen-answer")
+    assert abs(float(np.linalg.norm(vec)) - 1.0) < 1e-5
+
+
+def _service_with_table():
+    from cassmantle_tpu.serving.service import InferenceService
+
+    svc = InferenceService(tiny_config())
+    words = ["alpha", "beta", "gamma"]
+    emb = np.asarray(svc.scorer.embed(words), dtype=np.float32)
+    svc.scorer.table = EmbedTable.from_embeddings(words, emb)
+    return svc, words
+
+
+def test_service_zero_device_zero_queue(monkeypatch):
+    """THE acceptance pin: a fully in-vocabulary pair through
+    InferenceService.similarity touches neither the batching queue nor
+    the device — score.batches / score.items / scorer.embed_cache_misses
+    stay flat while scorer.table_hits advances by 2 and
+    overload.table_served by 1. The queue is deliberately NOT started:
+    any submit would hang the test, so passing IS the bypass proof."""
+    monkeypatch.delenv("CASSMANTLE_NO_EMBED_TABLE", raising=False)
+    svc, words = _service_with_table()
+    flat = ("score.batches", "score.items",
+            "scorer.embed_cache_misses")
+    moving = ("scorer.table_hits", "overload.table_served")
+    before = {k: metrics.counter_total(k) for k in flat + moving}
+
+    scores = asyncio.run(
+        asyncio.wait_for(svc.similarity([("alpha", "beta")]), 5.0))
+    assert scores.shape == (1,) and scores.dtype == np.float32
+
+    for k in flat:
+        assert metrics.counter_total(k) == before[k], \
+            f"{k} moved — the table fast path dispatched device work"
+    assert metrics.counter_total("scorer.table_hits") \
+        == before["scorer.table_hits"] + 2
+    assert metrics.counter_total("overload.table_served") \
+        == before["overload.table_served"] + 1
+
+
+def test_service_partial_pair_merges_queue_scores(monkeypatch):
+    """A batch mixing in-vocab and OOV pairs serves the covered pairs
+    from the table and routes ONLY the rest through the queue, merging
+    scores back in request order."""
+    monkeypatch.delenv("CASSMANTLE_NO_EMBED_TABLE", raising=False)
+    svc, words = _service_with_table()
+    pairs = [("alpha", "beta"), ("alpha", "zz-oov-word"),
+             ("beta", "gamma")]
+
+    async def run():
+        svc.score_queue.start()
+        got = await svc.similarity(pairs)
+        await svc.stop()
+        return got
+
+    before = metrics.counter_total("score.items")
+    scores = asyncio.run(run())
+    # only the OOV pair rode the queue
+    assert metrics.counter_total("score.items") == before + 1
+    direct = svc.scorer.similarity(pairs)
+    table_scores, served = svc.scorer.table.score_pairs(pairs)
+    assert served[0] and not served[1] and served[2]
+    assert scores[0] == pytest.approx(table_scores[0])
+    assert scores[2] == pytest.approx(table_scores[2])
+    assert scores[1] == pytest.approx(direct[1], abs=1e-6)
+
+
+def test_kill_switch_reverts_bit_exact(monkeypatch):
+    """CASSMANTLE_NO_EMBED_TABLE=1 must reproduce the pre-table queued
+    path BIT-exactly (same fp32 encoder, same queue), not merely
+    approximately — the operator's revert story is 'flip the flag,
+    get yesterday's numbers'."""
+    svc, words = _service_with_table()
+    pairs = [("alpha", "beta"), ("beta", "gamma")]
+
+    monkeypatch.setenv("CASSMANTLE_NO_EMBED_TABLE", "1")
+
+    async def run():
+        svc.score_queue.start()
+        got = await svc.similarity(pairs)
+        await svc.stop()
+        return got
+
+    before_hits = metrics.counter_total("scorer.table_hits")
+    killed = asyncio.run(run())
+    assert metrics.counter_total("scorer.table_hits") == before_hits
+    reference = np.asarray(svc.scorer.similarity(pairs),
+                           dtype=np.float32)
+    assert np.array_equal(killed, reference), \
+        "kill switch did not revert to the queued fp32 path bit-exactly"
+    # and the switch really changes the serving rung: armed scores are
+    # the quantized table's, close to fp32 but not the same code path
+    monkeypatch.delenv("CASSMANTLE_NO_EMBED_TABLE")
+    armed = asyncio.run(
+        asyncio.wait_for(svc.similarity(pairs), 5.0))
+    assert np.allclose(armed, reference, atol=5e-3)
+
+
+def test_round_promotion_pins_answers():
+    """RoundManager._notify_answers extracts the masked answer tokens
+    from a promoted prompt_state (dict, bytes, or JSON str — the three
+    shapes the call sites hold) and hands them to the pin hook off the
+    event loop; a failing hook counts rounds.answer_pin_failures and
+    never breaks promotion."""
+    from cassmantle_tpu.engine.rounds import RoundManager
+
+    rm = RoundManager.__new__(RoundManager)
+    rm.metric_labels = {}
+    pinned = []
+    rm.on_answers = pinned.extend
+
+    state = {"tokens": ["a", "stormy", "sea", "at", "dusk"],
+             "masks": [1, 4]}
+    asyncio.run(rm._notify_answers(state))
+    asyncio.run(rm._notify_answers(json.dumps(state).encode()))
+    asyncio.run(rm._notify_answers(json.dumps(state)))
+    assert pinned == ["stormy", "dusk"] * 3
+
+    def boom(_words):
+        raise RuntimeError("pin exploded")
+
+    rm.on_answers = boom
+    before = metrics.counter_total("rounds.answer_pin_failures")
+    asyncio.run(rm._notify_answers(state))   # must not raise
+    assert metrics.counter_total("rounds.answer_pin_failures") \
+        == before + 1
+    # a None hook (real-path services absent) is a silent no-op
+    rm.on_answers = None
+    asyncio.run(rm._notify_answers(state))
+
+
+def test_table_first_similarity_fake_path(monkeypatch):
+    """The --fake worker ladder (TableFirstSimilarity): covered pairs
+    never reach the fallback, mixed batches split-and-merge, the kill
+    switch routes everything through, and pin_answers_hash makes OOV
+    template answers servable."""
+    monkeypatch.delenv("CASSMANTLE_NO_EMBED_TABLE", raising=False)
+    monkeypatch.setenv("CASSMANTLE_FAKE_EMBED_TABLE", "1")
+    table = build_fake_table()
+    assert len(table) == len(load_wordlist())
+
+    calls = []
+
+    async def fallback(pairs):
+        calls.append(list(pairs))
+        return np.full((len(pairs),), 0.25, dtype=np.float32)
+
+    ladder = TableFirstSimilarity(table, fallback)
+    w = [normalize_key(x) for x in load_wordlist()[:3]]
+
+    before = metrics.counter_total("overload.table_served")
+    scores = asyncio.run(ladder([(w[0], w[1]), (w[1], w[2])]))
+    assert not calls, "fully covered pairs leaked to the fallback"
+    assert metrics.counter_total("overload.table_served") == before + 2
+
+    mixed = asyncio.run(ladder([(w[0], w[1]), (w[0], "zz-oov")]))
+    assert calls == [[(w[0], "zz-oov")]]
+    assert mixed[1] == pytest.approx(0.25)
+
+    monkeypatch.setenv("CASSMANTLE_NO_EMBED_TABLE", "1")
+    calls.clear()
+    asyncio.run(ladder([(w[0], w[1])]))
+    assert calls == [[(w[0], w[1])]]
+    monkeypatch.delenv("CASSMANTLE_NO_EMBED_TABLE")
+
+    # fake promotion pin: template answers outside the wordlist (e.g.
+    # 'crooked') become servable through the hash embedder
+    assert not table.contains("crooked")
+    assert pin_answers_hash(table, ["Crooked", "crooked", w[0]]) == 1
+    assert table.contains("crooked")
+    _, served = table.score_pairs([(w[0], "crooked")])
+    assert served.all()
+
+
+def test_quantize_rows_contract():
+    """quantize_rows invariants the artifact format leans on: per-row
+    symmetric scales, int8 range, norms taken over the QUANTIZED row
+    (so lookup and fused scoring divide by the same quantity), and
+    zero rows survive without NaN."""
+    rng = np.random.default_rng(11)
+    emb = rng.normal(size=(8, 32)).astype(np.float32)
+    emb[3] = 0.0
+    q, scales, norms = quantize_rows(emb)
+    assert q.dtype == np.int8 and q.shape == emb.shape
+    assert scales.dtype == np.float32 and norms.dtype == np.float32
+    assert int(np.abs(q).max()) <= 127
+    expect_norms = np.maximum(
+        np.linalg.norm(q.astype(np.float32), axis=-1), 1e-8)
+    assert np.allclose(norms, expect_norms)
+    assert np.all(np.isfinite(q[3].astype(np.float32) / norms[3]))
+    # round-trip: dequantized rows track the originals
+    deq = q.astype(np.float32) * scales[:, None]
+    keep = np.arange(8) != 3
+    cos = np.sum(_unit(deq)[keep] * _unit(emb)[keep], axis=-1)
+    assert float(cos.min()) > 0.99
+
+
+def test_wordlist_payload_identity_cache():
+    """Satellite: /wordlist's serialized payload + ETag are computed
+    once per lexicon OBJECT — repeated calls return the same bytes
+    object, and clearing the assets cache (a regenerated lexicon)
+    recomputes instead of serving the stale payload forever."""
+    from cassmantle_tpu.server import app as app_mod
+
+    p1 = app_mod._wordlist_payload()
+    e1 = app_mod._wordlist_etag()
+    assert app_mod._wordlist_payload() is p1
+    assert app_mod._wordlist_etag() == e1
+
+    load_wordlist.cache_clear()
+    try:
+        p2 = app_mod._wordlist_payload()
+        assert p2 is not p1          # recomputed for the new identity
+        assert p2 == p1              # same lexicon content -> same bytes
+        assert app_mod._wordlist_etag() == e1
+        assert app_mod._wordlist_payload() is p2
+    finally:
+        load_wordlist.cache_clear()

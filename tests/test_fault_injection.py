@@ -205,6 +205,13 @@ async def test_retry_deadline_bounds_lock_hold_time():
 
     backend = DeadBackend()
     game = make_game(backend, retries=50)
+    # this test pins the retry DEADLINE, not the breaker (which has its
+    # own arm_fast_breaker tests below): with the breaker armed, the
+    # jittered backoff stream decides whether 5 attempts fit inside the
+    # 0.8 s deadline — when they do, the breaker opens first and
+    # CircuitOpen beats the expected deadline RuntimeError (observed
+    # flake under load). Disarm it so the deadline path is what runs.
+    game.rounds.breaker = None
     game.rounds.retry_backoff_s = 0.2
     game.rounds.lock_timeout = 1.0         # deadline = 0.8 s
     t0 = time.monotonic()

@@ -1,0 +1,140 @@
+"""Emit the committed int8 wordlist embedding table (data/embed_table.bin).
+
+Embeds the FULL game vocabulary (server/assets.load_wordlist: the mined
+wordlist plus seed/style tokens) with the real production
+EmbeddingScorer, quantizes per ops/embed_table.quantize_rows, and
+writes the signature-stamped artifact the runtime scorer memory-maps as
+the first rung of the scoring ladder. The signature digests the
+wordlist content, the scorer config, and the weights identity — the
+same drift discipline as tools/profile_unet.py --emit-cost-model — and
+a tier-1 gate (tests/test_embed_table.py) fails whenever the committed
+artifact no longer matches what this tool would regenerate.
+
+Usage:  python -m cassmantle_tpu build-embed-table --emit
+        python tools/build_embed_table.py --out /tmp/t.bin [--weights D]
+            [--seq-len 16] [--batch 1024]
+
+Without --emit/--out it prints the committed artifact's signature vs
+the expected one (the check mode the drift gate runs in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def expected_signature(mcfg=None, seq_len: int = 16, weights_dir=None,
+                       words=None) -> str:
+    """Signature this tool WOULD stamp for the given config — jax-free
+    (hashes the wordlist + config + weights identity; embeds nothing),
+    so the tier-1 drift gate stays cheap."""
+    from cassmantle_tpu.ops import embed_table as et
+
+    if mcfg is None:
+        from cassmantle_tpu.config import FrameworkConfig
+
+        mcfg = FrameworkConfig().models.minilm
+    if words is None:
+        from cassmantle_tpu.server.assets import load_wordlist
+
+        words = load_wordlist()
+    norm = [et.normalize_key(w) for w in words]
+    return et.table_signature(
+        mcfg, seq_len, norm, et.weights_fingerprint(weights_dir))
+
+
+def emit_embed_table(path=None, cfg=None, weights_dir=None,
+                     seq_len: int = 16, scorer=None, batch: int = 1024,
+                     words=None, quiet: bool = False):
+    """Embed the vocabulary and write the artifact. Returns the header.
+
+    ``scorer`` injection lets tests emit with the tiny test-config
+    encoder; production emits build a fresh scorer with the table rung
+    OFF (embedding through an armed stale table would launder its rows
+    into the new artifact)."""
+    import numpy as np
+
+    from cassmantle_tpu.ops import embed_table as et
+
+    if cfg is None:
+        from cassmantle_tpu.config import FrameworkConfig
+
+        cfg = FrameworkConfig()
+    if path is None:
+        path = et.EMBED_TABLE_PATH
+    if words is None:
+        from cassmantle_tpu.server.assets import load_wordlist
+
+        words = load_wordlist()
+    words = [et.normalize_key(w) for w in words]
+    if scorer is None:
+        from cassmantle_tpu.ops.scorer import EmbeddingScorer
+
+        scorer = EmbeddingScorer(
+            cfg.models.minilm, weights_dir=weights_dir, seq_len=seq_len,
+            batch_buckets=(batch,), embed_cache_size=0, table=False)
+    chunks = []
+    t0 = time.time()
+    for start in range(0, len(words), batch):
+        chunks.append(np.asarray(
+            scorer.embed(words[start:start + batch]), dtype=np.float32))
+        if not quiet and (start // batch) % 8 == 0:
+            done = start + len(chunks[-1])
+            rate = done / max(time.time() - t0, 1e-9)
+            print(f"  embedded {done}/{len(words)} "
+                  f"({rate:.0f} rows/s)", flush=True)
+    emb = np.concatenate(chunks, axis=0)
+    header = et.write_table(
+        path, words, emb, cfg.models.minilm, scorer.seq_len,
+        et.weights_fingerprint(weights_dir))
+    if not quiet:
+        print(f"wrote {path}: {header['count']} x {header['dim']} int8 "
+              f"rows, signature {header['signature']} "
+              f"({os.path.getsize(path) / 1e6:.1f} MB, "
+              f"{time.time() - t0:.0f}s)")
+    return header
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--emit", action="store_true",
+                    help="write the committed data/embed_table.bin")
+    ap.add_argument("--out", default=None,
+                    help="write to an explicit path instead")
+    ap.add_argument("--weights", default=None,
+                    help="weights dir (minilm.safetensors); default "
+                         "deterministic random-init")
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=1024)
+    args = ap.parse_args(argv)
+
+    from cassmantle_tpu.ops import embed_table as et
+
+    if not args.emit and args.out is None:
+        expect = expected_signature(seq_len=args.seq_len,
+                                    weights_dir=args.weights)
+        try:
+            committed = et.read_header(et.EMBED_TABLE_PATH)["signature"]
+        except (OSError, ValueError):
+            committed = "<absent>"
+        print(f"expected signature:  {expect}")
+        print(f"committed signature: {committed}")
+        if committed != expect:
+            print("DRIFT — rerun with --emit to rebuild")
+            return 1
+        return 0
+
+    path = args.out or et.EMBED_TABLE_PATH
+    emit_embed_table(path=path, weights_dir=args.weights,
+                     seq_len=args.seq_len, batch=args.batch)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
