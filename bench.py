@@ -2149,6 +2149,189 @@ def bench_overload_drill_table(weights_dir: str) -> dict:
     }
 
 
+# -- device-loss drill (ISSUE 17): poison, then kill, the (fake) device --
+# -- and prove zero invalid outputs served + bounded recovery ------------
+
+def device_loss_drill_run(seed: int = 42, rate: float = 50.0,
+                          baseline_s: float = 1.5, poison_s: float = 2.0,
+                          kill_s: float = 5.0, recovered_s: float = 2.0,
+                          rebuild_s: float = 0.25) -> dict:
+    """The integrity/recovery stack driven end to end IN PROCESS: a
+    real BatchingQueue (own dispatch worker), a real ServingSupervisor,
+    a real DeviceRecoveryManager — only the device itself is fake (a
+    handler whose 'runtime' the ``device.lost`` chaos rule kills and
+    whose outputs the ``device.poison`` rule corrupts). Four phases:
+
+    - **baseline**: closed-loop submits, everything serves.
+    - **poison**: ``device.poison`` flake armed; corrupted batch members
+      must fail their OWN future with OutputInvalid — zero non-finite
+      values may ever resolve as results (``invalid_served`` == 0).
+    - **kill**: ``device.lost`` fires once; the dispatch error
+      classifies, the supervisor flips ``device_lost`` (submits fail
+      fast), the manager rebuilds (``rebuild_s`` fake re-upload) and
+      recovery_s is the lost->serving wall clock.
+    - **recovered**: chaos disarmed; goodput must be back >= 90%.
+
+    Every submit carries a deadline, so ALL futures resolve by
+    construction — the drill asserts the accounting matches."""
+    import asyncio
+    import math
+
+    import numpy as np
+
+    from cassmantle_tpu.chaos import ChaosInjected, configure, disarm, \
+        fault_point
+    from cassmantle_tpu.serving import integrity
+    from cassmantle_tpu.serving.device_recovery import (
+        DeviceRecoveryManager,
+    )
+    from cassmantle_tpu.serving.integrity import OutputInvalid
+    from cassmantle_tpu.serving.queue import (
+        BatchingQueue,
+        DeadlineExceeded,
+        QueueFull,
+        _DispatchWorker,
+    )
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    dev = {"alive": True, "generation": 0}
+
+    def handle(items):
+        try:
+            fault_point("device.lost", peer="drill")
+        except ChaosInjected:
+            dev["alive"] = False  # the runtime is gone until rebuilt
+            raise
+        if not dev["alive"]:
+            raise RuntimeError("fake TPU: device is lost")
+        out = np.asarray([float(len(str(s))) for s in items],
+                         dtype=np.float32)
+        out = integrity.poison(out, peer="drill")
+        bad = set(integrity.invalid_members(np.isfinite(out)).tolist())
+        if bad:
+            integrity.note_invalid("drill", "score", sorted(bad))
+        return [OutputInvalid("drill", "score", [i]) if i in bad
+                else float(out[i]) for i in range(len(items))]
+
+    def rebuild() -> None:
+        time.sleep(rebuild_s)  # stands in for the checkpoint re-upload
+        dev["generation"] += 1
+        dev["alive"] = True
+
+    def warm() -> None:
+        if not dev["alive"]:
+            raise RuntimeError("fake TPU: still lost after rebuild")
+
+    sup = ServingSupervisor()
+    rec = DeviceRecoveryManager(supervisor=sup, rebuild=rebuild,
+                                warm=warm, backoff_s=0.1)
+
+    async def drive() -> dict:
+        q = BatchingQueue(
+            handle, max_batch=8, max_delay_ms=5.0, name="drill",
+            default_deadline_s=2.0, hang_timeout_s=5.0,
+            supervisor=sup,
+            dispatcher=_DispatchWorker("drill.dispatch", rank=20),
+            on_dispatch_error=rec.note_dispatch_exception,
+        )
+        loop = asyncio.get_running_loop()
+        invalid_served = [0]
+        lost_at = [None]
+        recovered_at = [None]
+
+        async def phase(name: str, seconds: float) -> dict:
+            stats = {"submitted": 0, "ok": 0, "invalid": 0,
+                     "rejected": 0, "dispatch_failed": 0,
+                     "deadline": 0}
+            end = loop.time() + seconds
+            i = 0
+            while loop.time() < end:
+                lost = sup.device_lost
+                if lost is not None and lost_at[0] is None:
+                    lost_at[0] = loop.time()
+                if lost is None and lost_at[0] is not None \
+                        and recovered_at[0] is None:
+                    recovered_at[0] = loop.time()
+                stats["submitted"] += 1
+                try:
+                    res = await q.submit(f"{name}-{i}", deadline_s=2.0)
+                    if isinstance(res, float) and not math.isfinite(res):
+                        invalid_served[0] += 1  # the one forbidden path
+                    stats["ok"] += 1
+                except OutputInvalid:
+                    stats["invalid"] += 1
+                except DeadlineExceeded:
+                    stats["deadline"] += 1
+                except QueueFull:
+                    stats["rejected"] += 1
+                except Exception:
+                    stats["dispatch_failed"] += 1
+                i += 1
+                await asyncio.sleep(1.0 / rate)
+            resolved = sum(stats[k] for k in
+                           ("ok", "invalid", "rejected",
+                            "dispatch_failed", "deadline"))
+            stats["all_resolved"] = resolved == stats["submitted"]
+            stats["goodput"] = (stats["ok"] / stats["submitted"]
+                                if stats["submitted"] else 0.0)
+            return stats
+
+        phases = {"baseline": await phase("baseline", baseline_s)}
+        configure(f"seed={seed};device.poison=flake:p=0.35,peer=drill")
+        phases["poison"] = await phase("poison", poison_s)
+        configure(f"seed={seed};device.lost=raise:times=1,peer=drill")
+        phases["kill"] = await phase("kill", kill_s)
+        disarm()
+        rec.join(timeout=10.0)
+        phases["recovered"] = await phase("recovered", recovered_s)
+        await q.stop()
+        return {
+            "phases": phases,
+            "invalid_served": invalid_served[0],
+            "recovery_s": (
+                round(recovered_at[0] - lost_at[0], 3)
+                if lost_at[0] is not None and recovered_at[0] is not None
+                else None),
+            "device_generation": dev["generation"],
+        }
+
+    return asyncio.run(drive())
+
+
+def bench_device_loss_drill(weights_dir: str) -> dict:
+    """ISSUE 17's deliverable: zero invalid outputs served under device
+    poison, bounded lost->serving recovery after a device kill, every
+    submitted future resolved, and >= 90% goodput once recovered.
+    Knobs: BENCH_DEVLOSS_SEED / BENCH_DEVLOSS_RATE /
+    BENCH_DEVLOSS_KILL_S / BENCH_DEVLOSS_REBUILD_S (env)."""
+    env = os.environ.get
+    raw = device_loss_drill_run(
+        seed=int(env("BENCH_DEVLOSS_SEED", "42")),
+        rate=float(env("BENCH_DEVLOSS_RATE", "50")),
+        kill_s=float(env("BENCH_DEVLOSS_KILL_S", "5")),
+        rebuild_s=float(env("BENCH_DEVLOSS_REBUILD_S", "0.25")),
+    )
+    phases = raw["phases"]
+    poison, recovered = phases["poison"], phases["recovered"]
+    return {
+        "metric": "device_loss_drill_recovery_s",
+        "value": raw["recovery_s"],
+        "unit": "seconds",
+        "vs_baseline": None,
+        "invalid_served": raw["invalid_served"],
+        "zero_invalid_ok": raw["invalid_served"] == 0,
+        "poison_invalid_failed": poison["invalid"],
+        "all_resolved": all(p["all_resolved"] for p in phases.values()),
+        "recovered_goodput": round(recovered["goodput"], 3),
+        "recovered_goodput_ok": recovered["goodput"] >= 0.9,
+        "device_generation": raw["device_generation"],
+        "phases": phases,
+        # recovery wall clock = rebuild sleep + classification/thread
+        # latency; timing-noisy by nature on shared CI hosts
+        "noise_tolerance": 0.5,
+    }
+
+
 # Counters whose per-entry deltas carry diagnostic weight: recompiles,
 # cache effectiveness, staged-serving churn, and every supervision
 # counter (suffix match). Attached to each BENCH_SUITE.json record so
@@ -2178,12 +2361,22 @@ _DELTA_COUNTERS = {
     # the score queue totals (flat score.items IS the zero-device proof)
     "scorer.table_hits", "scorer.table_oov", "scorer.table_pins",
     "overload.table_served", "score.batches", "score.items",
+    # output integrity + device recovery (ISSUE 17): invalid members
+    # caught per pipeline/stage, staged-slot quarantines, and the
+    # recovery loop's outcomes — a perf delta arriving with recoveries
+    # or quarantines names its own cause
+    "pipeline.output_invalid", "stage.denoise.quarantines",
+    "rounds.generate_invalid", "device.recoveries",
+    "device.recovery_permanent", "retry.budget_exhausted",
+    "checkpoint.fingerprint_mismatch",
 }
 _DELTA_SUFFIXES = (".dispatch_hangs", ".deadline_expired", ".rejected",
                    ".rejected_degraded", ".failures", ".loop_errors",
                    # overload control plane (ISSUE 13)
                    ".rejected_overload", ".rejected_predicted_late",
-                   ".rejected_background")
+                   ".rejected_background",
+                   # device-lost fail-fast rejections (ISSUE 17)
+                   ".rejected_device_lost")
 
 
 def _counter_snapshot() -> dict:
@@ -2238,6 +2431,7 @@ SUITE = {
     "overload_drill": bench_overload_drill,
     "rooms_load_table": bench_rooms_load_table,
     "overload_drill_table": bench_overload_drill_table,
+    "device_loss_drill": bench_device_loss_drill,
 }
 
 # ``--north-star-only`` measures exactly these, with BENCH_ROUNDS=1

@@ -89,6 +89,11 @@ FAULT_POINTS: Dict[str, str] = {
                     "(serving/queue.py submit; peer=queue name)",
     "overload.brownout": "brownout-ladder tier evaluation "
                          "(serving/overload.py)",
+    "device.poison": "NaN/zero corruption of one dispatch-result "
+                     "batch member (serving/integrity.py poison; "
+                     "peer=pipeline)",
+    "device.lost": "accelerator-runtime loss at a dispatch point "
+                   "(serving dispatch regions; peer=pipeline)",
 }
 
 KINDS = ("raise", "flake", "latency", "wedge", "partition")

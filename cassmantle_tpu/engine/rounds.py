@@ -43,6 +43,7 @@ from cassmantle_tpu.engine.reserve import RoundReserve
 from cassmantle_tpu.engine.store import LockTimeout, StateStore
 from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.obs.trace import tracer
+from cassmantle_tpu.serving.integrity import OutputInvalid
 from cassmantle_tpu.utils.circuit import CircuitBreaker, CircuitOpen
 from cassmantle_tpu.utils.codec import decode_jpeg, encode_jpeg
 from cassmantle_tpu.utils.logging import get_logger, metrics
@@ -171,6 +172,18 @@ class RoundManager:
             with tracer.span("round.generate", root=True,
                              attrs={"is_seed": is_seed}):
                 content = await self.backend.generate(seed, is_seed)
+        except OutputInvalid as exc:
+            # the integrity sentinel rejected device output (ISSUE 17):
+            # retriable like any attempt failure, but counted apart so a
+            # sick device is distinguishable from queue pressure in the
+            # round-generation failure mix
+            metrics.inc("rounds.generate_invalid",
+                        labels=self.metric_labels)
+            log.warning("round generation rejected invalid output: %s",
+                        exc)
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
         except Exception:
             if self.breaker is not None:
                 self.breaker.record_failure()

@@ -583,11 +583,20 @@ def load_checkpoint_tensors(
     raised). Callers converting SEVERAL models from one file (the full
     CLIP checkpoint feeds the text tower, vision tower, and projection)
     read once here and run each converter via :func:`convert_tensors`."""
+    from cassmantle_tpu.utils.checkpoint import verify_or_record
+
     if not weights_dir:
         return None
     path = os.path.join(weights_dir, filename)
     if os.path.exists(path):
         log.info("%s: loading %s", model_name, path)
+        # fingerprint check FIRST (utils/checkpoint.py, ISSUE 17): a
+        # file that changed since its first load raises
+        # CheckpointCorrupt — loudly, naming the path — instead of
+        # riding the unreadable-file random-init fallback below. A
+        # corrupt re-read during device-loss recovery must fail the
+        # rebuild attempt, not silently swap weights mid-incident.
+        verify_or_record(path)
         try:
             return load_safetensors(path)
         except Exception:
@@ -610,6 +619,7 @@ def load_checkpoint_tensors(
     log.info("%s: loading %d shards for %s", model_name, len(shards), stem)
     tensors: Tensors = {}
     for shard in shards:
+        verify_or_record(shard)
         tensors.update(load_safetensors(shard))
     return tensors
 

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cassmantle_tpu.chaos import fault_point
 from cassmantle_tpu.config import MiniLMConfig
 from cassmantle_tpu.models.minilm import MiniLMEncoder
 from cassmantle_tpu.models.weights import (
@@ -34,6 +35,8 @@ from cassmantle_tpu.ops.embed_table import (
     table_signature,
     weights_fingerprint,
 )
+from cassmantle_tpu.serving import integrity
+from cassmantle_tpu.serving.integrity import finite_verdict
 from cassmantle_tpu.utils.compile_cache import (
     enable_compile_cache,
     param_cache_path,
@@ -84,15 +87,30 @@ class EmbeddingScorer:
         sample_ids = jnp.zeros((1, self.seq_len), dtype=jnp.int32)
         sample_mask = jnp.ones((1, self.seq_len), dtype=jnp.int32)
         enable_compile_cache()
-        self.params = (
-            maybe_load(weights_dir, "minilm.safetensors",
-                       lambda t: convert_minilm(t, cfg.num_layers),
-                       "minilm")
-            or init_params_cached(
-                model, 7, sample_ids, sample_mask,
-                cache_path=param_cache_path("minilm", cfg))
-        )
-        self._encode = jax.jit(model.apply)
+
+        def load_params() -> None:
+            """Load/init the encoder tree; re-run by reload_params()
+            during a device-loss rebuild (serving/device_recovery.py)."""
+            self.params = (
+                maybe_load(weights_dir, "minilm.safetensors",
+                           lambda t: convert_minilm(t, cfg.num_layers),
+                           "minilm")
+                or init_params_cached(
+                    model, 7, sample_ids, sample_mask,
+                    cache_path=param_cache_path("minilm", cfg))
+            )
+
+        self._param_loader = load_params
+        load_params()
+        # the encode jit also returns the per-row integrity verdict
+        # (serving/integrity.py): computed in-jit, transferred with the
+        # embeddings — no extra dispatch or sync
+
+        def encode_impl(params, ids, mask):
+            emb = model.apply(params, ids, mask)
+            return emb, finite_verdict(emb)
+
+        self._encode = jax.jit(encode_impl)
         # roofline attribution (obs/costmodel.py): an encoder forward
         # costs ~2·N(params) FLOPs per token; resolved lazily from the
         # committed cost model (production MiniLM) or this tree
@@ -115,6 +133,15 @@ class EmbeddingScorer:
             self.table = None
         if self.table is not None:
             metrics.gauge("scorer.table_rows", len(self.table))
+
+    def reload_params(self) -> None:
+        """Device-loss rebuild (serving/device_recovery.py): re-load
+        the encoder tree (fingerprint-verified, utils/checkpoint.py)
+        onto the fresh runtime. The embed LRU and the int8 table hold
+        HOST arrays — content-addressed by text, runtime-independent —
+        so neither needs invalidation; params re-enter the encode jit
+        as arguments, so nothing recompiles."""
+        self._param_loader()
 
     def _autoload_table(self, weights_dir):
         try:
@@ -167,12 +194,17 @@ class EmbeddingScorer:
             mask[i, : len(toks)] = 1
         return ids, mask
 
-    def _embed_device(self, texts: Sequence[str]) -> np.ndarray:
-        """The uncached device path: (n,) texts -> (n, D) unit
-        embeddings via padded buckets (one encode per bucket chunk)."""
+    def _embed_device(self, texts: Sequence[str]
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """The uncached device path: (n,) texts -> ((n, D) unit
+        embeddings, (n,) validity) via padded buckets (one encode per
+        bucket chunk). Validity is the in-jit verdict unioned with a
+        host finiteness check of the transferred rows — all-True under
+        the integrity kill switch."""
         n = len(texts)
         batch = _pick_bucket(n, self.batch_buckets)
         out_chunks = []
+        ok_chunks = []
         for start in range(0, n, batch):
             chunk = texts[start : start + batch]
             ids, mask = self._tokenize_batch(chunk, batch)
@@ -183,12 +215,24 @@ class EmbeddingScorer:
             with block_timer("scorer.encode_s",
                              flops_est=self._row_flops() * batch,
                              pipeline="scorer") as sink:
-                emb = self._encode(self.params, jnp.asarray(ids),
-                                   jnp.asarray(mask))
+                fault_point("device.lost", peer="scorer")
+                emb, verdict = self._encode(
+                    self.params, jnp.asarray(ids), jnp.asarray(mask))
                 sink.append(emb)
             # lint: ignore[host-sync] — one sync per dispatched chunk, not per text
-            out_chunks.append(np.asarray(emb)[: len(chunk)])
-        return np.concatenate(out_chunks, axis=0)
+            rows = integrity.poison(np.asarray(emb)[: len(chunk)],
+                                    peer="scorer")
+            out_chunks.append(rows)
+            if integrity.integrity_disabled():
+                ok_chunks.append(np.ones(len(chunk), dtype=bool))
+            else:
+                # the verdict rides the completed dispatch; judging the
+                # transferred rows too catches host-side corruption
+                # lint: ignore[host-sync] — one sync per dispatched chunk, not per text
+                okj = np.asarray(verdict).astype(bool)[: len(chunk)]
+                ok_chunks.append(okj & np.isfinite(rows).all(axis=-1))
+        return (np.concatenate(out_chunks, axis=0),
+                np.concatenate(ok_chunks, axis=0))
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         """(n,) texts -> (n, D) unit embeddings via the scoring ladder:
@@ -238,9 +282,19 @@ class EmbeddingScorer:
                 else:
                     miss_rows.setdefault(text, []).append(i)
         if miss_rows:
-            fresh = self._embed_device(list(miss_rows))
+            fresh, ok = self._embed_device(list(miss_rows))
+            bad_members: List[int] = []
             with self._embed_cache_lock:
-                for row, (text, idxs) in zip(fresh, miss_rows.items()):
+                for row, valid, (text, idxs) in zip(
+                        fresh, ok, miss_rows.items()):
+                    if not valid:
+                        # an invalid row never enters the LRU (a cached
+                        # NaN would poison every later hit); the output
+                        # rows stay NaN so downstream scoring fails
+                        # loudly per pair, not silently as zeros
+                        out[idxs] = np.nan
+                        bad_members.extend(idxs)
+                        continue
                     out[idxs] = row
                     if self._embed_cache_size > 0:
                         # copy: a row VIEW would pin the whole encode
@@ -250,6 +304,9 @@ class EmbeddingScorer:
                         while len(self._embed_cache) > \
                                 self._embed_cache_size:
                             self._embed_cache.popitem(last=False)
+            if bad_members:
+                integrity.note_invalid("scorer", "encode",
+                                       sorted(bad_members))
         metrics.inc("scorer.texts", n)
         metrics.inc("scorer.embed_cache_misses", len(miss_rows))
         metrics.inc("scorer.embed_cache_hits", len(rest) - len(miss_rows))

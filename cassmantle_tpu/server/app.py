@@ -1073,7 +1073,14 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
 
         # the supervisor owns the prober and fuses its verdict into
         # /healthz and /readyz (supervisor.probe_device)
-        fabric.supervisor.device_health = DeviceHealth()
+        dh = DeviceHealth()
+        fabric.supervisor.device_health = dh
+        recovery = getattr(fabric.supervisor, "recovery", None)
+        if recovery is not None:
+            # probe raises ride the device-loss classifier
+            # (serving/device_recovery.py): a dispatch-quiet worker
+            # still detects runtime loss through its health probes
+            dh.on_probe_error = recovery.note_probe_exception
     app.router.add_get("/", handle_root)
     app.router.add_get("/init", handle_init)
     app.router.add_get("/client/status", handle_status)

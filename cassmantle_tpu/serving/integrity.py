@@ -1,0 +1,199 @@
+"""Output-integrity sentinels: no invalid output reaches a player.
+
+The data-plane counterpart of the breaker/chaos control plane (ISSUE
+17 rung 1). Every serving dispatch gets a per-batch-member validity
+verdict, computed where parity constraints allow: the scorer encode
+folds :func:`finite_verdict` into its own jit (no parity bar there);
+the staged denoise/retirement paths run it as a SEPARATE tiny jitted
+dispatch on the existing graph's output, because adding a consumer
+inside the image-producing jits changes XLA fusion and breaks the
+staged-vs-monolithic bit-parity bar (tests/test_stages.py); the
+monolithic t2i/SDXL paths and the prompt decoder judge host-side on
+the batch they already transferred (degenerate uint8 frames / token
+range) — zero extra device work on those hot paths. At uint8
+conversion the host-side detector (:func:`degenerate_frames`) catches
+the all-black / stuck-constant frames a finite-but-dead device
+produces.
+
+An invalid member NEVER reaches the image cache, a round promotion, or
+a player: the owning request fails :class:`OutputInvalid` (retriable —
+round generation falls back down the existing reserve/replay ladder),
+``pipeline.output_invalid{pipeline=,stage=}`` counts it, and the flight
+recorder keeps the forensic trail. Per-member verdicts mean one
+poisoned batch row fails one request, not the batch.
+
+Kill switch: ``CASSMANTLE_NO_INTEGRITY_CHECKS`` (read per call) makes
+every enforcement a no-op. Verdicts may still compute (they never
+touch the image-producing graphs), so flipping the switch is a
+bit-exact revert with zero recompiles.
+
+Chaos: :func:`poison` is the ``device.poison`` fault point — it
+corrupts one batch member of a dispatch result (NaN for float dtypes,
+zeros for uint8) at the caller's representation, so the detectors
+downstream must genuinely catch the bad data; detection never keys off
+the injection site.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from cassmantle_tpu.chaos import ChaosInjected, fault_point
+from cassmantle_tpu.obs.recorder import flight_recorder
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("serving.integrity")
+
+
+class OutputInvalid(RuntimeError):
+    """A dispatch produced output the integrity sentinel rejected.
+
+    Retriable: the device may be healthy again (or the poison transient)
+    on the next attempt, so callers treat this like DispatchTimeout —
+    retry/fallback ladders apply, breakers record the failure.
+    """
+
+    retriable = True
+
+    def __init__(self, pipeline: str, stage: str,
+                 members: Sequence[int] = ()):
+        self.pipeline = pipeline
+        self.stage = stage
+        # lint: ignore[host-sync] — members are host-side np indices
+        self.members = tuple(int(m) for m in members)
+        detail = f" members={list(self.members)}" if self.members else ""
+        super().__init__(
+            f"invalid output from {pipeline}/{stage}{detail}")
+
+
+def integrity_disabled() -> bool:
+    """Kill switch, read per call (flip at runtime, no restart)."""
+    return os.environ.get(
+        "CASSMANTLE_NO_INTEGRITY_CHECKS", "").lower() \
+        not in ("", "0", "false", "no", "off")
+
+
+# -- device-side verdict -----------------------------------------------------
+
+def finite_verdict(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-batch-member all-finite verdict. Fold it into a jit ONLY
+    where no bit-parity bar constrains the graph (the scorer encode);
+    paths under the staged-vs-monolithic parity bar dispatch it as its
+    own tiny jit on the producing graph's output instead — an extra
+    consumer inside those graphs changes XLA fusion and the rounding
+    of the images themselves.
+
+    ``(B, ...) -> (B,) bool``; integer outputs (token ids) are finite
+    by construction so the verdict is constant-true for them (range
+    checks are the caller's job — see PromptGenerator).
+    """
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.ones(x.shape[:1] or (1,), dtype=bool)
+    if x.ndim <= 1:
+        return jnp.isfinite(x)
+    axes = tuple(range(1, x.ndim))
+    return jnp.isfinite(x).all(axis=axes)
+
+
+# -- host-side detectors -----------------------------------------------------
+
+def degenerate_frames(u8: np.ndarray) -> np.ndarray:
+    """Constant-frame detector on a decoded uint8 batch ``(B, H, W, C)``
+    → ``(B,)`` bool, True marking a degenerate (all-black / stuck)
+    member. A frame every one of whose pixels is the same value is
+    never a real generation — it is the signature of a dead VAE or a
+    zeroed DMA buffer."""
+    arr = np.asarray(u8)
+    if arr.ndim <= 1 or arr.shape[0] == 0:
+        return np.zeros(arr.shape[:1], dtype=bool)
+    flat = arr.reshape(arr.shape[0], -1)
+    return flat.max(axis=1) == flat.min(axis=1)
+
+
+def invalid_members(verdict, *, images: Optional[np.ndarray] = None,
+                    n: Optional[int] = None) -> np.ndarray:
+    """Indices of invalid batch members: device verdict rows that are
+    False, unioned with degenerate ``images`` frames when given. ``n``
+    trims bucket-padding rows before judging. Returns an empty array
+    when the kill switch is on."""
+    if integrity_disabled():
+        return np.empty(0, dtype=np.int64)
+    ok = np.asarray(verdict).astype(bool).reshape(-1)
+    if n is not None:
+        ok = ok[:n]
+    bad = ~ok
+    if images is not None:
+        deg = degenerate_frames(
+            images if n is None else np.asarray(images)[:n])
+        m = min(len(bad), len(deg))
+        bad = bad[:m] | deg[:m]
+    return np.nonzero(bad)[0]
+
+
+def note_invalid(pipeline: str, stage: str,
+                 members: Sequence[int]) -> None:
+    """Count + flight-record invalid members (callers that handle the
+    failure per-member instead of raising use this directly)."""
+    # lint: ignore[host-sync] — members are host-side np indices
+    members = [int(m) for m in members]
+    metrics.inc("pipeline.output_invalid", float(len(members)),
+                labels={"pipeline": pipeline, "stage": stage})
+    flight_recorder.record("integrity.invalid", pipeline=pipeline,
+                           stage=stage, members=members)
+    log.warning("integrity: invalid output from %s/%s members=%s",
+                pipeline, stage, members)
+
+
+def enforce(verdict, *, pipeline: str, stage: str,
+            images: Optional[np.ndarray] = None,
+            n: Optional[int] = None) -> None:
+    """Raise :class:`OutputInvalid` (after counting) when any batch
+    member is invalid; no-op under the kill switch."""
+    members = invalid_members(verdict, images=images, n=n)
+    if members.size == 0:
+        return
+    note_invalid(pipeline, stage, members.tolist())
+    raise OutputInvalid(pipeline, stage, members.tolist())
+
+
+# -- chaos: the device.poison fault point ------------------------------------
+
+def poison(arr, peer: str, member: int = 0):
+    """``device.poison`` chaos hook: when the plan says so, corrupt one
+    batch member of ``arr`` — NaN for floats, -1 for signed ints,
+    zeros for uint8 — and return the corrupted array; otherwise ``arr``
+    untouched. Host batches (numpy) get row ``member`` corrupted;
+    device arrays (a single admitted slot row) are corrupted whole.
+
+    Signed-integer fills are -1 (out of any vocab range) so the token
+    range check downstream genuinely catches the poison; unsigned
+    (uint8 frames) fill 0 so the degenerate-frame detector does.
+    """
+    try:
+        fault_point("device.poison", peer=peer)
+    except ChaosInjected:
+        if isinstance(arr, np.ndarray):
+            if arr.ndim == 0 or arr.shape[0] == 0:
+                return arr
+            arr = np.array(arr, copy=True)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr[member % arr.shape[0]] = np.nan
+            elif np.issubdtype(arr.dtype, np.signedinteger):
+                arr[member % arr.shape[0]] = -1
+            else:
+                arr[member % arr.shape[0]] = 0
+        else:
+            if jnp.issubdtype(arr.dtype, jnp.inexact):
+                fill = jnp.nan
+            elif jnp.issubdtype(arr.dtype, jnp.signedinteger):
+                fill = -1
+            else:
+                fill = 0
+            arr = jnp.full_like(arr, fill)
+        log.warning("chaos: device.poison corrupted %s output "
+                    "(member %d)", peer, member)
+    return arr

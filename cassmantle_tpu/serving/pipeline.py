@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cassmantle_tpu.chaos import fault_point
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.engine.rounds import ContentBackend, RoundContent
 from cassmantle_tpu.models.clip_text import ClipTextEncoder
@@ -46,6 +47,7 @@ from cassmantle_tpu.ops.ddim import (
 )
 from cassmantle_tpu.ops.samplers import make_sampler
 from cassmantle_tpu.ops.decode import greedy_decode
+from cassmantle_tpu.serving import integrity
 from cassmantle_tpu.utils.locks import OrderedLock
 from cassmantle_tpu.utils.logging import get_logger, metrics
 from cassmantle_tpu.utils.profiling import annotate, block_timer
@@ -473,65 +475,84 @@ class Text2ImagePipeline:
                 cache_path=param_cache_path("unet", m.unet.arch()),
                 cast_to=m.param_dtype, transform=transform), False
 
-        if share_params_with is not None:
-            donor = share_params_with
-            self.clip_params = donor.clip_params
-            self.vae_params = donor.vae_params
-            unet_was_loaded = True
-            if donor.cfg.models.unet_int8 == m.unet_int8:
-                self.unet_params = donor.unet_params
-            elif m.unet_int8:
-                # int8 arm joining an fp donor: quantize the donor's
-                # in-memory tree (host-side) — no second checkpoint read
-                from cassmantle_tpu.ops.quant import quantize_tree_host
+        def load_all_params() -> None:
+            """Load/convert/share every stage tree and publish it on
+            ``self``. Boot runs this once; a device-loss rebuild
+            (serving/device_recovery.py, via :meth:`reload_params`)
+            runs it again to re-upload the fingerprint-verified
+            checkpoints onto the fresh runtime."""
+            if share_params_with is not None:
+                donor = share_params_with
+                self.clip_params = donor.clip_params
+                self.vae_params = donor.vae_params
+                unet_was_loaded = True
+                if donor.cfg.models.unet_int8 == m.unet_int8:
+                    self.unet_params = donor.unet_params
+                elif m.unet_int8:
+                    # int8 arm joining an fp donor: quantize the donor's
+                    # in-memory tree (host-side) — no second checkpoint
+                    # read
+                    from cassmantle_tpu.ops.quant import (
+                        quantize_tree_host,
+                    )
 
-                self.unet_params = quantize_tree_host(donor.unet_params)
+                    self.unet_params = quantize_tree_host(
+                        donor.unet_params)
+                else:
+                    # fp arm joining an int8 donor: dequantization is
+                    # lossy, so load the fp tree properly
+                    self.unet_params, unet_was_loaded = load_unet(None)
+                # the donor's flag vouches only for tensors actually
+                # taken from the donor; the fp-joins-int8-donor arm
+                # re-loads its own UNet, and if the checkpoint vanished
+                # between the two constructions that arm is random-init
+                # and must say so
+                self.loaded_real_weights = (
+                    donor.loaded_real_weights and unet_was_loaded)
             else:
-                # fp arm joining an int8 donor: dequantization is lossy,
-                # so load the fp tree properly
-                self.unet_params, unet_was_loaded = load_unet(None)
-            # the donor's flag vouches only for tensors actually taken
-            # from the donor; the fp-joins-int8-donor arm re-loads its
-            # own UNet, and if the checkpoint vanished between the two
-            # constructions that arm is random-init and must say so
-            self.loaded_real_weights = (
-                donor.loaded_real_weights and unet_was_loaded)
-        else:
-            ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
-            loaded_clip = maybe_load(
-                weights_dir, "clip_text.safetensors",
-                lambda t: convert_clip_text(t, m.clip_text.num_layers),
-                "clip_text", cast_to=m.param_dtype)
-            self.clip_params = (
-                loaded_clip if loaded_clip is not None
-                else init_params_cached(
-                    self.clip, 1, ids,
-                    cache_path=param_cache_path("clip_text", m.clip_text),
-                    cast_to=m.param_dtype)
-            )
-            lat_hw = cfg.sampler.image_size // self.vae_scale
-            lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
-            self.unet_params, unet_was_loaded = load_unet(unet_transform)
-            loaded_vae = maybe_load(
-                weights_dir, "vae.safetensors",
-                lambda t: convert_vae_decoder(t, m.vae), "vae")
-            self.vae_params = (
-                loaded_vae if loaded_vae is not None
-                else init_params_cached(
-                    self.vae, 3, lat,
-                    # cache key on arch(): fused_conv changes execution, not
-                    # the tree (see UNet note above)
-                    cache_path=param_cache_path(
-                        f"vae{cfg.sampler.image_size}", m.vae.arch()))
-            )
-            # True only when EVERY stage came from a checkpoint: quality
-            # evals (tools/clip_report.py) refuse to call a partially
-            # random-init pipeline a measurement
-            self.loaded_real_weights = (
-                loaded_clip is not None
-                and unet_was_loaded
-                and loaded_vae is not None
-            )
+                ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
+                loaded_clip = maybe_load(
+                    weights_dir, "clip_text.safetensors",
+                    lambda t: convert_clip_text(
+                        t, m.clip_text.num_layers),
+                    "clip_text", cast_to=m.param_dtype)
+                self.clip_params = (
+                    loaded_clip if loaded_clip is not None
+                    else init_params_cached(
+                        self.clip, 1, ids,
+                        cache_path=param_cache_path(
+                            "clip_text", m.clip_text),
+                        cast_to=m.param_dtype)
+                )
+                lat_hw = cfg.sampler.image_size // self.vae_scale
+                lat = jnp.zeros((1, lat_hw, lat_hw, 4),
+                                dtype=jnp.float32)
+                self.unet_params, unet_was_loaded = load_unet(
+                    unet_transform)
+                loaded_vae = maybe_load(
+                    weights_dir, "vae.safetensors",
+                    lambda t: convert_vae_decoder(t, m.vae), "vae")
+                self.vae_params = (
+                    loaded_vae if loaded_vae is not None
+                    else init_params_cached(
+                        self.vae, 3, lat,
+                        # cache key on arch(): fused_conv changes
+                        # execution, not the tree (see UNet note above)
+                        cache_path=param_cache_path(
+                            f"vae{cfg.sampler.image_size}",
+                            m.vae.arch()))
+                )
+                # True only when EVERY stage came from a checkpoint:
+                # quality evals (tools/clip_report.py) refuse to call a
+                # partially random-init pipeline a measurement
+                self.loaded_real_weights = (
+                    loaded_clip is not None
+                    and unet_was_loaded
+                    and loaded_vae is not None
+                )
+
+        self._param_loader = load_all_params
+        load_all_params()
         self.unet_apply = wrap_unet_apply(self.unet.apply)
         from cassmantle_tpu.ops.fused_conv import describe as fc_describe
 
@@ -607,6 +628,33 @@ class Text2ImagePipeline:
         self._staged_init_lock = OrderedLock("pipeline.staged_init",
                                              rank=13)
 
+    def reload_params(self) -> None:
+        """Device-loss rebuild (serving/device_recovery.py): re-run the
+        boot load path — fingerprint-verified checkpoint reads
+        (utils/checkpoint.py), donor sharing, int8 transform — and
+        republish the tree onto the fresh runtime. Compiled executables
+        take params as ARGUMENTS (see the __init__ note), so existing
+        jitted fns stay valid; the recovery manager's warm pass
+        verifies zero recompiles. The staged slot server held device
+        state tied to the dead runtime: stop and drop it here — it
+        rebuilds lazily on the next staged generate."""
+        staged = self._staged
+        if staged is not None:
+            self._staged = None
+            try:
+                staged.stop()
+            except Exception:
+                log.exception("staged server stop during reload failed")
+        self._param_loader()
+        self._params = {"clip": self.clip_params,
+                        "unet": self.unet_params,
+                        "vae": self.vae_params}
+        if getattr(self, "vae_enc", None) is not None:
+            # lazy img2img encoder state: drop it; _ensure_encoder
+            # re-loads (fingerprint-verified) on the next img2img call
+            self.vae_enc = None
+            self.enc_params = None
+
     # -- stage-disaggregated serving (serving/stages.py) -------------------
 
     def _staged_enabled(self) -> bool:
@@ -641,9 +689,12 @@ class Text2ImagePipeline:
         }
 
     def _decode_stage(self, params, lat):
-        """Decode-stage computation: the VAE + uint8 tail of
-        ``_sample_impl``."""
-        return postprocess_images(self.vae.apply(params["vae"], lat))
+        """Decode-stage computation: exactly the VAE + uint8 tail of
+        ``_sample_impl`` (the staged server's retirement verdict runs
+        as its own dispatch on the latents — folding it in here would
+        change fusion and break bit-parity with the monolith)."""
+        decoded = self.vae.apply(params["vae"], lat)
+        return postprocess_images(decoded)
 
     def _staged_server(self):
         if self._staged is None:
@@ -820,17 +871,25 @@ class Text2ImagePipeline:
                 flops_est=(per_image * len(padded)) if per_image
                 else None,
                 pipeline="t2i"):
+            fault_point("device.lost", peer="t2i")
             images = sample_fn(self._params, ids, uncond, rng)
             # the dispatch lock exists to serialize device work; blocking
             # on the result under it is the point
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
+        out = integrity.poison(np.asarray(images[:n]), peer="t2i")
+        # host-side sentinel on the already-transferred uint8 batch:
+        # NaN/zeroed latents decode to constant frames, which the
+        # degenerate-frame detector catches (the verdict stays OUT of
+        # the sample jit to preserve staged-vs-monolithic bit-parity)
+        integrity.enforce(np.ones(n, dtype=bool), pipeline="t2i",
+                          stage="sample", images=out, n=n)
         metrics.inc("pipeline.images", n)
         if degraded is not None:
             metrics.inc("pipeline.brownout_images", n)
         note_encprop_counters(ep_counts, n)
         note_consistency_counter(scfg, n)
-        return np.asarray(images[:n])
+        return out
 
     # -- img2img ----------------------------------------------------------
     def _ensure_encoder(self) -> None:
@@ -870,6 +929,11 @@ class Text2ImagePipeline:
             self.cfg.sampler.guidance_scale,
         )
         rng_enc, rng_noise = jax.random.split(rng)
+        # vae_enc is pure module structure (its params enter as the
+        # ``params["vae_enc"]`` argument); reload_params nulls it only
+        # so _ensure_encoder re-verifies the checkpoint and rebuilds an
+        # architecturally identical module — the baked trace stays valid
+        # lint: ignore[recompile-hazard] — structural capture, see above
         lat0 = self.vae_enc.apply(params["vae_enc"], images, rng_enc)
         s = self.cfg.sampler
         prepare, sample = make_img2img_sampler(
@@ -931,8 +995,12 @@ class Text2ImagePipeline:
             )
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             out = jax.block_until_ready(out)
+        out = np.asarray(out)
+        # host-side degenerate-frame sentinel (see generate())
+        integrity.enforce(np.ones(out.shape[0], dtype=bool),
+                          pipeline="t2i", stage="img2img", images=out)
         metrics.inc("pipeline.images", len(prompts))
-        return np.asarray(out)
+        return out
 
 
 class PromptGenerator:
@@ -975,48 +1043,63 @@ class PromptGenerator:
                       lambda t: convert_gpt2(t, m.num_layers, m.hidden_size),
                       "gpt2")
         self.mcfg = m
+        self._weights_dir = weights_dir
         self._int8_path = (
             os.path.join(weights_dir, f"{loader[2]}.int8.safetensors")
             if weights_dir else None)
-        ids = jnp.zeros((1, 8), dtype=jnp.int32)
-        self.params = (self._load_int8_checkpoint(loader[2], weights_dir)
-                       if cfg.models.lm_int8 else None)
-        if self.params is not None:
-            # Pre-quantized checkpoint straight from disk. Provenance:
-            # tools/quantize_weights.py falls back to random init when
-            # no fp checkpoint exists, so the int8 file only counts as
-            # real weights if its fp source (file or shards) is present
-            # (the staleness check already ensures int8 is the newer).
-            import glob as _glob
 
-            stem = loader[0].rsplit(".", 1)[0]
-            self.loaded_real_weights = bool(
-                os.path.exists(os.path.join(weights_dir, loader[0]))
-                or _glob.glob(os.path.join(
-                    weights_dir, f"{stem}-*.safetensors")))
-        else:
-            transform = None
-            if cfg.models.lm_int8:
-                # Quantize on HOST, before device placement: peak HBM
-                # stays at the int8 footprint (quantizing after would
-                # briefly hold the fp and int8 trees resident together —
-                # fatal for a 7B-class model on a 16 GB chip).
-                from cassmantle_tpu.ops.quant import quantize_tree_host
-
-                transform = quantize_tree_host
-            loaded = maybe_load(
-                weights_dir, loader[0], loader[1], loader[2],
-                cast_to=cfg.models.param_dtype, transform=transform)
-            # measurement tools (tools/lm_int8_ab.py) refuse to label a
-            # random-init decode a real-weights number
-            self.loaded_real_weights = loaded is not None
+        def load_params() -> None:
+            """Load the LM tree and publish it on ``self``. Boot runs
+            this once; a device-loss rebuild (reload_params) runs it
+            again onto the fresh runtime."""
+            ids = jnp.zeros((1, 8), dtype=jnp.int32)
             self.params = (
-                loaded if loaded is not None
-                else init_params_cached(
-                    self.model, 5, ids,
-                    cache_path=param_cache_path(loader[2], m),
+                self._load_int8_checkpoint(loader[2], weights_dir)
+                if cfg.models.lm_int8 else None)
+            if self.params is not None:
+                # Pre-quantized checkpoint straight from disk.
+                # Provenance: tools/quantize_weights.py falls back to
+                # random init when no fp checkpoint exists, so the int8
+                # file only counts as real weights if its fp source
+                # (file or shards) is present (the staleness check
+                # already ensures int8 is the newer).
+                import glob as _glob
+
+                stem = loader[0].rsplit(".", 1)[0]
+                self.loaded_real_weights = bool(
+                    os.path.exists(os.path.join(weights_dir, loader[0]))
+                    or _glob.glob(os.path.join(
+                        weights_dir, f"{stem}-*.safetensors")))
+            else:
+                transform = None
+                if cfg.models.lm_int8:
+                    # Quantize on HOST, before device placement: peak
+                    # HBM stays at the int8 footprint (quantizing after
+                    # would briefly hold the fp and int8 trees resident
+                    # together — fatal for a 7B-class model on a 16 GB
+                    # chip).
+                    from cassmantle_tpu.ops.quant import (
+                        quantize_tree_host,
+                    )
+
+                    transform = quantize_tree_host
+                loaded = maybe_load(
+                    weights_dir, loader[0], loader[1], loader[2],
                     cast_to=cfg.models.param_dtype, transform=transform)
-            )
+                # measurement tools (tools/lm_int8_ab.py) refuse to
+                # label a random-init decode a real-weights number
+                self.loaded_real_weights = loaded is not None
+                self.params = (
+                    loaded if loaded is not None
+                    else init_params_cached(
+                        self.model, 5, ids,
+                        cache_path=param_cache_path(loader[2], m),
+                        cast_to=cfg.models.param_dtype,
+                        transform=transform)
+                )
+
+        self._param_loader = load_params
+        load_params()
         # params flow through greedy_decode as traced args (no captured
         # constants — see Text2ImagePipeline note)
         from cassmantle_tpu.ops.decode import make_apply_fns
@@ -1046,6 +1129,10 @@ class PromptGenerator:
         # previous successful dispatch's figure
         self._flops_per_token: Optional[float] = None
         self._decode_flops_tls = threading.local()
+        # per-thread invalid-row indices from the LAST decode_ids_batch
+        # on this thread (same ownership rationale as the flops TLS):
+        # generate_batch reads it to fail exactly the poisoned rows
+        self._decode_invalid_tls = threading.local()
 
     def _token_flops(self) -> float:
         """Analytic FLOPs per token processed (prefill or decode)."""
@@ -1069,6 +1156,9 @@ class PromptGenerator:
         spec = cfg.spec_decode
         self._spec_draft = None
         self._spec_draft_params = None
+        # re-runnable loader for a SEPARATE draft tree (reload_params);
+        # the self-draft arm shares self.params and needs no loader
+        self._spec_params_loader = None
         self.last_spec_stats = None
         if spec.mode == "off":
             return
@@ -1094,18 +1184,37 @@ class PromptGenerator:
         from cassmantle_tpu.ops.decode import make_apply_fns
 
         draft_model = GPT2LM(d)
-        loaded = maybe_load(
-            weights_dir, "gpt2_draft.safetensors",
-            lambda t: convert_gpt2(t, d.num_layers, d.hidden_size),
-            "gpt2_draft", cast_to=cfg.models.param_dtype)
-        self._spec_draft_params = (
-            loaded if loaded is not None
-            else init_params_cached(
-                draft_model, 6, jnp.zeros((1, 8), dtype=jnp.int32),
-                cache_path=param_cache_path("gpt2_draft", d),
-                cast_to=cfg.models.param_dtype))
+
+        def load_draft_params() -> None:
+            loaded = maybe_load(
+                weights_dir, "gpt2_draft.safetensors",
+                lambda t: convert_gpt2(t, d.num_layers, d.hidden_size),
+                "gpt2_draft", cast_to=cfg.models.param_dtype)
+            self._spec_draft_params = (
+                loaded if loaded is not None
+                else init_params_cached(
+                    draft_model, 6, jnp.zeros((1, 8), dtype=jnp.int32),
+                    cache_path=param_cache_path("gpt2_draft", d),
+                    cast_to=cfg.models.param_dtype))
+
+        self._spec_params_loader = load_draft_params
+        load_draft_params()
         d_prefill, d_step, _ = make_apply_fns(draft_model)
         self._spec_draft = ModelDraft(d_prefill, d_step)
+
+    def reload_params(self) -> None:
+        """Device-loss rebuild (serving/device_recovery.py): re-run the
+        boot load path (fingerprint-verified reads, int8 transform) and
+        republish the tree. The draft source object keeps its identity
+        (it keys the jit cache — replacing it would recompile the spec
+        graphs); only its PARAMS refresh: the self-draft arm re-shares
+        the target tree, a separate draft tree re-loads."""
+        shared_draft = self._spec_draft_params is self.params
+        self._param_loader()
+        if shared_draft:
+            self._spec_draft_params = self.params
+        elif self._spec_params_loader is not None:
+            self._spec_params_loader()
 
     def _spec_enabled(self, bucket: int, max_new: int) -> bool:
         """Host-side, per bucket group: the spec path engages only for
@@ -1225,8 +1334,11 @@ class PromptGenerator:
         spec_stats = []
         dispatch_flops = 0.0
         self._decode_flops_tls.value = 0.0  # failed decodes attr nothing
+        self._decode_invalid_tls.value = ()
+        bad_members: set = set()
         for bucket, idxs in groups.items():
             n = len(idxs)
+            fault_point("device.lost", peer="prompt")
             n_pad = next((b for b in self.BATCH_BUCKETS if n <= b), n)
             # roofline attribution: the dispatched shapes are fixed —
             # n_pad rows prefill `bucket` tokens then run max_new decode
@@ -1296,12 +1408,25 @@ class PromptGenerator:
             # one sync per DISPATCHED bucket group (not per row): each
             # group is a separate device computation whose result must
             # land before its rows scatter into the output
-            # lint: ignore[host-sync] — per-dispatch sync, not per-item
-            out_tokens[idxs] = np.asarray(tokens[:n])
+            toks_host = integrity.poison(
+                # lint: ignore[host-sync] — per-dispatch sync, not per-item
+                np.asarray(tokens[:n]), peer="prompt")
+            if not integrity.integrity_disabled():
+                # token-range validity on the just-transferred array —
+                # no extra sync. Tokens are ints, so finiteness can't
+                # carry the verdict here; range IS the sentinel: a dead
+                # runtime hands back garbage buffers, and the chaos
+                # poison fills -1 — both land outside [0, vocab).
+                ok = ((toks_host >= 0)
+                      & (toks_host < m.vocab_size)).all(axis=1)
+                bad_members.update(
+                    idxs[row] for row in np.nonzero(~ok)[0])
+            out_tokens[idxs] = toks_host
             # lint: ignore[host-sync] — per-dispatch sync, not per-item
             out_len[idxs] = np.asarray(gen_len[:n])
         self._record_spec_stats(spec_stats)
         self._decode_flops_tls.value = dispatch_flops
+        self._decode_invalid_tls.value = tuple(sorted(bad_members))
         return jnp.asarray(out_tokens), jnp.asarray(out_len)
 
     def _record_spec_stats(self, spec_stats) -> None:
@@ -1333,10 +1458,16 @@ class PromptGenerator:
         return self.decode_ids_batch([seed_text], max_new_tokens, seed)
 
     def generate_batch(self, seed_texts: Sequence[str],
-                       max_new_tokens: Optional[int] = None) -> List[str]:
+                       max_new_tokens: Optional[int] = None) -> List:
         """Batched greedy continuation: one device dispatch for N texts,
         each trimmed to its first two sentences (reference
-        backend.py:253-265)."""
+        backend.py:253-265).
+
+        Rows the integrity sentinel rejected come back as
+        :class:`~cassmantle_tpu.serving.integrity.OutputInvalid`
+        INSTANCES in their slots (not raised): the prompt queue's
+        per-member distribution fails exactly those requests while the
+        healthy rows of the same dispatch still serve."""
         # flops_est is a callable: the bucket grouping (and so the
         # dispatched token count) is only known after decode_ids_batch
         # runs; block_timer evaluates it at exit, on THIS thread (the
@@ -1354,8 +1485,18 @@ class PromptGenerator:
         # hazard, tools/check_concurrency.py)
         out_tokens = np.asarray(out_tokens)
         lengths = np.asarray(gen_len).tolist()
+        bad = frozenset(
+            getattr(self._decode_invalid_tls, "value", ()) or ())
+        if bad:
+            integrity.note_invalid("prompt", "decode", sorted(bad))
         texts = []
         for i in range(len(seed_texts)):
+            if i in bad:
+                # never decode a rejected row — garbage/poisoned ids
+                # must not reach the tokenizer, let alone a player
+                texts.append(integrity.OutputInvalid(
+                    "prompt", "decode", [i]))
+                continue
             texts.append(two_sentences(
                 self.tokenizer.decode(out_tokens[i, : lengths[i]].tolist())))
         return texts
@@ -1364,8 +1505,13 @@ class PromptGenerator:
                  ) -> str:
         """Greedy continuation of ``seed_text`` (the reference decodes
         32-96 tokens then keeps the first two sentences,
-        backend.py:253-265)."""
-        return self.generate_batch([seed_text], max_new_tokens)[0]
+        backend.py:253-265). Raises
+        :class:`~cassmantle_tpu.serving.integrity.OutputInvalid` when
+        the integrity sentinel rejects the row (retriable)."""
+        out = self.generate_batch([seed_text], max_new_tokens)[0]
+        if isinstance(out, Exception):
+            raise out
+        return out
 
 
 def sanitize_text(text: str) -> str:

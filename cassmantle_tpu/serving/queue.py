@@ -229,6 +229,8 @@ class BatchingQueue(Generic[T, R]):
         dispatcher: Optional[_DispatchWorker] = None,
         admission=None,
         background_every: int = 8,
+        on_dispatch_error: Optional[Callable[[BaseException], None]]
+        = None,
     ) -> None:
         # ``dispatcher``: a dedicated _DispatchWorker for this queue.
         # Default is the process-global worker (device work serializes
@@ -252,6 +254,9 @@ class BatchingQueue(Generic[T, R]):
         # adaptive admission (serving/overload.py AdaptiveLimiter):
         # None keeps the legacy static max_pending bound exactly
         self.admission = admission
+        # called with the exception when a dispatched batch fails —
+        # the device-loss classification seam (device_recovery.py)
+        self.on_dispatch_error = on_dispatch_error
         # starvation bound: after this many consecutive batches
         # dispatched while background work sat pending, the oldest
         # background item heads the next batch
@@ -329,6 +334,14 @@ class BatchingQueue(Generic[T, R]):
         depth = self.depth()
         deadline_s = (deadline_s if deadline_s is not None
                       else self.default_deadline_s)
+        if self.supervisor is not None:
+            lost = getattr(self.supervisor, "device_lost", None)
+            if lost is not None:
+                # the accelerator runtime is GONE: queuing work behind
+                # it only manufactures deadline misses — fail fast with
+                # a retriable error while the rebuild runs
+                metrics.inc(f"{self.name}.rejected_device_lost")
+                raise QueueFull(f"{self.name} (device_lost: {lost})")
         if self.supervisor is not None and self.supervisor.degraded and \
                 depth >= self.degraded_max_pending:
             # degraded: admit only a short queue — deep backlogs behind a
@@ -540,7 +553,13 @@ class BatchingQueue(Generic[T, R]):
                     )
                 for fut, res in zip(futures, results):
                     if not fut.done():
-                        fut.set_result(res)
+                        if isinstance(res, Exception):
+                            # per-member failure (integrity sentinels:
+                            # one poisoned batch row fails one request,
+                            # not the batch)
+                            fut.set_exception(res)
+                        else:
+                            fut.set_result(res)
             except asyncio.CancelledError:
                 # queue stopping mid-batch: the in-flight futures must
                 # fail, not dangle (their handler result is dropped)
@@ -576,6 +595,15 @@ class BatchingQueue(Generic[T, R]):
                 status = "error"
                 log.exception("%s batch failed", self.name)
                 metrics.inc(f"{self.name}.failures")
+                if self.on_dispatch_error is not None:
+                    # device-loss classification seam (serving/
+                    # device_recovery.py); advisory — a hook failure
+                    # must not change the per-item failure contract
+                    try:
+                        self.on_dispatch_error(exc)
+                    except Exception:
+                        log.exception("%s on_dispatch_error hook "
+                                      "failed", self.name)
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(exc)

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from cassmantle_tpu.chaos import fault_point
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.models.clip_text import ClipTextEncoder
 from cassmantle_tpu.models.layers import timestep_embedding
@@ -47,6 +48,7 @@ from cassmantle_tpu.models.weights import (
 )
 from cassmantle_tpu.ops.ddim import initial_latents
 from cassmantle_tpu.ops.samplers import make_sampler
+from cassmantle_tpu.serving import integrity
 from cassmantle_tpu.utils.compile_cache import (
     enable_compile_cache,
     param_cache_path,
@@ -108,22 +110,40 @@ class SDXLPipeline:
             "addition_embed_dim must exceed the bigG pooled width"
         )
 
-        if share_params_with is not None:
-            from cassmantle_tpu.serving.pipeline import share_compatible
+        lat_hw = cfg.sampler.image_size // self.vae_scale
+        lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
+        t0 = jnp.zeros((1,), dtype=jnp.int32)
+        ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
+                        dtype=jnp.float32)
+        add = jnp.zeros((1, m.unet.addition_embed_dim), dtype=jnp.float32)
+        from cassmantle_tpu.serving.pipeline import int8_unet_tools
 
-            donor = share_params_with
-            dm = donor.cfg.models
-            assert share_compatible(dm, m) \
-                and dm.clip_text_2 == m.clip_text_2 \
-                and dm.unet_int8 == m.unet_int8, (
-                    "share_params_with needs matching SDXL architectures"
+        unet_transform, wrap_unet_apply = int8_unet_tools(m)
+
+        def load_all_params() -> None:
+            """Load/convert/share every stage tree and publish it on
+            ``self``. Boot runs this once; a device-loss rebuild
+            (serving/device_recovery.py, via :meth:`reload_params`)
+            runs it again onto the fresh runtime."""
+            if share_params_with is not None:
+                from cassmantle_tpu.serving.pipeline import (
+                    share_compatible,
                 )
-            self.clip_params = donor.clip_params
-            self.clip2_params = donor.clip2_params
-            self.clip2_proj = donor.clip2_proj
-            self.unet_params = donor.unet_params
-            self.vae_params = donor.vae_params
-        else:
+
+                donor = share_params_with
+                dm = donor.cfg.models
+                assert share_compatible(dm, m) \
+                    and dm.clip_text_2 == m.clip_text_2 \
+                    and dm.unet_int8 == m.unet_int8, (
+                        "share_params_with needs matching SDXL "
+                        "architectures"
+                    )
+                self.clip_params = donor.clip_params
+                self.clip2_params = donor.clip2_params
+                self.clip2_proj = donor.clip2_proj
+                self.unet_params = donor.unet_params
+                self.vae_params = donor.vae_params
+                return
             ids = jnp.zeros((1, self.pad_len), dtype=jnp.int32)
             self.clip_params = (
                 maybe_load(weights_dir, "clip_text.safetensors",
@@ -132,7 +152,8 @@ class SDXLPipeline:
                            "clip_text", cast_to=m.param_dtype)
                 or init_params_cached(
                     self.clip, 1, ids,
-                    cache_path=param_cache_path("clip_text", m.clip_text),
+                    cache_path=param_cache_path("clip_text",
+                                                m.clip_text),
                     cast_to=m.param_dtype)
             )
             # read once: the same file carries the tower AND its
@@ -140,7 +161,8 @@ class SDXLPipeline:
             t2 = load_checkpoint_tensors(
                 weights_dir, "clip_text_2.safetensors", "clip_text_2")
             converted2 = convert_tensors(
-                t2, lambda t: convert_clip_text(t, m.clip_text_2.num_layers),
+                t2, lambda t: convert_clip_text(
+                    t, m.clip_text_2.num_layers),
                 "clip_text_2", cast_to=m.param_dtype)
             self.clip2_params = (
                 converted2
@@ -152,36 +174,29 @@ class SDXLPipeline:
                     cast_to=m.param_dtype)
             )
             # Real SDXL conditions on text_projection(pooled) — the
-            # CLIPTextModelWithProjection text_embeds — not the raw pooled
-            # state; skipping the (square, 1280x1280) projection would
-            # silently divert from the published model the moment real
-            # weights load. Random init keeps the identity behavior.
+            # CLIPTextModelWithProjection text_embeds — not the raw
+            # pooled state; skipping the (square, 1280x1280) projection
+            # would silently divert from the published model the moment
+            # real weights load. Random init keeps identity behavior.
             self.clip2_proj = None
             if converted2 is not None and t2 is not None \
                     and "text_projection.weight" in t2:
                 self.clip2_proj = jnp.asarray(
                     convert_clip_text_projection(t2),
                     dtype=jnp.dtype(m.param_dtype))
-        lat_hw = cfg.sampler.image_size // self.vae_scale
-        lat = jnp.zeros((1, lat_hw, lat_hw, 4), dtype=jnp.float32)
-        t0 = jnp.zeros((1,), dtype=jnp.int32)
-        ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
-                        dtype=jnp.float32)
-        add = jnp.zeros((1, m.unet.addition_embed_dim), dtype=jnp.float32)
-        from cassmantle_tpu.serving.pipeline import int8_unet_tools
-
-        unet_transform, wrap_unet_apply = int8_unet_tools(m)
-        if share_params_with is None:
             # cache key on arch(): the fused-conv execution flags
-            # (UNetConfig.fused_conv / conv_pad_to) don't change the tree,
-            # so A/B arms share one cached init (see serving/pipeline.py)
+            # (UNetConfig.fused_conv / conv_pad_to) don't change the
+            # tree, so A/B arms share one cached init (see
+            # serving/pipeline.py)
             self.unet_params = (
                 maybe_load(weights_dir, "unet_xl.safetensors",
                            lambda t: convert_unet(t, m.unet), "unet_xl",
-                           cast_to=m.param_dtype, transform=unet_transform)
+                           cast_to=m.param_dtype,
+                           transform=unet_transform)
                 or init_params_cached(
                     self.unet, 2, lat, t0, ctx, add,
-                    cache_path=param_cache_path("unet_xl", m.unet.arch()),
+                    cache_path=param_cache_path("unet_xl",
+                                                m.unet.arch()),
                     cast_to=m.param_dtype, transform=unet_transform)
             )
             self.vae_params = (
@@ -191,8 +206,12 @@ class SDXLPipeline:
                 or init_params_cached(
                     self.vae, 3, lat,
                     cache_path=param_cache_path(
-                        f"vae_xl{cfg.sampler.image_size}", m.vae.arch()))
+                        f"vae_xl{cfg.sampler.image_size}",
+                        m.vae.arch()))
             )
+
+        self._param_loader = load_all_params
+        load_all_params()
         from cassmantle_tpu.serving.pipeline import (
             deepcache_schedule,
             encprop_plan,
@@ -262,6 +281,26 @@ class SDXLPipeline:
         self._flops_cache: dict = {}
         self._flops_lock = threading.Lock()
         self._flops_pending: set = set()
+
+    def reload_params(self) -> None:
+        """Device-loss rebuild (serving/device_recovery.py): re-run the
+        boot load path and republish the tree (see
+        Text2ImagePipeline.reload_params — same contract: params are
+        jit ARGUMENTS, so nothing recompiles; the staged slot server is
+        dropped and rebuilds lazily)."""
+        staged = self._staged
+        if staged is not None:
+            self._staged = None
+            try:
+                staged.stop()
+            except Exception:
+                log.exception("staged server stop during reload failed")
+        self._param_loader()
+        self._params = {
+            "clip": self.clip_params, "clip2": self.clip2_params,
+            "clip2_proj": self.clip2_proj,
+            "unet": self.unet_params, "vae": self.vae_params,
+        }
 
     # -- conditioning ------------------------------------------------------
 
@@ -351,7 +390,8 @@ class SDXLPipeline:
         }
 
     def _decode_stage(self, params, lat):
-        return postprocess_images(self.vae.apply(params["vae"], lat))
+        decoded = self.vae.apply(params["vae"], lat)
+        return postprocess_images(decoded)
 
     def _staged_server(self):
         if self._staged is None:
@@ -471,9 +511,16 @@ class SDXLPipeline:
                 flops_est=(per_image * len(padded)) if per_image
                 else None,
                 pipeline="sdxl"):
+            fault_point("device.lost", peer="sdxl")
             images = sample_fn(self._params, ids, uncond, rng)
             # lint: ignore[lock-blocking-call] — intentional sync under dispatch lock
             images = jax.block_until_ready(images)
+        out = integrity.poison(np.asarray(images[:n]), peer="sdxl")
+        # host-side degenerate-frame sentinel on the transferred uint8
+        # batch (the verdict stays OUT of the sample jit to preserve
+        # staged-vs-monolithic bit-parity — see Text2ImagePipeline)
+        integrity.enforce(np.ones(n, dtype=bool), pipeline="sdxl",
+                          stage="sample", images=out, n=n)
         metrics.inc("pipeline.sdxl_images", n)
         if degraded is not None:
             metrics.inc("pipeline.brownout_images", n)
@@ -481,4 +528,4 @@ class SDXLPipeline:
 
         note_encprop_counters(ep_counts, n)
         note_consistency_counter(scfg, n)
-        return np.asarray(images[:n])
+        return out

@@ -15,6 +15,9 @@ import numpy as np
 from cassmantle_tpu.config import FrameworkConfig
 from cassmantle_tpu.ops.blur import device_blur
 from cassmantle_tpu.ops.scorer import EmbeddingScorer
+from cassmantle_tpu.serving import integrity
+from cassmantle_tpu.serving.device_recovery import DeviceRecoveryManager
+from cassmantle_tpu.serving.integrity import OutputInvalid
 from cassmantle_tpu.serving.overload import (
     PRIORITY_BACKGROUND,
     make_admission,
@@ -75,6 +78,26 @@ class InferenceService:
         t2i = getattr(self.backend, "t2i", None)
         if t2i is not None and hasattr(t2i, "supervisor"):
             t2i.supervisor = self.supervisor
+        # device-loss recovery (serving/device_recovery.py, ISSUE 17):
+        # dispatch exceptions from either queue (and the image path in
+        # generate_content) are classified where they surface; a
+        # classified loss flips the supervisor to ``device_lost`` and
+        # kicks off the single-flight rebuild below
+        self._warm_count = 0
+        self.recovery = DeviceRecoveryManager(
+            supervisor=self.supervisor,
+            rebuild=self.rebuild_device_state,
+            warm=self._warm_after_recovery,
+        )
+        # published on the supervisor so the server layer (which wires
+        # DeviceHealth after this constructor) can connect probe raises
+        # to the same classifier
+        self.supervisor.recovery = self.recovery
+        dh = getattr(self.supervisor, "device_health", None)
+        if dh is not None and hasattr(dh, "on_probe_error"):
+            # a dispatch-quiet worker still detects runtime loss: probe
+            # raises ride the same classifier as dispatch exceptions
+            dh.on_probe_error = self.recovery.note_probe_exception
         self.score_queue: BatchingQueue = BatchingQueue(
             handler=self._score_batch,
             max_batch=max(cfg.serving.score_batch_sizes),
@@ -87,6 +110,7 @@ class InferenceService:
             degraded_max_pending=cfg.serving.degraded_max_pending,
             admission=make_admission("score", cfg),
             background_every=cfg.serving.background_every_batches,
+            on_dispatch_error=self.recovery.note_dispatch_exception,
         )
         # Concurrent round generations (double-buffering overlapping a
         # live promotion, or several Game instances sharing one service)
@@ -107,13 +131,30 @@ class InferenceService:
             degraded_max_pending=cfg.serving.degraded_max_pending,
             admission=make_admission("prompt", cfg),
             background_every=cfg.serving.background_every_batches,
+            on_dispatch_error=self.recovery.note_dispatch_exception,
         )
 
     # handlers run on the dispatch thread
-    def _score_batch(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
-        return self.scorer.similarity(list(pairs))
+    def _score_batch(self, pairs: Sequence[Tuple[str, str]]):
+        """Batch handler with per-pair integrity (ISSUE 17): the scorer
+        marks rows whose device encode came back non-finite as NaN
+        similarities (never cached); those pairs fail individually with
+        a retriable OutputInvalid via the queue's per-member exception
+        distribution, while valid neighbors in the same batch still
+        resolve. Counting happened at the scorer (pipeline=scorer)."""
+        sims = self.scorer.similarity(list(pairs))
+        if integrity.integrity_disabled():
+            return sims
+        bad = ~np.isfinite(np.asarray(sims))
+        if not bad.any():
+            return sims
+        return [OutputInvalid("scorer", "similarity", [i]) if bad[i]
+                else sims[i] for i in range(len(sims))]
 
     def _prompt_batch(self, seeds: Sequence[str]):
+        # rows the integrity sentinel rejected come back as
+        # OutputInvalid instances; the queue's per-member distribution
+        # fails those futures while healthy rows still serve
         return self.backend.prompt_gen.generate_batch(list(seeds))
 
     # -- engine injection points -----------------------------------------
@@ -190,8 +231,21 @@ class InferenceService:
             log.warning("score dispatch failed (%s); floor scores for %d "
                         "pairs", type(exc).__name__, len(pairs))
             return np.zeros((len(pairs),), dtype=np.float32)
-        except Exception:
+        except OutputInvalid as exc:
+            # the device produced garbage for at least one pair
+            # (integrity verdict, serving/integrity.py): degrade the
+            # request to floor scores — an invalid score must never
+            # reach a player as a real one — and count toward the
+            # breaker (repeated invalid output = sick scorer)
             breaker.record_failure()
+            log.warning("invalid scorer output (%s); floor scores for "
+                        "%d pairs", exc, len(pairs))
+            return np.zeros((len(pairs),), dtype=np.float32)
+        except Exception as exc:
+            breaker.record_failure()
+            # a dead runtime surfaces here too (gather re-raises the
+            # dispatch exception): classify before propagating
+            self.recovery.note_dispatch_exception(exc)
             raise
         breaker.record_success()
         return np.asarray(results, dtype=np.float32)
@@ -216,17 +270,27 @@ class InferenceService:
                 # queue path itself also keeps progressing)
                 text = await self.prompt_queue.submit(
                     seed, priority=PRIORITY_BACKGROUND)
-            except (QueueFull, DeadlineExceeded, DispatchTimeout) as exc:
+            except (QueueFull, DeadlineExceeded, DispatchTimeout,
+                    OutputInvalid) as exc:
                 # any queue-path failure (backpressure, missed deadline,
-                # wedged dispatch) degrades to the in-backend decode —
-                # the fallback exists precisely for a sick queue path
+                # wedged dispatch, invalid decode output) degrades to
+                # the in-backend decode — the fallback exists precisely
+                # for a sick queue path, and OutputInvalid is retriable
+                # by design (a fresh dispatch usually succeeds)
                 log.warning(
                     "prompt queue failed (%s); decoding %r in-backend",
                     type(exc).__name__, seed[:40])
-        if text is not None:
-            return await self.backend.generate(seed, is_seed, text=text)
-        # injected custom backends may not take a ``text`` kwarg
-        return await self.backend.generate(seed, is_seed)
+        try:
+            if text is not None:
+                return await self.backend.generate(seed, is_seed,
+                                                   text=text)
+            # injected custom backends may not take a ``text`` kwarg
+            return await self.backend.generate(seed, is_seed)
+        except Exception as exc:
+            # the image pipeline dispatches outside the queues, so its
+            # exceptions classify here; rounds.py owns the retry ladder
+            self.recovery.note_dispatch_exception(exc)
+            raise
 
     @property
     def content_backend(self):
@@ -236,6 +300,40 @@ class InferenceService:
         handing ``service.backend`` to the Game instead would silently
         bypass the batching."""
         return _QueuedContentBackend(self)
+
+    # -- device-loss rebuild (serving/device_recovery.py) ------------------
+    def rebuild_device_state(self) -> None:
+        """ONE rebuild attempt, run on the recovery manager's thread:
+        re-upload every pipeline's checkpoints through the
+        fingerprint-verified load path (utils/checkpoint.py) and drop
+        state that referenced the dead runtime (the staged slot server
+        restarts lazily on the next generate). Raises on failure — the
+        manager owns retries, backoff, and the retry budget."""
+        for name in ("t2i", "sdxl", "prompt_gen"):
+            pipe = getattr(self.backend, name, None)
+            if pipe is not None and hasattr(pipe, "reload_params"):
+                pipe.reload_params()
+        if hasattr(self.scorer, "reload_params"):
+            self.scorer.reload_params()
+        dh = getattr(self.supervisor, "device_health", None)
+        if dh is not None and hasattr(dh, "invalidate"):
+            # the rebuilt runtime must be re-probed, not vouched for by
+            # the dead one's cached verdict
+            dh.invalidate()
+
+    def _warm_after_recovery(self) -> None:
+        """Post-rebuild warm: drive one real dispatch through the
+        scorer inside a ``no_new_compiles`` window. Params re-enter the
+        jits as ARGUMENTS (serving/pipeline.py __init__ note), so a
+        rebuild must not recompile anything — if it does, the bucket
+        key regressed and recovery fails loudly here instead of
+        recompiling under live traffic. A fresh word each time keeps
+        the scorer's host LRU from short-circuiting the device dial."""
+        from cassmantle_tpu.utils import jit_sentinel
+
+        self._warm_count += 1
+        with jit_sentinel.no_new_compiles():
+            self.scorer.embed([f"recovery warm {self._warm_count}"])
 
     async def stop(self) -> None:
         await self.score_queue.stop()

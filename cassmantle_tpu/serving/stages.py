@@ -62,6 +62,8 @@ from cassmantle_tpu.obs.recorder import flight_recorder
 from cassmantle_tpu.obs.trace import current_ctx, tracer
 from cassmantle_tpu.ops.ddim import initial_latents, make_slot_denoiser
 from cassmantle_tpu.ops.samplers import make_slot_sampler
+from cassmantle_tpu.serving import integrity
+from cassmantle_tpu.serving.integrity import OutputInvalid, finite_verdict
 from cassmantle_tpu.serving.queue import (
     BatchingQueue,
     DeadlineExceeded,
@@ -203,6 +205,8 @@ class StagedImageServer:
         self._step = jax.jit(self._step_impl)
         self._admit = jax.jit(self._admit_impl)
         self._take = jax.jit(self._take_impl)
+        self._fin_check = jax.jit(finite_verdict)
+        self._fin_check_dec = jax.jit(finite_verdict)
         self._init = jax.jit(self._init_impl, static_argnums=0)
         # scheduler lifecycle only — never held across a device dispatch
         # or a cross-stage handoff (docs/STATIC_ANALYSIS.md rank 14)
@@ -226,6 +230,14 @@ class StagedImageServer:
         self._lat = None
         self._aux = None
         self._cond: Optional[Dict[str, jax.Array]] = None
+        # per-slot finiteness verdict (integrity rung 2): a SEPARATE
+        # tiny jitted reduction over the slot tensor, dispatched after
+        # each step and read back lazily. Kept OUT of the step jit on
+        # purpose — an extra consumer inside that graph changes XLA
+        # fusion decisions and breaks the staged-vs-monolithic
+        # bit-parity bar (tests/test_stages.py).
+        self._finite = None
+        self._fin_probes: deque = deque()
         self._steps = np.zeros((self.capacity,), dtype=np.int32)
         self._alive = np.zeros((self.capacity,), dtype=bool)
         self._slots: List[Optional[_Unit]] = [None] * self.capacity
@@ -235,7 +247,8 @@ class StagedImageServer:
         # single-writer (denoise thread) counters; the bench derives
         # mean slot occupancy as slot_steps / (steps * capacity)
         self.stats = {"steps": 0, "slot_steps": 0, "admissions": 0,
-                      "retirements": 0, "preemptions": 0}
+                      "retirements": 0, "preemptions": 0,
+                      "quarantines": 0}
         self._on_step = None  # test seam: called once per loop iteration
         # roofline attribution: per-image denoise FLOPs, traced on a
         # background thread kicked off at the first retirement (needs
@@ -284,7 +297,8 @@ class StagedImageServer:
     def _admit_impl(lat, aux, cond, slot, lat_row, aux_row, cond_rows):
         """Write one request's rows into slot ``slot``. ``slot`` is a
         TRACED scalar, so admission into any slot reuses one compiled
-        graph — no recompiles at admission/retirement."""
+        graph — no recompiles at admission/retirement. The quarantine
+        scrub reuses this same graph with zero rows."""
 
         def put(dst, row):
             return jax.lax.dynamic_update_slice(
@@ -502,11 +516,26 @@ class StagedImageServer:
         if bucket > n:
             rows = list(rows) + [jnp.zeros_like(rows[0])] * (bucket - n)
         lat = jnp.concatenate(rows, axis=0)
+        # retirement verdict on the LATENTS, a separate tiny dispatch
+        # before decode (a verdict output folded into the decode jit
+        # would change fusion and break the staged-vs-monolithic
+        # bit-parity bar); its own jit instance so this thread never
+        # shares an executable with the denoise thread's slot check
+        verdict = self._fin_check_dec(lat)
         images = self._decode(self._params, lat)
         # the ONE device->host transfer of the whole stage graph:
-        # collect-once per decoded batch
+        # collect-once per decoded batch (the verdict vector is tiny
+        # and already in flight)
         images = np.asarray(images)
-        return [images[i:i + 1] for i in range(n)]
+        bad = set(integrity.invalid_members(
+            np.asarray(verdict), images=images, n=n).tolist())
+        if bad:
+            # per-member failure: one poisoned row (e.g. a quarantine
+            # race that retired before its verdict landed) fails ITS
+            # request; neighbors in this decode batch still get images
+            integrity.note_invalid("staged", "decode", sorted(bad))
+        return [OutputInvalid("staged", "decode", [i]) if i in bad
+                else images[i:i + 1] for i in range(n)]
 
     # -- denoise stage (its own thread) ------------------------------------
 
@@ -545,7 +574,8 @@ class StagedImageServer:
                 self._free_slot(slot)
         while self._pend:
             self._fail_unit(self._pend.popleft(), exc)
-        self._lat = self._aux = self._cond = None
+        self._lat = self._aux = self._cond = self._finite = None
+        self._fin_probes.clear()
         self._probe = None
 
     def _denoise_tick(self) -> None:
@@ -583,7 +613,17 @@ class StagedImageServer:
         self._lat, self._aux = self._step(
             self._params, self._lat, self._aux, self._cond, idx,
             jnp.asarray(slots))
+        # per-slot finiteness verdict as a SEPARATE tiny dispatch on
+        # the step's output (a consumer inside the step jit would
+        # change fusion and break the bit-parity bar); stale rows in
+        # freed slots may read non-finite, but the probe only judges
+        # units that still own their slot
+        self._finite = self._fin_check(self._lat)
+        # snapshot (verdict array, slot→unit) for the lazy quarantine
+        # probe: units are judged only while they still own their slot
+        self._fin_probes.append((self._finite, tuple(self._slots)))
         self._note_step()
+        self._check_quarantine()
         self._retire_finished()
         self._watchdog_check()
 
@@ -607,9 +647,13 @@ class StagedImageServer:
                 continue
             slot = self._free.pop()
             self._ensure_state(u)
+            # device.poison drill lever: corrupts THIS request's latent
+            # row at admission — detection must come from the per-step
+            # verdict + quarantine path, never from the injection site
+            lat_row = integrity.poison(u.lat, peer="stage")
             self._lat, self._aux, self._cond = self._admit(
-                self._lat, self._aux, self._cond, jnp.int32(slot),
-                u.lat, u.aux, u.cond)
+                self._lat, self._aux, self._cond,
+                jnp.int32(slot), lat_row, u.aux, u.cond)
             # the slot tensor now owns copies; dropping the unit's row
             # references releases the views that would otherwise pin
             # the whole encode batch (and the request's init draw) in
@@ -671,6 +715,71 @@ class StagedImageServer:
         metrics.inc("stage.denoise.steps")
         metrics.gauge("stage.denoise.slot_occupancy",
                       self._active_n / self.capacity)
+
+    # -- slot quarantine (integrity rung 2) --------------------------------
+
+    def _check_quarantine(self) -> None:
+        """Quarantine slots whose latents went non-finite mid-flight,
+        detected from the per-step verdict dispatch with NO
+        blocking sync: only READY verdict arrays are read (the same
+        non-blocking ``is_ready`` discipline as the wedge watchdog), so
+        detection lags dispatch by however long the device pipeline
+        runs deep — bounded, because a poisoned slot's verdict stays
+        False every subsequent step (NaN propagates) until scrubbed.
+        A poisoned row that retires before its verdict lands is caught
+        by the retirement verdict instead (never reaches a player).
+        Under ``CASSMANTLE_NO_INTEGRITY_CHECKS`` (read per tick) ready
+        probes drain unjudged — no quarantines, matching the global
+        kill-switch contract.
+        """
+        probes = self._fin_probes
+        disabled = integrity.integrity_disabled()
+        while probes and self._array_ready(probes[0][0]):
+            fin, units = probes.popleft()
+            # ready ⇒ copy-out, not a device wait
+            # lint: ignore[host-sync] — is_ready-gated read of a (capacity,) bool vector
+            verdict = np.asarray(fin)
+            for slot, u in enumerate(units):
+                if disabled or u is None or verdict[slot]:
+                    continue
+                if self._slots[slot] is not u:
+                    # already retired/preempted; admission re-writes
+                    # the rows, so stale state cannot leak forward
+                    continue
+                self._quarantine(slot, u)
+        # drop stale unread probes: detection does not depend on any
+        # single probe (the per-slot verdict is persistent), and an
+        # unready backlog must not grow without bound
+        while len(probes) > 32:
+            probes.popleft()
+
+    def _quarantine(self, slot: int, u: _Unit) -> None:
+        """Retire a poisoned slot with OutputInvalid and scrub its
+        rows (zero-fill through the same compiled admission graph)
+        before the slot can be reused; repeated quarantines trip the
+        content breaker via the supervisor, so a sick device reads as
+        sick, not as a run of unlucky requests."""
+        steps_done = int(self._steps[slot])
+        self.stats["quarantines"] += 1
+        metrics.inc("stage.denoise.quarantines")
+        integrity.note_invalid("staged", "denoise", [slot])
+        flight_recorder.record(
+            "stage.quarantine", stage="denoise", slot=slot,
+            step=self.stats["steps"], steps_done=steps_done)
+        log.error("stage.denoise slot %d latents non-finite after %d "
+                  "steps: quarantined", slot, steps_done)
+        zero_lat = jnp.zeros((1,) + self._lat.shape[1:], self._lat.dtype)
+        zero_aux = jnp.zeros((1,) + self._aux.shape[1:], self._aux.dtype)
+        zero_cond = {k: jnp.zeros((1,) + v.shape[1:], v.dtype)
+                     for k, v in self._cond.items()}
+        self._lat, self._aux, self._cond = self._admit(
+            self._lat, self._aux, self._cond,
+            jnp.int32(slot), zero_lat, zero_aux, zero_cond)
+        self._fail_unit(u, OutputInvalid("staged", "denoise", [slot]))
+        self._free_slot(slot)
+        sup = self._supervisor
+        if sup is not None:
+            sup.content_breaker.record_failure()
 
     def _denoise_flops_per_image(self):
         """Analytic FLOPs of one request's full denoise residency (CFG

@@ -62,6 +62,14 @@ class ServingSupervisor:
         self._lock = OrderedLock("supervisor", rank=30)
         self._degraded_until = 0.0
         self._overruns = 0
+        # the DeviceRecoveryManager when an InferenceService owns this
+        # supervisor (serving/service.py publishes it): the server
+        # layer wires DeviceHealth probe raises into its classifier
+        self.recovery = None
+        # device-loss state (serving/device_recovery.py): reason string
+        # while the accelerator runtime is gone and the recovery manager
+        # is rebuilding serving state; None when healthy
+        self._device_lost: Optional[str] = None
         # per-stage dispatch health (stage-disaggregated serving,
         # serving/stages.py): last time each stage made observable
         # progress (a batch completed / a slot retired). status()
@@ -109,6 +117,33 @@ class ServingSupervisor:
             return {s: round(now - t, 3)
                     for s, t in self._stage_progress.items()}
 
+    # -- device loss (serving/device_recovery.py) --------------------------
+    def note_device_lost(self, reason: str) -> None:
+        """The recovery manager classified a dispatch failure / probe
+        pattern as accelerator-runtime loss: hold `/readyz` 503 (state
+        ``device_lost``) until :meth:`note_device_recovered`."""
+        with self._lock:
+            self._device_lost = reason or "device lost"
+        metrics.gauge("supervisor.device_lost", 1.0)
+        flight_recorder.record("device.lost", reason=reason)
+        log.error("device lost (%s): serving degraded until the "
+                  "recovery manager rebuilds device state", reason)
+
+    def note_device_recovered(self) -> None:
+        with self._lock:
+            self._device_lost = None
+        metrics.gauge("supervisor.device_lost", 0.0)
+        flight_recorder.record("device.recovered")
+        log.warning("device recovered: serving state rebuilt")
+
+    @property
+    def device_lost(self) -> Optional[str]:
+        """The loss reason while in the ``device_lost`` state, else
+        None. Read by `/readyz` (names the state) and the queues (fail
+        fast instead of batching work for a dead device)."""
+        with self._lock:
+            return self._device_lost
+
     def device_unhealthy(self) -> bool:
         """True only when the cached device verdict is a hard False —
         a sync read with NO probe dial, cheap enough for the request
@@ -136,6 +171,7 @@ class ServingSupervisor:
         this; `/readyz` flips 503."""
         return (
             self.watchdog_degraded
+            or self.device_lost is not None
             or self.content_breaker.state != "closed"
             or self.score_breaker.state != "closed"
         )
@@ -154,6 +190,9 @@ class ServingSupervisor:
         return max(
             1.0,
             watchdog,
+            # a rebuild (re-upload + re-warm) takes seconds at best:
+            # don't invite shed clients back mid-recovery
+            5.0 if self.device_lost is not None else 0.0,
             self.content_breaker.seconds_until_half_open(),
             self.score_breaker.seconds_until_half_open(),
         )
@@ -168,6 +207,7 @@ class ServingSupervisor:
         `/debugz` enforces; remote probes get the verdict, not the
         event history)."""
         degraded = self.degraded
+        lost = self.device_lost
         ready = not degraded and device_ok is not False
         with self._lock:
             watchdog = {
@@ -184,7 +224,10 @@ class ServingSupervisor:
         metrics.gauge("supervisor.degraded", 0.0 if ready else 1.0)
         status: Dict[str, object] = {
             "ready": ready,
-            "state": "ok" if ready else "degraded",
+            # device_lost is its own named state: the operator runbook
+            # (docs/DEPLOY.md §7b) keys off it
+            "state": ("device_lost" if lost is not None
+                      else "ok" if ready else "degraded"),
             "breakers": {
                 b.name: b.snapshot()
                 for b in (self.content_breaker, self.score_breaker)
@@ -192,6 +235,8 @@ class ServingSupervisor:
             "watchdog": watchdog,
             "device": device_ok,
         }
+        if lost is not None:
+            status["device_lost"] = {"reason": lost}
         stages = self.stage_health()
         if stages:
             status["stages"] = stages

@@ -7,14 +7,28 @@ Two planes, mirroring the reference's split:
   a worker restart re-attaches to the in-flight round, backend.py:93-97).
 - **Model/training state** checkpoints via orbax: params + optimizer state
   + step counter, with atomic versioned directories and resume-latest.
+
+Plus **load-time fingerprints** (ISSUE 17): two loaders read the same
+multi-GB safetensors files — boot (models/weights.py maybe_load) and
+the device-loss rebuild (serving/device_recovery.py), which re-uploads
+them while an incident is already in progress. A file that changed (or
+rotted) between those two reads would silently swap weights under a
+live game. The first successful load records a sidecar
+(``<file>.fingerprint``); every later load verifies against it and
+fails FAST with :class:`CheckpointCorrupt` naming the path — distinct
+from the absent-file case, which remains the documented random-init
+fallback (a missing checkpoint is a configuration, a changed one is an
+incident).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 from typing import Any, Optional
 
-from cassmantle_tpu.utils.logging import get_logger
+from cassmantle_tpu.utils.logging import get_logger, metrics
 
 log = get_logger("checkpoint")
 
@@ -64,3 +78,94 @@ class TrainCheckpointer:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint fingerprints (ISSUE 17): content-addressed load verification
+# ---------------------------------------------------------------------------
+
+SIDECAR_SUFFIX = ".fingerprint"
+# The digest covers file size + the first and last MiB, not the full
+# content: the safetensors header (the complete tensor inventory with
+# offsets) lives at the head, so truncation, re-serialization, and
+# tensor-level edits all move it, while a full-content hash would add
+# seconds of re-read per multi-GB file on every boot for no extra
+# detection in practice.
+_CHUNK = 1 << 20
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint's bytes no longer match its recorded fingerprint.
+
+    Raised by the load path (models/weights.py) and therefore by any
+    recovery rebuild — callers must NOT degrade this to random init."""
+
+    def __init__(self, path: str, expected: str, actual: str) -> None:
+        super().__init__(
+            f"checkpoint fingerprint mismatch at {path}: "
+            f"expected {expected[:16]}..., got {actual[:16]}... — the "
+            f"file changed since it was first loaded (re-fetch it, or "
+            f"delete {path + SIDECAR_SUFFIX} to accept the new content)")
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+
+
+def fingerprint_file(path: str) -> str:
+    """sha256 over (size, head MiB, tail MiB) of ``path``."""
+    size = os.path.getsize(path)
+    h = hashlib.sha256()
+    h.update(str(size).encode())
+    with open(path, "rb") as f:
+        h.update(f.read(_CHUNK))
+        if size > _CHUNK:
+            f.seek(max(_CHUNK, size - _CHUNK))
+            h.update(f.read(_CHUNK))
+    return h.hexdigest()
+
+
+def read_fingerprint(path: str) -> Optional[str]:
+    """The recorded digest for checkpoint ``path``, or None."""
+    sidecar = path + SIDECAR_SUFFIX
+    try:
+        with open(sidecar, "r", encoding="utf-8") as f:
+            return json.load(f).get("sha256") or None
+    except FileNotFoundError:
+        return None
+    except Exception:
+        # an unreadable sidecar cannot vouch for anything: treat as
+        # unrecorded (the caller re-records), but say so
+        log.warning("unreadable fingerprint sidecar %s; re-recording",
+                    sidecar)
+        return None
+
+
+def record_fingerprint(path: str, digest: Optional[str] = None) -> None:
+    """Write the sidecar. Best-effort: a read-only weights mount skips
+    recording (loads of that file stay unverified) rather than failing
+    the boot."""
+    sidecar = path + SIDECAR_SUFFIX
+    body = {"sha256": digest or fingerprint_file(path),
+            "size": os.path.getsize(path)}
+    try:
+        with open(sidecar, "w", encoding="utf-8") as f:
+            json.dump(body, f)
+    except OSError as exc:
+        log.info("cannot record fingerprint %s (%s); loads of this "
+                 "file stay unverified", sidecar, exc)
+
+
+def verify_or_record(path: str) -> None:
+    """Verify ``path`` against its sidecar, recording one if absent.
+
+    Raises :class:`CheckpointCorrupt` on mismatch; returns silently
+    when verified or freshly recorded."""
+    actual = fingerprint_file(path)
+    expected = read_fingerprint(path)
+    if expected is None:
+        record_fingerprint(path, actual)
+        return
+    if actual != expected:
+        metrics.inc("checkpoint.fingerprint_mismatch")
+        log.error("checkpoint %s failed fingerprint verification", path)
+        raise CheckpointCorrupt(path, expected, actual)

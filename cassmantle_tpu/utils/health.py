@@ -45,6 +45,11 @@ class _Probe:
     def __init__(self) -> None:
         self.done = threading.Event()
         self.ok = False
+        # the exception when the probe RAISED (vs hung/miscomputed):
+        # a raise carries the runtime's own error, which the recovery
+        # manager can classify as device loss (a timeout cannot — a
+        # wedge is the watchdog's department)
+        self.exc: Optional[BaseException] = None
         self.started_at = time.monotonic()
         threading.Thread(
             target=self._run, daemon=True, name="device-probe"
@@ -56,6 +61,7 @@ class _Probe:
         except Exception as exc:
             log.warning("device probe failed: %s", exc)
             self.ok = False
+            self.exc = exc
         self.done.set()
 
 
@@ -77,6 +83,16 @@ class DeviceHealth:
         self._healthy: Optional[bool] = None
         self._checked_at = 0.0
         self._inflight: Optional[_Probe] = None
+        # failure CLASS behind a cached False verdict: "timeout" (the
+        # probe hung — a wedge, the watchdog's department), or
+        # "raise:<ExcType>" (the runtime itself errored — candidate
+        # device loss). None while healthy/unknown.
+        self._failure: Optional[str] = None
+        # wired by the serving layer (DeviceRecoveryManager
+        # .note_probe_exception): called OUTSIDE the lock with the
+        # probe's exception when a probe completes by raising, so a
+        # dispatch-quiet worker still detects runtime loss
+        self.on_probe_error = None  # type: Optional[callable]
 
     def last_verdict(self):
         """The cached verdict (True/False/None-unknown) with NO probe
@@ -84,6 +100,23 @@ class DeviceHealth:
         to ``timeout_s`` on a wedged device is not an option."""
         with self._lock:
             return self._healthy
+
+    def last_failure(self) -> Optional[str]:
+        """Failure class behind the cached verdict ("timeout" /
+        "raise:<ExcType>"), None while healthy or unknown. Surfaced so
+        a /readyz reader (and the recovery manager) can tell a wedged
+        device from a dead runtime."""
+        with self._lock:
+            return self._failure
+
+    def invalidate(self) -> None:
+        """Drop the cached verdict (device-loss recovery: a freshly
+        rebuilt runtime must be re-probed, not vouched for by the dead
+        one's verdict)."""
+        with self._lock:
+            self._healthy = None
+            self._failure = None
+            self._checked_at = 0.0
 
     def check(self) -> tuple:
         with self._lock:
@@ -104,14 +137,27 @@ class DeviceHealth:
             probe = self._inflight
         if probe.done.wait(timeout=self.timeout_s):
             ok = probe.ok
+            failure = (None if ok else
+                       f"raise:{type(probe.exc).__name__}"
+                       if probe.exc is not None else "miscompute")
         else:
             ok = False
+            failure = "timeout"
             log.warning("device probe exceeded %.1fs (device hung?)",
                         self.timeout_s)
         with self._lock:
             if probe.done.is_set():
                 self._inflight = None
             self._healthy = ok
+            self._failure = failure
             self._checked_at = time.monotonic()
         metrics.gauge("health.device_ok", 1.0 if ok else 0.0)
+        hook = self.on_probe_error
+        if probe.exc is not None and hook is not None:
+            # outside the lock: the hook may start a recovery thread
+            # that flips supervisor state
+            try:
+                hook(probe.exc)
+            except Exception:
+                log.exception("probe-error hook failed")
         return ok, 0.0

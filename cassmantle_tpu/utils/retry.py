@@ -11,12 +11,73 @@ from __future__ import annotations
 
 import asyncio
 import random
+import threading
+import time
 from typing import Awaitable, Callable, Optional, Tuple, Type, TypeVar
 
 from cassmantle_tpu.utils.logging import get_logger, metrics
 
 T = TypeVar("T")
 log = get_logger("retry")
+
+
+class RetryBudget:
+    """Token-bucket cap on the RATE of retries (ISSUE 17).
+
+    Per-call retry loops are individually bounded but collectively
+    unbounded: under a persistent fault, every caller spends its full
+    ``max_retries`` re-dialing the same dead thing, and the retry
+    traffic itself becomes load (checkpoint re-reads in device
+    recovery, device dials behind a flaky tunnel). A shared budget
+    makes the AGGREGATE bounded: each retry attempt spends a token,
+    tokens refill at a fixed rate, and an empty bucket turns further
+    retries into immediate give-ups (``retry.budget_exhausted``).
+
+    Breaker fast-fails are exempt by construction — a ``give_up_on``
+    abort in :func:`retry_async` raises before any token is consumed,
+    and CircuitOpen paths never reach a retry loop at all; the budget
+    meters real re-dials only, never the cheap refusals.
+
+    Thread-safe; ``clock`` is injectable for tests and drills.
+    """
+
+    def __init__(self, name: str, capacity: float = 10.0,
+                 refill_per_s: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.name = name
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._tokens = float(capacity)
+        self._at = clock()
+
+    def tokens(self) -> float:
+        """Current token balance (after refill), for status surfaces."""
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+    def _refill_locked(self) -> None:
+        now = self.clock()
+        self._tokens = min(self.capacity,
+                           self._tokens
+                           + (now - self._at) * self.refill_per_s)
+        self._at = now
+
+    def acquire(self, n: float = 1.0) -> bool:
+        """Spend ``n`` tokens if available. False = budget exhausted:
+        the caller must give up this retry (counted, logged)."""
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+        metrics.inc("retry.budget_exhausted",
+                    labels={"budget": self.name})
+        log.warning("retry budget %r exhausted; giving up retry",
+                    self.name)
+        return False
 
 # Default jitter source. Module-level (not per-call) so the stream is
 # one process-wide sequence; seed_jitter() pins it for drills/tests —
@@ -51,6 +112,7 @@ async def retry_async(
     give_up_on: Tuple[Type[BaseException], ...] = (),
     jitter: bool = True,
     rng: Optional[random.Random] = None,
+    budget: Optional[RetryBudget] = None,
 ) -> T:
     """Run ``op`` with up to ``max_retries`` attempts; re-raises the last
     failure (callers keep skip-don't-crash semantics at their level).
@@ -72,7 +134,12 @@ async def retry_async(
 
     ``give_up_on`` exceptions abort immediately with no further attempts —
     e.g. a CircuitOpen fast-fail, where backing off and re-dialing an
-    open breaker would just burn the caller's lock budget."""
+    open breaker would just burn the caller's lock budget.
+
+    ``budget``: a shared :class:`RetryBudget` each RE-dial must acquire
+    from (the first attempt is free — it is not a retry). Exhaustion
+    re-raises the last failure immediately; give_up_on fast-fails never
+    touch the budget."""
     backoff = backoff or linear_backoff()
     loop = asyncio.get_running_loop()
     start = loop.time()
@@ -88,6 +155,10 @@ async def retry_async(
             log.warning("%s attempt %d/%d failed: %s",
                         name, attempt + 1, max_retries, exc)
             if attempt + 1 < max_retries:
+                if budget is not None and not budget.acquire():
+                    log.warning("%s: retry budget exhausted after %d "
+                                "attempt(s)", name, attempt + 1)
+                    break
                 pause = backoff(attempt)
                 if jitter and pause > 0:
                     # full jitter (uniform over (0, schedule]): the

@@ -1,0 +1,486 @@
+"""Output-integrity sentinels + device-loss recovery (ISSUE 17).
+
+Covers the three rungs end to end without hardware: the device-side /
+host-side detectors and their kill-switch bit-exactness (serving/
+integrity.py), per-member failure through the scorer and prompt paths,
+device-loss classification + the single-flight rebuild manager
+(serving/device_recovery.py), the queue's device-lost fail-fast, the
+checkpoint fingerprint sidecars (utils/checkpoint.py), the retry token
+bucket (utils/retry.py), and the device_loss_drill harness itself.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cassmantle_tpu.chaos import FAULT_POINTS, configure, disarm, parse_spec
+from cassmantle_tpu.serving import integrity
+from cassmantle_tpu.serving.integrity import (
+    OutputInvalid,
+    degenerate_frames,
+    finite_verdict,
+    invalid_members,
+    poison,
+)
+from cassmantle_tpu.serving.device_recovery import (
+    DeviceRecoveryManager,
+    classify_device_loss,
+)
+from cassmantle_tpu.utils.retry import RetryBudget, retry_async
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    yield
+    disarm()
+
+
+# -- detectors ---------------------------------------------------------------
+
+def test_finite_verdict_per_member():
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.array(
+        [[1.0, 2.0], [np.nan, 1.0], [3.0, np.inf], [0.0, 0.0]],
+        dtype=np.float32))
+    assert np.asarray(finite_verdict(x)).tolist() == [
+        True, False, False, True]
+
+
+def test_finite_verdict_ints_constant_true():
+    import jax.numpy as jnp
+
+    toks = jnp.asarray(np.array([[1, 2], [3, 4]], dtype=np.int32))
+    assert np.asarray(finite_verdict(toks)).tolist() == [True, True]
+
+
+def test_degenerate_frames_flags_constant_only():
+    frames = np.zeros((3, 4, 4, 3), dtype=np.uint8)
+    frames[1, 0, 0, 0] = 7          # one differing pixel: a real image
+    frames[2, :] = 255              # stuck-constant white
+    assert degenerate_frames(frames).tolist() == [True, False, True]
+    assert degenerate_frames(
+        np.zeros((0, 4, 4, 3), dtype=np.uint8)).tolist() == []
+
+
+def test_invalid_members_union_and_trim():
+    verdict = np.array([True, False, True, True])
+    frames = np.zeros((4, 2, 2, 3), dtype=np.uint8)
+    frames[0, 0, 0, 0] = 9           # valid frame
+    frames[2, :] = 0                 # degenerate, verdict True
+    # n=3 trims the pad row before judging
+    assert invalid_members(verdict, images=frames,
+                           n=3).tolist() == [1, 2]
+
+
+def test_invalid_members_kill_switch(monkeypatch):
+    monkeypatch.setenv("CASSMANTLE_NO_INTEGRITY_CHECKS", "1")
+    verdict = np.array([False, False])
+    assert invalid_members(verdict).size == 0
+
+
+def test_enforce_raises_retriable():
+    with pytest.raises(OutputInvalid) as exc:
+        integrity.enforce(np.array([True, False]), pipeline="t2i",
+                          stage="sample")
+    assert exc.value.retriable
+    assert exc.value.members == (1,)
+    assert "t2i/sample" in str(exc.value)
+
+
+# -- the device.poison chaos hook --------------------------------------------
+
+def test_poison_disarmed_is_identity():
+    arr = np.ones((2, 3), dtype=np.float32)
+    assert poison(arr, peer="x") is arr
+
+
+def test_poison_fills_by_dtype():
+    configure("seed=1;device.poison=raise:peer=x")
+    f = poison(np.ones((2, 3), dtype=np.float32), peer="x")
+    assert np.isnan(f[0]).all() and np.isfinite(f[1]).all()
+    # signed ints get -1 (out of any vocab) so range checks catch it
+    t = poison(np.ones((2, 4), dtype=np.int32), peer="x")
+    assert (t[0] == -1).all() and (t[1] == 1).all()
+    # uint8 frames get 0 so the degenerate detector catches it
+    u = poison(np.full((2, 2, 2, 3), 7, dtype=np.uint8), peer="x")
+    assert (u[0] == 0).all() and (u[1] == 7).all()
+
+
+def test_poison_peer_scoped():
+    configure("seed=1;device.poison=raise:peer=only-this")
+    arr = np.ones((2, 3), dtype=np.float32)
+    assert poison(arr, peer="other") is arr
+
+
+def test_fault_points_registered():
+    assert "device.poison" in FAULT_POINTS
+    assert "device.lost" in FAULT_POINTS
+    seed, rules = parse_spec(
+        "seed=7;device.poison=flake:p=0.3,peer=a;"
+        "device.lost=raise:times=1")
+    assert seed == 7 and len(rules) == 2
+
+
+# -- device-loss classification ----------------------------------------------
+
+def test_classify_matches_type_names_and_markers():
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert classify_device_loss(XlaRuntimeError("boom")) is not None
+    assert classify_device_loss(
+        RuntimeError("TPU driver: data transfer failed")) is not None
+    assert classify_device_loss(
+        RuntimeError("chaos: injected failure at device.lost")) \
+        is not None
+
+
+def test_classify_walks_cause_chain():
+    class XlaRuntimeError(Exception):
+        pass
+
+    outer = RuntimeError("dispatch failed")
+    outer.__cause__ = XlaRuntimeError("device is lost")
+    assert classify_device_loss(outer) is not None
+    # cycle-safe
+    a = RuntimeError("a")
+    b = RuntimeError("b")
+    a.__cause__, b.__cause__ = b, a
+    assert classify_device_loss(a) is None
+
+
+def test_classify_conservative():
+    from cassmantle_tpu.serving.queue import DeadlineExceeded, QueueFull
+
+    for exc in (ValueError("bad shape"), DeadlineExceeded("score"),
+                QueueFull("score"), OutputInvalid("t2i", "sample")):
+        assert classify_device_loss(exc) is None
+
+
+# -- the recovery manager ----------------------------------------------------
+
+class _FakeSupervisor:
+    def __init__(self):
+        self.lost = None
+        self.events = []
+
+    def note_device_lost(self, reason):
+        self.lost = reason
+        self.events.append(("lost", reason))
+
+    def note_device_recovered(self):
+        self.lost = None
+        self.events.append(("recovered",))
+
+    @property
+    def device_lost(self):
+        return self.lost
+
+    @property
+    def degraded(self):
+        return self.lost is not None
+
+
+def test_recovery_rebuilds_and_recovers():
+    sup = _FakeSupervisor()
+    calls = {"rebuild": 0, "warm": 0}
+
+    def rebuild():
+        calls["rebuild"] += 1
+
+    def warm():
+        calls["warm"] += 1
+
+    mgr = DeviceRecoveryManager(supervisor=sup, rebuild=rebuild,
+                                warm=warm, backoff_s=0.01,
+                                sleep=lambda s: None)
+    assert mgr.note_dispatch_exception(
+        RuntimeError("chaos: injected failure at device.lost"))
+    mgr.join(timeout=5.0)
+    assert sup.lost is None
+    assert calls == {"rebuild": 1, "warm": 1}
+    assert sup.events[0][0] == "lost" and sup.events[-1][0] == "recovered"
+
+
+def test_recovery_ignores_non_loss():
+    sup = _FakeSupervisor()
+    mgr = DeviceRecoveryManager(supervisor=sup,
+                                rebuild=lambda: None)
+    assert not mgr.note_dispatch_exception(ValueError("nope"))
+    assert sup.lost is None and not mgr.recovering
+
+
+def test_recovery_warm_failure_fails_attempt_then_permanent():
+    sup = _FakeSupervisor()
+    attempts = []
+
+    def rebuild():
+        attempts.append(1)
+
+    mgr = DeviceRecoveryManager(
+        supervisor=sup, rebuild=rebuild,
+        warm=lambda: (_ for _ in ()).throw(RuntimeError("still dead")),
+        max_attempts=2, backoff_s=0.0, sleep=lambda s: None)
+    mgr.begin_recovery("test loss")
+    mgr.join(timeout=5.0)
+    assert len(attempts) == 2
+    assert mgr.permanent
+    assert sup.lost is not None  # stays device_lost: /readyz keeps 503
+
+
+def test_recovery_permanent_hook_and_no_restart():
+    sup = _FakeSupervisor()
+    drained = []
+    mgr = DeviceRecoveryManager(
+        supervisor=sup,
+        rebuild=lambda: (_ for _ in ()).throw(RuntimeError("dead")),
+        on_permanent=drained.append, max_attempts=1, backoff_s=0.0,
+        sleep=lambda s: None)
+    mgr.begin_recovery("gone")
+    mgr.join(timeout=5.0)
+    assert drained == ["gone"]
+    # permanent loss: later classifications must NOT restart recovery
+    mgr.begin_recovery("gone again")
+    assert not mgr.recovering and sup.lost is not None
+
+
+def test_recovery_budget_bounds_attempts():
+    sup = _FakeSupervisor()
+    attempts = []
+    budget = RetryBudget("t", capacity=2.0, refill_per_s=0.0)
+    mgr = DeviceRecoveryManager(
+        supervisor=sup,
+        rebuild=lambda: attempts.append(1) or (_ for _ in ()).throw(
+            RuntimeError("dead")),
+        max_attempts=10, backoff_s=0.0, budget=budget,
+        sleep=lambda s: None)
+    mgr.begin_recovery("flapping")
+    mgr.join(timeout=5.0)
+    assert len(attempts) == 2    # budget, not max_attempts, bounded it
+    assert mgr.permanent
+
+
+def test_recovery_kill_switch_stays_lost(monkeypatch):
+    monkeypatch.setenv("CASSMANTLE_NO_DEVICE_RECOVERY", "1")
+    sup = _FakeSupervisor()
+    rebuilt = []
+    mgr = DeviceRecoveryManager(supervisor=sup,
+                                rebuild=lambda: rebuilt.append(1))
+    mgr.begin_recovery("operator will handle it")
+    mgr.join(timeout=1.0)
+    assert sup.lost is not None and rebuilt == [] and not mgr.recovering
+
+
+# -- queue integration -------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_queue_fails_fast_while_device_lost():
+    from cassmantle_tpu.serving.queue import BatchingQueue, QueueFull
+
+    sup = _FakeSupervisor()
+    sup.note_device_lost("drill")
+    q = BatchingQueue(lambda items: items, name="t_lost",
+                      supervisor=sup)
+    with pytest.raises(QueueFull) as exc:
+        await q.submit("x", deadline_s=1.0)
+    assert "device_lost" in str(exc.value)
+    await q.stop()
+
+
+@pytest.mark.asyncio
+async def test_queue_distributes_per_member_exceptions():
+    from cassmantle_tpu.serving.queue import BatchingQueue
+
+    def handler(items):
+        return [OutputInvalid("drill", "score", [i])
+                if item == "bad" else f"ok:{item}"
+                for i, item in enumerate(items)]
+
+    q = BatchingQueue(handler, name="t_members", max_delay_ms=20.0)
+    import asyncio
+
+    good, bad = await asyncio.gather(
+        q.submit("fine", deadline_s=2.0),
+        q.submit("bad", deadline_s=2.0),
+        return_exceptions=True)
+    assert good == "ok:fine"
+    assert isinstance(bad, OutputInvalid)
+    await q.stop()
+
+
+@pytest.mark.asyncio
+async def test_queue_dispatch_error_hook_classifies():
+    from cassmantle_tpu.serving.queue import BatchingQueue
+
+    seen = []
+
+    def handler(items):
+        raise RuntimeError("TPU driver: hardware failure")
+
+    q = BatchingQueue(handler, name="t_hook",
+                      on_dispatch_error=seen.append)
+    with pytest.raises(RuntimeError):
+        await q.submit("x", deadline_s=2.0)
+    assert len(seen) == 1
+    assert classify_device_loss(seen[0]) is not None
+    await q.stop()
+
+
+# -- retry budget ------------------------------------------------------------
+
+def test_retry_budget_drain_and_refill():
+    now = [0.0]
+    b = RetryBudget("t", capacity=2.0, refill_per_s=1.0,
+                    clock=lambda: now[0])
+    assert b.acquire() and b.acquire() and not b.acquire()
+    now[0] = 1.5
+    assert b.acquire() and not b.acquire()
+    now[0] = 100.0
+    assert b.tokens() <= 2.0  # capacity-capped
+
+
+@pytest.mark.asyncio
+async def test_retry_async_respects_budget():
+    calls = []
+
+    async def always_fails():
+        calls.append(1)
+        raise RuntimeError("nope")
+
+    b = RetryBudget("t", capacity=1.0, refill_per_s=0.0)
+    with pytest.raises(RuntimeError):
+        await retry_async(always_fails, max_retries=10,
+                          backoff=lambda i: 0.0, name="t", budget=b)
+    # first attempt free, one retry from the budget, then it breaks
+    assert len(calls) == 2
+
+
+# -- checkpoint fingerprints -------------------------------------------------
+
+def test_fingerprint_record_then_verify(tmp_path):
+    from cassmantle_tpu.utils.checkpoint import (
+        CheckpointCorrupt,
+        read_fingerprint,
+        verify_or_record,
+    )
+
+    path = tmp_path / "model.safetensors"
+    path.write_bytes(b"\x00" * 4096)
+    verify_or_record(str(path))           # absent sidecar: records
+    assert read_fingerprint(str(path)) is not None
+    verify_or_record(str(path))           # match: silent
+    path.write_bytes(b"\xff" * 4096)      # corrupt in place
+    with pytest.raises(CheckpointCorrupt) as exc:
+        verify_or_record(str(path))
+    assert str(path) in str(exc.value)
+    assert exc.value.expected != exc.value.actual
+
+
+def test_fingerprint_covers_size_and_tail(tmp_path):
+    from cassmantle_tpu.utils.checkpoint import fingerprint_file
+
+    a = tmp_path / "a.bin"
+    b = tmp_path / "b.bin"
+    a.write_bytes(b"x" * 100)
+    b.write_bytes(b"x" * 101)             # same head, different size
+    assert fingerprint_file(str(a)) != fingerprint_file(str(b))
+
+
+# -- scorer path (one tiny real encoder, shared) -----------------------------
+
+@pytest.fixture(scope="module")
+def scorer():
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.ops.scorer import EmbeddingScorer
+
+    return EmbeddingScorer(test_config().models.minilm, seq_len=8,
+                           batch_buckets=(4,), table=False)
+
+
+def test_scorer_poisoned_rows_nan_and_never_cached(scorer):
+    configure("seed=3;device.poison=raise:times=1,peer=scorer")
+    out = scorer.embed(["qq-poisoned", "qq-neighbor"])
+    bad = ~np.isfinite(out).all(axis=-1)
+    assert bad.sum() == 1          # member 0 of the dispatch corrupted
+    assert np.isfinite(out[~bad]).all()  # the neighbor row is intact
+    disarm()
+    # the poisoned text was never cached: a clean re-embed succeeds
+    again = scorer.embed(["qq-poisoned"])
+    assert np.isfinite(again).all()
+
+
+def test_scorer_kill_switch_bit_exact(scorer, monkeypatch):
+    rows_on, ok_on = scorer._embed_device(["storm", "harbor"])
+    monkeypatch.setenv("CASSMANTLE_NO_INTEGRITY_CHECKS", "1")
+    rows_off, ok_off = scorer._embed_device(["storm", "harbor"])
+    # the verdict is still computed in-jit either way — identical
+    # compiled graphs, so flipping the switch is a bit-exact revert
+    assert np.array_equal(rows_on, rows_off)
+    assert ok_on.all() and ok_off.all()
+
+
+def test_scorer_reload_params_zero_recompile(scorer):
+    from cassmantle_tpu.utils import jit_sentinel
+
+    scorer.embed(["warm-reload"])          # ensure compiled
+    scorer.reload_params()
+    with jit_sentinel.no_new_compiles():
+        out = scorer.embed(["post-reload-word"])
+    assert np.isfinite(out).all()
+
+
+# -- prompt path (tiny GPT-2; one shared module-scoped compile, ~3s) ---------
+
+@pytest.fixture(scope="module")
+def promptgen():
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+
+    return PromptGenerator(test_config())
+
+
+def test_prompt_poison_fails_only_its_row(promptgen):
+    configure("seed=5;device.poison=raise:times=1,peer=prompt")
+    out = promptgen.generate_batch(["the harbor", "the lighthouse"])
+    invalid = [o for o in out if isinstance(o, OutputInvalid)]
+    texts = [o for o in out if isinstance(o, str)]
+    assert len(invalid) == 1 and len(texts) == 1
+    assert invalid[0].pipeline == "prompt"
+    disarm()
+    # clean decode afterwards: the poison never stuck anywhere
+    clean = promptgen.generate_batch(["the harbor"])
+    assert isinstance(clean[0], str)
+
+
+def test_prompt_generate_raises_on_poison(promptgen):
+    configure("seed=5;device.poison=raise:times=1,peer=prompt")
+    with pytest.raises(OutputInvalid):
+        promptgen.generate("the storm")
+
+
+def test_prompt_kill_switch_serves_poisoned_tokens(promptgen,
+                                                   monkeypatch):
+    # with checks off the range verdict is skipped entirely — the
+    # production bit-exact revert (output text may be garbage, which is
+    # exactly what the switch trades for zero enforcement)
+    monkeypatch.setenv("CASSMANTLE_NO_INTEGRITY_CHECKS", "1")
+    configure("seed=5;device.poison=raise:times=1,peer=prompt")
+    out = promptgen.generate_batch(["the harbor"])
+    assert isinstance(out[0], str)
+
+
+# -- the drill harness (bench.py entry, short phases) ------------------------
+
+def test_device_loss_drill_short():
+    import bench
+
+    raw = bench.device_loss_drill_run(
+        seed=42, rate=60.0, baseline_s=0.3, poison_s=0.6, kill_s=2.0,
+        recovered_s=0.5, rebuild_s=0.05)
+    assert raw["invalid_served"] == 0
+    assert all(p["all_resolved"] for p in raw["phases"].values())
+    assert raw["recovery_s"] is not None and raw["recovery_s"] < 2.0
+    assert raw["device_generation"] == 1
+    assert raw["phases"]["recovered"]["goodput"] >= 0.9
