@@ -2332,6 +2332,225 @@ def bench_device_loss_drill(weights_dir: str) -> dict:
     }
 
 
+# -- canary drill (ISSUE 18): does the synthetic prober actually catch ----
+# -- the faults it exists to catch? ---------------------------------------
+
+def canary_drill_run(seed: int = 42, store_port: int = 7661) -> dict:
+    """The canary prober's proof-of-detection drill: one in-process
+    fabric worker on a REAL socket over a REAL mantlestore (the
+    ``store.client.op`` fault point lives in the native client), probed
+    by the real :class:`CanaryProber` over real HTTP. Three fault
+    classes are armed in turn — slow store, device output poison, a
+    wedged dispatch thread — and each probe is driven explicitly, so
+    "detected within one probe period" is literal: the single probe
+    fired while the fault was armed must fail. Between faults the probe
+    must recover (chaos disarmed => ok again), the FAILED probe's trace
+    must be retrievable through the ``probe.e2e_s`` bucket exemplar,
+    and the whole drill must leave player surfaces untouched:
+    ``game.guesses`` flat, the score admission limiter's estimate
+    unmoved (probe submits bypass it by design)."""
+    import asyncio
+    import dataclasses
+
+    from aiohttp.test_utils import TestServer
+
+    from cassmantle_tpu import chaos
+    from cassmantle_tpu.config import test_config
+    from cassmantle_tpu.engine.content import FakeContentBackend
+    from cassmantle_tpu.engine.game import Game
+    from cassmantle_tpu.fabric.rooms import RoomFabric
+    from cassmantle_tpu.native.client import (
+        MantleStore,
+        ensure_built,
+        spawn_server,
+    )
+    from cassmantle_tpu.obs.prober import CanaryProber
+    from cassmantle_tpu.obs.trace import tracer
+    from cassmantle_tpu.serving.service import InferenceService
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+    from cassmantle_tpu.server.app import create_app
+    from cassmantle_tpu.utils.logging import metrics
+
+    if ensure_built() is None:
+        raise RuntimeError("mantlestore toolchain unavailable")
+
+    base = test_config()
+    cfg = base.replace(
+        game=dataclasses.replace(
+            base.game, rate_limit_default=1e6, rate_limit_api=1e6,
+            time_per_prompt=30.0),
+        fabric=dataclasses.replace(
+            base.fabric, num_rooms=1, heartbeat_s=30.0),
+        serving=dataclasses.replace(
+            base.serving, submit_deadline_s=2.0, dispatch_hang_s=1.0),
+        obs=dataclasses.replace(
+            base.obs, probe_timeout_s=2.0, probe_interval_s=3600.0,
+            slo_eval_interval_s=300.0, process_sample_interval_s=60.0),
+    )
+
+    store_proc = spawn_server(store_port)
+
+    async def drive() -> dict:
+        store = MantleStore(port=store_port)
+        await store.connect()
+        sup = ServingSupervisor()
+        service = InferenceService(
+            cfg, backend=FakeContentBackend(image_size=64),
+            supervisor=sup)
+
+        def factory(room, room_store):
+            return Game(cfg, room_store, service.content_backend,
+                        embed=service.embed,
+                        similarity=service.similarity,
+                        supervisor=sup, room=room)
+
+        fabric = RoomFabric(cfg, store, factory, worker_id="canary-w",
+                            start_timers=False, heartbeat=False,
+                            supervisor=sup)
+        server = TestServer(create_app(fabric, cfg, start_timer=False,
+                                       device_health=False))
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}"
+        fabric.membership.addr = url
+        prober = CanaryProber(fabric, cfg, self_addr=url)
+
+        limiter = service.score_queue.admission
+        limit_before = limiter._limit if limiter is not None else None
+        counters_before = dict(metrics.snapshot()["counters"])
+
+        def guesses_total(counters: dict) -> float:
+            return sum(v for k, v in counters.items()
+                       if k.split("{", 1)[0] == "game.guesses")
+
+        def clear_embed_cache() -> None:
+            # the probe's near-guess/answer rows land in the scorer LRU
+            # on the first probe; a poison drill must force them back
+            # onto the device path or the fault never executes
+            with service.scorer._embed_cache_lock:
+                service.scorer._embed_cache.clear()
+
+        async def recover(deadline_s: float = 10.0) -> dict:
+            t0 = time.monotonic()
+            while True:
+                v = await prober.probe_once()
+                if v["ok"] or time.monotonic() - t0 > deadline_s:
+                    return {"ok": bool(v["ok"]),
+                            "recovery_s":
+                                round(time.monotonic() - t0, 3)}
+                await asyncio.sleep(0.25)
+
+        def slim(v: dict) -> dict:
+            return {"ok": bool(v["ok"]), "leg": v["leg"],
+                    "error": v["error"], "e2e_s": v["e2e_s"],
+                    "trace": v["trace"]}
+
+        phases: dict = {}
+        try:
+            phases["baseline"] = slim(await prober.probe_once())
+
+            chaos.configure(
+                f"seed={seed};store.client.op=latency:delay_s=3.0")
+            phases["slow_store"] = slim(await prober.probe_once())
+            chaos.disarm()
+            phases["slow_store"]["recovered"] = await recover()
+
+            clear_embed_cache()
+            chaos.configure(
+                f"seed={seed};device.poison=raise:peer=scorer")
+            phases["device_poison"] = slim(await prober.probe_once())
+            chaos.disarm()
+            clear_embed_cache()
+            phases["device_poison"]["recovered"] = await recover()
+
+            chaos.configure(f"seed={seed};queue.dispatch="
+                            f"wedge:times=1,wedge_s=30,peer=score")
+            phases["wedged_dispatch"] = slim(await prober.probe_once())
+            chaos.release("queue.dispatch")
+            chaos.disarm()
+            # let the deadline fail the wedged batch and the watchdog
+            # replace the dispatch thread (dispatch_hang_s=1.0)
+            await asyncio.sleep(2.5)
+            phases["wedged_dispatch"]["recovered"] = await recover()
+
+            # the last FAILED probe's trace: retrievable directly from
+            # the tracer AND linked from a probe.e2e_s bucket exemplar
+            failed_trace = phases["wedged_dispatch"]["trace"]
+            spans = tracer.get_trace(failed_trace)
+            snap = metrics.snapshot(exemplars=True)
+            ex = snap.get("exemplars", {}).get("probe.e2e_s", {})
+            linked = {e["trace_id"] for e in ex.values()}
+            counters_after = dict(snap["counters"])
+            return {
+                "phases": phases,
+                "trace_retrievable": bool(spans),
+                "exemplar_linked": failed_trace in linked,
+                "probe_ok_total":
+                    counters_after.get("probe.ok", 0.0)
+                    - counters_before.get("probe.ok", 0.0),
+                "probe_failures_total":
+                    counters_after.get("probe.failures", 0.0)
+                    - counters_before.get("probe.failures", 0.0),
+                "game_guesses_delta":
+                    guesses_total(counters_after)
+                    - guesses_total(counters_before),
+                "admit_limit_moved":
+                    (limiter is not None
+                     and limiter._limit != limit_before),
+            }
+        finally:
+            chaos.disarm()
+            await prober.close()
+            await service.score_queue.stop()
+            await service.prompt_queue.stop()
+            await server.close()
+            await store.close()
+
+    try:
+        return asyncio.run(drive())
+    finally:
+        store_proc.kill()
+        store_proc.wait()
+
+
+def bench_canary_drill(weights_dir: str) -> dict:
+    """ISSUE 18's deliverable: every armed fault class (slow store,
+    device poison, wedged dispatch) caught by the very next probe —
+    within one probe period by construction — with the failed probe's
+    trace retrievable via its histogram exemplar, recovery observed
+    once chaos disarms, and zero probe bleed into player surfaces
+    (``game.guesses`` and the admission limiter stay flat). Knobs:
+    BENCH_CANARY_SEED / BENCH_CANARY_STORE_PORT (env)."""
+    env = os.environ.get
+    raw = canary_drill_run(
+        seed=int(env("BENCH_CANARY_SEED", "42")),
+        store_port=int(env("BENCH_CANARY_STORE_PORT", "7661")),
+    )
+    phases = raw["phases"]
+    faults = ("slow_store", "device_poison", "wedged_dispatch")
+    detected = sum(1 for f in faults if not phases[f]["ok"])
+    return {
+        "metric": "canary_drill_faults_detected",
+        "value": detected,
+        "unit": "faults",
+        "vs_baseline": None,
+        "baseline_ok": phases["baseline"]["ok"],
+        "all_detected_within_one_probe": detected == len(faults),
+        "detected_legs": {f: phases[f]["leg"] for f in faults},
+        "all_recovered": all(phases[f]["recovered"]["ok"]
+                             for f in faults),
+        "trace_retrievable": raw["trace_retrievable"],
+        "exemplar_linked": raw["exemplar_linked"],
+        "probe_invisible_to_players":
+            raw["game_guesses_delta"] == 0
+            and not raw["admit_limit_moved"],
+        "game_guesses_delta": raw["game_guesses_delta"],
+        "admit_limit_moved": raw["admit_limit_moved"],
+        "phases": phases,
+        # a detection count, not a timing: exact by construction
+        "noise_tolerance": 0.0,
+    }
+
+
 # Counters whose per-entry deltas carry diagnostic weight: recompiles,
 # cache effectiveness, staged-serving churn, and every supervision
 # counter (suffix match). Attached to each BENCH_SUITE.json record so
@@ -2369,6 +2588,11 @@ _DELTA_COUNTERS = {
     "rounds.generate_invalid", "device.recoveries",
     "device.recovery_permanent", "retry.budget_exhausted",
     "checkpoint.fingerprint_mismatch",
+    # canary prober + tail sampling (ISSUE 18): probe verdict totals
+    # (probe.failures rides the .failures suffix) and the tail
+    # retention/abandonment accounting — a perf delta that arrives with
+    # probe failures or abandoned traces names its own cause
+    "probe.ok", "obs.tail_retained", "obs.traces_abandoned",
 }
 _DELTA_SUFFIXES = (".dispatch_hangs", ".deadline_expired", ".rejected",
                    ".rejected_degraded", ".failures", ".loop_errors",
@@ -2432,6 +2656,7 @@ SUITE = {
     "rooms_load_table": bench_rooms_load_table,
     "overload_drill_table": bench_overload_drill_table,
     "device_loss_drill": bench_device_loss_drill,
+    "canary_drill": bench_canary_drill,
 }
 
 # ``--north-star-only`` measures exactly these, with BENCH_ROUNDS=1

@@ -255,6 +255,16 @@ class ChaosPlan:
         # parameter is the event kind
         flight_recorder.record("chaos.injected", point=name,
                                fault=rule.kind, peer=peer)
+        # tail retention (ISSUE 18): whatever request this injection
+        # landed in is a trace worth keeping — mark the ambient context
+        # so the pending ring promotes it at root completion. Lazy
+        # import: chaos must stay importable before the obs package.
+        try:
+            from cassmantle_tpu.obs.trace import tracer
+
+            tracer.mark_retain("chaos")
+        except Exception:
+            pass
         log.warning("chaos: injecting %s at %s (peer=%s, fire %d)",
                     rule.kind, name, peer, rule.fires)
 

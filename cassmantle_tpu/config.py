@@ -460,14 +460,31 @@ class ObsConfig:
     Applied to the process-global tracer / flight recorder / metrics
     registry by ``obs.configure_observability`` at server build."""
 
-    # Head-based trace sampling: fraction of root spans recorded. IDs
-    # still propagate (X-Trace-Id stays useful for log correlation)
-    # when a trace is unsampled.
+    # Healthy-baseline sampling FLOOR (ISSUE 18): fraction of root
+    # spans retained unconditionally (head-certain). Every other trace
+    # buffers in the pending ring and is tail-retained only when its
+    # root completes slow/errored/marked; IDs always propagate
+    # (X-Trace-Id stays useful for log correlation) either way.
+    # CASSMANTLE_NO_TAIL_SAMPLING=1 reverts this to the pre-tail
+    # head-sampling decision (docs/DEPLOY.md §6).
     trace_sample_rate: float = 1.0
     # Bounded per-trace span sink: how many traces stay queryable at
     # /debugz?trace=... (LRU eviction), and the per-trace span cap.
     trace_capacity: int = 256
     trace_max_spans: int = 512
+    # -- tail retention (ISSUE 18) -----------------------------------------
+    # Pending ring for traces awaiting their root's retention verdict:
+    # occupancy cap, and the TTL sweep that reclaims traces whose root
+    # never completes (client disconnect, watchdog kill) — counted
+    # obs.traces_abandoned.
+    trace_pending_capacity: int = 512
+    trace_pending_ttl_s: float = 120.0
+    # Per-route slow thresholds for tail retention: a completed root
+    # span at least this slow is promoted. Keyed by root span name
+    # ("http.post /compute_score"); ()-pairs because the dataclass is
+    # frozen/hashable.
+    tail_slow_default_s: float = 1.0
+    tail_slow_routes: Tuple[Tuple[str, float], ...] = ()
     # Flight-recorder ring: how many structured events /debugz replays.
     recorder_capacity: int = 512
     # Default latency-histogram bucket bounds (seconds, cumulative) —
@@ -497,6 +514,17 @@ class ObsConfig:
     slo_score_p99_s: float = 2.0
     slo_generation_ratio: float = 0.9
     slo_repl_lag_max: float = 512.0
+    # -- synthetic canary prober (obs/prober.py, ISSUE 18) -----------------
+    # Background cadence of the end-to-end probe loop (self + peers)
+    # and the per-leg HTTP timeout. CASSMANTLE_NO_PROBER=1 disables the
+    # loop; CASSMANTLE_PROBE_INTERVAL_S overrides the cadence
+    # (docs/DEPLOY.md §6).
+    probe_interval_s: float = 15.0
+    probe_timeout_s: float = 5.0
+    # Black-box SLO objectives fed by probe verdicts: minimum probe
+    # success ratio, and the p99 bound on probe end-to-end time.
+    probe_success_ratio: float = 0.95
+    probe_p99_s: float = 3.0
 
 
 @dataclasses.dataclass(frozen=True)

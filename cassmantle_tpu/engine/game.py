@@ -25,11 +25,17 @@ from cassmantle_tpu.engine.sessions import SessionManager
 from cassmantle_tpu.engine.store import StateStore
 from cassmantle_tpu.obs.trace import tracer
 from cassmantle_tpu.serving.supervisor import ServingSupervisor
-from cassmantle_tpu.utils.logging import metrics
+from cassmantle_tpu.utils.logging import NULL_METRICS, metrics
 from cassmantle_tpu.utils.text import format_clock
 
 # (image uint8 HWC, blur_radius) -> blurred uint8 HWC
 BlurFn = Callable[[np.ndarray, float], np.ndarray]
+
+# The synthetic-canary probe room (ISSUE 18). A game built for this
+# room plays the full engine surface but emits NO engine metrics:
+# probe traffic must never pollute game.guesses, cache-hit ratios, or
+# latency histograms that feed capacity estimation and SLO burn.
+PROBE_ROOM = "__probe__"
 
 
 def _pil_blur(image: np.ndarray, radius: float) -> np.ndarray:
@@ -67,6 +73,10 @@ class Game:
         self._metric_labels: Optional[Dict[str, str]] = (
             {"room": room} if room else None
         )
+        # probe-room games swap the registry for a no-op sink: canary
+        # traffic exercises the real code paths without contributing a
+        # single engine series (ISSUE 18)
+        self._metrics = NULL_METRICS if room == PROBE_ROOM else metrics
         # the degradation control plane: production shares one supervisor
         # between the InferenceService and the engine (server/app.py
         # build_game); standalone/fake games get their own
@@ -164,8 +174,8 @@ class Game:
             # work that must not stall the event loop (to_thread copies
             # contextvars, so the span lands in the request trace)
             with tracer.span("game.blur"), \
-                    metrics.timer("game.blur_s",
-                                  labels=self._metric_labels):
+                    self._metrics.timer("game.blur_s",
+                                        labels=self._metric_labels):
                 return self.blur_fn(image, radius)
 
         return await asyncio.to_thread(render)
@@ -210,16 +220,16 @@ class Game:
             self._image_renders = {}
         cached = self._image_cache.get(bucket)
         if cached is not None:
-            metrics.inc("game.image_cache_hits",
-                        labels=self._metric_labels)
+            self._metrics.inc("game.image_cache_hits",
+                              labels=self._metric_labels)
             return cached
         task = self._image_renders.get(bucket)
         if task is not None:
-            metrics.inc("game.image_cache_hits",
-                        labels=self._metric_labels)
+            self._metrics.inc("game.image_cache_hits",
+                              labels=self._metric_labels)
         else:
-            metrics.inc("game.image_cache_misses",
-                        labels=self._metric_labels)
+            self._metrics.inc("game.image_cache_misses",
+                              labels=self._metric_labels)
             # the render runs as its OWN task: a waiter's cancellation
             # (client disconnect) must not cancel the shared render or
             # propagate to the other coalesced waiters
@@ -252,8 +262,8 @@ class Game:
             # this worker thread on device dispatch)
             image = decode_jpeg(raw)
             with tracer.span("game.blur"), \
-                    metrics.timer("game.blur_s",
-                                  labels=self._metric_labels):
+                    self._metrics.timer("game.blur_s",
+                                        labels=self._metric_labels):
                 blurred = self.blur_fn(image, bucket)
             return image_to_base64(np.asarray(blurred))
 
@@ -317,13 +327,13 @@ class Game:
         if not pairs:
             return {"won": 0}
         with tracer.span("game.score", attrs={"pairs": len(pairs)}), \
-                metrics.timer("game.score_s",
-                              labels=self._metric_labels):
+                self._metrics.timer("game.score_s",
+                                    labels=self._metric_labels):
             scores = await self.scorer.score_pairs(pairs)
         result = await self.sessions.set_scores(session, scores)
         await self.sessions.increment_attempt(session)
-        metrics.inc("game.guesses", len(pairs),
-                    labels=self._metric_labels)
+        self._metrics.inc("game.guesses", len(pairs),
+                          labels=self._metric_labels)
         return result
 
     # -- clock / presence -------------------------------------------------

@@ -165,6 +165,11 @@ class RoomFabric:
         self._startups: Dict[str, asyncio.Task] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._draining = False
+        # canary probe engine (ISSUE 18): built lazily, NEVER in
+        # _games — invisible to the directory ring, placement answers,
+        # heartbeat room counts, and fabric.rooms_created
+        self._probe_game: Optional[Game] = None
+        self._legacy_game: Optional[Game] = None
 
     # -- legacy wrap -------------------------------------------------------
     @classmethod
@@ -185,6 +190,10 @@ class RoomFabric:
                      start_timers=start_timers, heartbeat=False,
                      supervisor=game.supervisor)
         fabric._games[fabric.default_room] = game
+        # the wrap's factory ignores its store argument (it returns the
+        # one pre-built game), so probe_game() must derive a separate
+        # probe engine from this game's parts instead
+        fabric._legacy_game = game
         return fabric
 
     # -- ownership ---------------------------------------------------------
@@ -336,6 +345,39 @@ class RoomFabric:
 
         self._startups[room] = asyncio.get_running_loop().create_task(
             _start())
+        return game
+
+    def probe_game(self) -> Game:
+        """The canary probe engine (ISSUE 18): a full Game over a
+        ``probe:<worker_id>:``-prefixed store view, playing the exact
+        serving surface players hit — but isolated on every axis that
+        matters: its store keys never collide with any room prefix
+        (rooms use ``room:<id>:`` or ''), it is absent from ``_games``
+        (so the directory, placement answers, heartbeat room counts,
+        and drain/handoff never see it), it runs no round clock or
+        startup generation (the prober seeds known-answer content
+        directly), and ``room=PROBE_ROOM`` swaps its engine metrics for
+        the null sink. Lazily built once per worker."""
+        from cassmantle_tpu.engine.game import PROBE_ROOM
+
+        if self._probe_game is not None:
+            return self._probe_game
+        view = NamespacedStore(self.store, f"probe:{self.worker_id}:")
+        legacy = self._legacy_game
+        if legacy is not None:
+            # for_game wrap: its factory returns the ONE shared game
+            # regardless of arguments, so derive the probe engine from
+            # the wrapped game's serving parts
+            game = Game(self.cfg, view, legacy.rounds.backend,
+                        embed=legacy.rounds.embed,
+                        similarity=legacy.scorer._similarity,
+                        blur_fn=legacy.blur_fn,
+                        supervisor=legacy.supervisor,
+                        room=PROBE_ROOM)
+        else:
+            game = self.game_factory(PROBE_ROOM, view)
+        game.rounds.rng = random.Random(f"{PROBE_ROOM}:{self.cfg.seed}")
+        self._probe_game = game
         return game
 
     async def rotate_room(self, room: str) -> None:

@@ -174,9 +174,21 @@ class MantleStore(StateStore):
             payload = b"*%d\r\n" % len(args)
             for a in args:
                 payload += b"$%d\r\n%s\r\n" % (len(a), a)
-            self._writer.write(payload)
-            await self._writer.drain()
-            return await self._read_reply()
+            try:
+                self._writer.write(payload)
+                await self._writer.drain()
+                return await self._read_reply()
+            except asyncio.CancelledError:
+                # a cancelled round trip (e.g. an aiohttp handler whose
+                # client gave up) may leave this command's reply in
+                # flight; the connection is shared, so the NEXT command
+                # would read the stale reply and every later caller
+                # desyncs. Drop the socket — the next op redials clean.
+                writer, self._reader, self._writer = \
+                    self._writer, None, None
+                if writer is not None:
+                    writer.close()
+                raise
 
     async def raw_command(self, *args: bytes):
         """One command round trip — the public form of ``_cmd`` for

@@ -50,6 +50,10 @@ def configure_observability(obs_cfg) -> None:
         capacity=obs_cfg.trace_capacity,
         sample_rate=obs_cfg.trace_sample_rate,
         max_spans_per_trace=obs_cfg.trace_max_spans,
+        pending_capacity=obs_cfg.trace_pending_capacity,
+        pending_ttl_s=obs_cfg.trace_pending_ttl_s,
+        tail_slow_default_s=obs_cfg.tail_slow_default_s,
+        tail_slow_routes=dict(obs_cfg.tail_slow_routes),
     )
     flight_recorder.set_capacity(obs_cfg.recorder_capacity)
     metrics.set_default_buckets(obs_cfg.latency_buckets_s)

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import bisect
 import dataclasses
+import os
 import time
 from collections import deque
 from typing import Callable, Deque, Dict, Optional, Sequence, Tuple
@@ -105,6 +106,30 @@ def default_objectives(cfg) -> Tuple[Objective, ...]:
             name="replication_lag", kind="gauge", metric="repl.lag",
             bound=obs.slo_repl_lag_max,
             description="worst follower lag in shipped log commands"),
+    ) + _probe_objectives(obs)
+
+
+def _probe_objectives(obs) -> Tuple[Objective, ...]:
+    """Black-box canary objectives (ISSUE 18): the probe plays the
+    real game surface, so its verdicts are the closest thing to a
+    player's experience the SLO set has. Absent entirely under
+    CASSMANTLE_NO_PROBER — a disabled prober must leave zero probe
+    artifacts, including the slo.burning{objective=probe_*} gauges
+    evaluate() would otherwise mint with no traffic."""
+    if os.environ.get("CASSMANTLE_NO_PROBER", "").lower() in (
+            "1", "true", "yes", "on"):
+        return ()
+    return (
+        Objective(
+            name="probe_success", kind="ratio",
+            good=("probe.ok",), bad=("probe.failures",),
+            objective_ratio=obs.probe_success_ratio,
+            description="synthetic canary probe success ratio"),
+        Objective(
+            name="probe_latency", kind="latency",
+            metric="probe.e2e_s",
+            threshold_s=obs.probe_p99_s, objective_ratio=0.99,
+            description="p99 of canary end-to-end probe time"),
     )
 
 

@@ -18,10 +18,24 @@ PAPERS.md). This module is the minimal native tracer that answers it:
   result arrays, so spans measure device work, not dispatch).
 
 Finished spans land in a bounded per-trace ring (LRU eviction at
-``capacity`` traces) queryable at ``/debugz?trace=<id>``. Sampling is
-head-based: the root span draws once against ``sample_rate``; an
-unsampled trace still propagates IDs (the header stays useful for log
-correlation) but records nothing.
+``capacity`` traces) queryable at ``/debugz?trace=<id>``.
+
+**Sampling is tail-based with a head floor (ISSUE 18).** The root span
+still draws once against ``sample_rate``, but the coin now decides
+*certainty*, not *existence*: a head-sampled trace records straight
+into the durable ring exactly as before (the healthy-baseline floor),
+while a non-head trace buffers its spans in a bounded **pending ring**
+until its root span completes. At completion a retention policy
+promotes the traces worth keeping — errored, slower than the per-route
+threshold (``ObsConfig.tail_slow_routes`` / ``tail_slow_default_s``),
+or explicitly marked via :meth:`Tracer.mark_retain` (shed, brownout-
+degraded, chaos-injected, canary probes) — into the durable ring;
+everything else is dropped and its pending occupancy reclaimed. Traces
+whose root never completes (client disconnect, watchdog kill) age out
+of the pending ring under a TTL sweep, counted ``obs.traces_abandoned``.
+``CASSMANTLE_NO_TAIL_SAMPLING=1`` (read per root-context mint) reverts
+to the exact pre-tail head-sampling behavior: the coin IS the sampling
+decision and nothing ever buffers.
 
 Each root context also carries a small mutable ``marks`` dict shared by
 the whole request: the queue writes ``queue_wait_s`` / ``service_s``
@@ -44,6 +58,7 @@ all land in ONE trace, merged across workers by
 from __future__ import annotations
 
 import contextvars
+import os
 import random
 import re
 import threading
@@ -56,19 +71,31 @@ from typing import Dict, List, Optional
 from cassmantle_tpu.utils.logging import metrics
 
 
+def _no_tail_sampling() -> bool:
+    """Kill switch, read per use (flipping the env mid-flight takes
+    effect on the next root context / observation, no restart)."""
+    return os.environ.get(
+        "CASSMANTLE_NO_TAIL_SAMPLING", "").lower() in \
+        ("1", "true", "yes", "on")
+
+
 class SpanContext:
     """Immutable-by-convention propagation record: who the ambient span
     is. ``marks`` is the one deliberately shared mutable field — the
-    per-request blackboard (see module docstring)."""
+    per-request blackboard (see module docstring). ``head`` says whether
+    the trace is already durably retained (head-sampled, or continued
+    from a remote hop): head spans record directly; non-head spans
+    buffer pending the root's retention verdict."""
 
-    __slots__ = ("trace_id", "span_id", "sampled", "marks")
+    __slots__ = ("trace_id", "span_id", "sampled", "marks", "head")
 
     def __init__(self, trace_id: str, span_id: str, sampled: bool,
-                 marks: Optional[dict] = None) -> None:
+                 marks: Optional[dict] = None, head: bool = True) -> None:
         self.trace_id = trace_id
         self.span_id = span_id
         self.sampled = sampled
         self.marks = marks if marks is not None else {}
+        self.head = head
 
 
 _current: contextvars.ContextVar[Optional[SpanContext]] = \
@@ -168,14 +195,27 @@ class Tracer:
         # evicted trace must be DROPPED, not resurrect a torn partial
         # trace that /debugz would serve with no hint its head is gone
         self._evicted: "OrderedDict[str, None]" = OrderedDict()
+        # trace_id -> {"spans": [...], "t": creation wall time} for
+        # non-head traces awaiting their root's retention verdict;
+        # insertion-ordered so the TTL sweep walks oldest-first
+        self._pending: "OrderedDict[str, dict]" = OrderedDict()
         self.capacity = capacity
         self.sample_rate = sample_rate
         self.max_spans_per_trace = max_spans_per_trace
+        self.pending_capacity = 512
+        self.pending_ttl_s = 120.0
+        self.tail_slow_default_s = 1.0
+        # root-span name ("http.post /compute_score") -> seconds
+        self.tail_slow_routes: Dict[str, float] = {}
         self._rng = rng or random.Random()
 
     def configure(self, *, capacity: Optional[int] = None,
                   sample_rate: Optional[float] = None,
-                  max_spans_per_trace: Optional[int] = None) -> None:
+                  max_spans_per_trace: Optional[int] = None,
+                  pending_capacity: Optional[int] = None,
+                  pending_ttl_s: Optional[float] = None,
+                  tail_slow_default_s: Optional[float] = None,
+                  tail_slow_routes: Optional[dict] = None) -> None:
         with self._lock:
             if capacity is not None:
                 self.capacity = max(1, int(capacity))
@@ -186,13 +226,37 @@ class Tracer:
                 self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
             if max_spans_per_trace is not None:
                 self.max_spans_per_trace = max(1, int(max_spans_per_trace))
+            if pending_capacity is not None:
+                self.pending_capacity = max(1, int(pending_capacity))
+                while len(self._pending) > self.pending_capacity:
+                    tid, _ = self._pending.popitem(last=False)
+                    self._remember_evicted(tid)
+                    metrics.inc("obs.traces_abandoned")
+            if pending_ttl_s is not None:
+                self.pending_ttl_s = max(0.0, float(pending_ttl_s))
+            if tail_slow_default_s is not None:
+                self.tail_slow_default_s = max(0.0,
+                                               float(tail_slow_default_s))
+            if tail_slow_routes is not None:
+                self.tail_slow_routes = {
+                    str(k): float(v) for k, v in
+                    (tail_slow_routes.items()
+                     if isinstance(tail_slow_routes, dict)
+                     else tail_slow_routes)}
 
     # -- context derivation ----------------------------------------------
     def new_root_ctx(self) -> SpanContext:
-        """Fresh trace; the head-based sampling decision happens here."""
-        sampled = (self.sample_rate >= 1.0
-                   or self._rng.random() < self.sample_rate)
-        return SpanContext(_new_id(16), _new_id(8), sampled, marks={})
+        """Fresh trace. The sampling coin is drawn here; under tail
+        sampling it decides head-certainty (the healthy-baseline floor)
+        and every trace starts sampled pending its retention verdict.
+        With ``CASSMANTLE_NO_TAIL_SAMPLING`` set the coin IS the
+        sampling decision — the exact pre-tail behavior."""
+        coin = (self.sample_rate >= 1.0
+                or self._rng.random() < self.sample_rate)
+        if _no_tail_sampling():
+            return SpanContext(_new_id(16), _new_id(8), coin, marks={})
+        return SpanContext(_new_id(16), _new_id(8), True, marks={},
+                           head=coin)
 
     def child_ctx(self, parent: Optional[SpanContext]) -> SpanContext:
         """A child of ``parent`` (same trace, same marks blackboard);
@@ -200,7 +264,7 @@ class Tracer:
         if parent is None:
             return self.new_root_ctx()
         return SpanContext(parent.trace_id, _new_id(8), parent.sampled,
-                           marks=parent.marks)
+                           marks=parent.marks, head=parent.head)
 
     def detached_ctx(self) -> SpanContext:
         """An always-unsampled context: lets shared infrastructure (a
@@ -235,6 +299,13 @@ class Tracer:
                 if ctx.trace_id in self._evicted:
                     metrics.inc("obs.spans_dropped")
                     return
+                if not ctx.head:
+                    # tail-pending: buffer until the root's retention
+                    # verdict (_finish_root). obs.spans counts only on
+                    # promotion — a dropped pending trace recorded
+                    # nothing, exactly like a pre-tail unsampled one.
+                    self._record_pending_locked(span, ctx.trace_id)
+                    return
                 while len(self._traces) >= self.capacity:
                     evicted_id, _ = self._traces.popitem(last=False)
                     self._remember_evicted(evicted_id)
@@ -251,6 +322,94 @@ class Tracer:
                 return
             spans.append(span)
         metrics.inc("obs.spans")
+
+    def _record_pending_locked(self, span: dict, trace_id: str) -> None:
+        pend = self._pending.get(trace_id)
+        if pend is None:
+            self._sweep_pending_locked(time.time())
+            while len(self._pending) >= self.pending_capacity:
+                # capacity pressure evicts the oldest pending trace —
+                # its root will find nothing to promote, same as a TTL
+                # abandonment, and late spans drop via _evicted
+                tid, _ = self._pending.popitem(last=False)
+                self._remember_evicted(tid)
+                metrics.inc("obs.traces_abandoned")
+            pend = {"spans": [], "t": time.time()}
+            self._pending[trace_id] = pend
+        spans = pend["spans"]
+        if len(spans) >= self.max_spans_per_trace:
+            metrics.inc("obs.spans_dropped")
+            spans[-1].setdefault("attrs", {})["truncated"] = True
+            return
+        spans.append(span)
+
+    def _sweep_pending_locked(self, now: float) -> None:
+        """Age out pending traces whose root never completed (client
+        disconnect, watchdog kill): oldest-first, stopping at the first
+        young entry — bounded work per sweep by construction."""
+        while self._pending:
+            tid, pend = next(iter(self._pending.items()))
+            if now - pend["t"] <= self.pending_ttl_s:
+                break
+            del self._pending[tid]
+            self._remember_evicted(tid)
+            metrics.inc("obs.traces_abandoned")
+
+    def mark_retain(self, reason: str,
+                    ctx: Optional[SpanContext] = None) -> None:
+        """Flag the (ambient) trace for tail retention regardless of its
+        latency — the hook the HTTP layer uses for shed/degraded
+        responses, chaos for injections, and the prober for its probes.
+        First reason wins (the earliest cause is the interesting one).
+        Harmless on head traces (they are already durable)."""
+        c = ctx if ctx is not None else _current.get()
+        if c is not None:
+            c.marks.setdefault("tail.retain", str(reason))
+
+    def _finish_root(self, ctx: SpanContext, name: str,
+                     duration_s: float, status: str) -> None:
+        """The tail-retention verdict, at root-span completion of a
+        non-head trace: promote (error / marked / slow) or drop —
+        either way the pending occupancy is reclaimed."""
+        slow = duration_s >= self.tail_slow_routes.get(
+            name, self.tail_slow_default_s)
+        mark = ctx.marks.get("tail.retain")
+        reason = None
+        if mark == "baseline":
+            # explicit demotion (the HTTP layer's routine-non-2xx
+            # verdict: 307 ownership hops, 4xx): slow still retains,
+            # the error status alone does not
+            reason = "slow" if slow else None
+        elif mark:
+            reason = mark
+        elif status != "ok":
+            reason = "error"
+        elif slow:
+            reason = "slow"
+        promoted = 0
+        with self._lock:
+            pend = self._pending.pop(ctx.trace_id, None)
+            if reason is not None and pend is not None:
+                while len(self._traces) >= self.capacity:
+                    evicted_id, _ = self._traces.popitem(last=False)
+                    self._remember_evicted(evicted_id)
+                    metrics.inc("obs.trace_evictions")
+                self._traces[ctx.trace_id] = pend["spans"]
+                promoted = len(pend["spans"])
+            else:
+                # completed-but-unretained (or already swept): the id
+                # must never re-enter pending via a straggler span
+                self._remember_evicted(ctx.trace_id)
+        if promoted:
+            metrics.inc("obs.spans", promoted)
+            metrics.inc("obs.tail_retained")
+            metrics.retain_exemplars(ctx.trace_id)
+            from cassmantle_tpu.obs.recorder import flight_recorder
+            flight_recorder.record(
+                "trace.tail_retained", trace=ctx.trace_id, route=name,
+                reason=reason, duration_s=round(duration_s, 6))
+        else:
+            metrics.discard_exemplars(ctx.trace_id)
 
     def _remember_evicted(self, trace_id: str) -> None:
         """Bounded (4x capacity) eviction memory; oldest ids age out —
@@ -293,19 +452,34 @@ class Tracer:
             raise
         finally:
             _current.reset(token)
+            duration_s = time.perf_counter() - start
             self.record_span(
                 name, ctx, parent_id=parent_id, start_wall=start_wall,
-                duration_s=time.perf_counter() - start, status=status,
+                duration_s=duration_s, status=status,
                 attrs=handle.attrs)
+            if root and ctx.sampled and not ctx.head:
+                # the trace's root just completed: issue the tail
+                # retention verdict (promote or reclaim). Spans with an
+                # explicit parent= continue someone else's trace — the
+                # verdict belongs to THAT root, never the hop.
+                self._finish_root(ctx, name, duration_s, status)
 
     # -- query ------------------------------------------------------------
     def get_trace(self, trace_id: str) -> Optional[List[dict]]:
+        """Durable ring first; a still-pending trace answers too (an
+        operator chasing a live request must not see a 404 that flips
+        to data one second later)."""
         with self._lock:
             spans = self._traces.get(trace_id)
+            if spans is None:
+                pend = self._pending.get(trace_id)
+                if pend is not None:
+                    spans = pend["spans"]
             return [dict(s) for s in spans] if spans is not None else None
 
     def trace_ids(self) -> List[str]:
-        """Oldest-first resident trace ids (the ``/debugz`` listing)."""
+        """Oldest-first resident trace ids (the ``/debugz`` listing) —
+        durable (retained) traces only; pending ones are in flight."""
         with self._lock:
             return list(self._traces.keys())
 
@@ -315,7 +489,27 @@ class Tracer:
                 "traces": len(self._traces),
                 "capacity": self.capacity,
                 "sample_rate": self.sample_rate,
+                "pending": len(self._pending),
+                "pending_capacity": self.pending_capacity,
             }
 
 
 tracer = Tracer()
+
+
+def _exemplar_probe():
+    """Metrics→trace linkage (utils.logging exemplars): every histogram
+    observation asks which trace it belongs to. Head traces are already
+    durable (certain → bucket exemplar written immediately); pending
+    tail traces park as candidates until their retention verdict. The
+    tail-sampling kill switch disables the linkage entirely — the
+    pre-tail exposition had no exemplars."""
+    if _no_tail_sampling():
+        return None
+    ctx = _current.get()
+    if ctx is None or not ctx.sampled:
+        return None
+    return ctx.trace_id, ctx.head
+
+
+metrics.set_exemplar_source(_exemplar_probe)

@@ -50,7 +50,7 @@ from aiohttp import WSMsgType, web
 from cassmantle_tpu import chaos
 from cassmantle_tpu.chaos import afault_point
 from cassmantle_tpu.config import FrameworkConfig, ObsConfig
-from cassmantle_tpu.engine.game import Game
+from cassmantle_tpu.engine.game import PROBE_ROOM, Game
 from cassmantle_tpu.fabric.rooms import RoomFabric
 from cassmantle_tpu.obs import configure_observability, flight_recorder, tracer
 from cassmantle_tpu.obs.device import device_metrics
@@ -64,7 +64,12 @@ from cassmantle_tpu.obs.trace import (
 )
 from cassmantle_tpu.serving import overload
 from cassmantle_tpu.serving.queue import OverloadShed
-from cassmantle_tpu.utils.logging import get_logger, merge_states, metrics
+from cassmantle_tpu.utils.logging import (
+    NULL_METRICS,
+    get_logger,
+    merge_states,
+    metrics,
+)
 
 log = get_logger("app")
 
@@ -83,6 +88,9 @@ _PROCESS = web.AppKey("process_metrics", ProcessMetrics)
 # ClientSession for cluster fan-outs, and the background obs tasks
 _PEER_HTTP = web.AppKey("peer_http", dict)
 _OBS_TASKS = web.AppKey("obs_tasks", list)
+# mutable holder for the canary prober (None when CASSMANTLE_NO_PROBER
+# disabled it at boot — /readyz then reports {"enabled": False})
+_PROBER = web.AppKey("prober", dict)
 
 
 def _env_flag_set(name: str) -> bool:
@@ -179,9 +187,37 @@ def _check_room_ownership(request: web.Request, fabric: RoomFabric,
     raise web.HTTPTemporaryRedirect(location=addr.rstrip("/") + str(url))
 
 
+async def _resolve_probe_game(request: web.Request,
+                              fabric: RoomFabric):
+    """(PROBE_ROOM, probe game) for an authenticated canary request
+    (ISSUE 18). The probe room exists on EVERY worker (no directory
+    entry, no ownership gate — a probe targets a specific worker and
+    must be answered by it, never redirected), is invisible to
+    outsiders (404, exactly like any unknown room), and lazily seeds
+    its known-answer round so a cross-worker probe landing on a cold
+    peer still plays a full game. The request's trace is marked: probe
+    traffic bypasses admission control (serving/queue.py) and is
+    always tail-retained."""
+    from cassmantle_tpu.obs.prober import ensure_probe_round
+
+    if not _is_cluster_peer(request, fabric):
+        # indistinguishable from a nonexistent room: the probe surface
+        # must not advertise itself to players
+        raise web.HTTPNotFound(text=f"unknown room {PROBE_ROOM!r}")
+    game = fabric.probe_game()
+    await ensure_probe_round(game)
+    ctx = current_ctx()
+    if ctx is not None:
+        ctx.marks["probe"] = True
+    tracer.mark_retain("probe")
+    return PROBE_ROOM, game
+
+
 async def _resolve_game(request: web.Request):
     """(room, game) for this request, after the ownership gate."""
     fabric = request.app[_FABRIC]
+    if _explicit_room(request) == PROBE_ROOM:
+        return await _resolve_probe_game(request, fabric)
     room = _room_of(request)
     if not fabric.directory.has_room(room):
         raise web.HTTPNotFound(text=f"unknown room {room!r}")
@@ -285,6 +321,15 @@ async def tracing_middleware(request: web.Request, handler):
         except web.HTTPException as exc:
             span.attrs["status"] = exc.status
             exc.headers["X-Trace-Id"] = span.trace_id
+            # tail-retention verdicts (ISSUE 18): a shed (503) is one
+            # of the traces the pending ring exists to keep; routine
+            # redirects/4xx (the 307 ownership hop, rate-limit 429s,
+            # bad input) are healthy-baseline — retaining every one
+            # would flush the durable ring with non-incidents
+            if exc.status == 503:
+                tracer.mark_retain("shed", span.ctx)
+            elif exc.status < 500:
+                tracer.mark_retain("baseline", span.ctx)
             raise
         except asyncio.CancelledError:
             raise
@@ -295,12 +340,19 @@ async def tracing_middleware(request: web.Request, handler):
             # carries the same id (JSON formatter), replacing aiohttp's
             # anonymous error log.
             span.attrs["status"] = 500
+            # the span exits cleanly (we return, not raise): mark it so
+            # the tail verdict still reads this trace as an error
+            tracer.mark_retain("error", span.ctx)
             log.exception("unhandled error serving %s %s",
                           request.method, request.path)
             return web.Response(
                 status=500, text="500 Internal Server Error",
                 headers={"X-Trace-Id": span.trace_id})
         span.attrs["status"] = response.status
+        if response.status >= 500:
+            # handler-returned 5xx (integrity failures surface this
+            # way): same retention verdict as a raised one
+            tracer.mark_retain("error", span.ctx)
         if not response.prepared:
             # a prepared response (WS handshake already sent) can't
             # take new headers
@@ -312,6 +364,7 @@ async def tracing_middleware(request: web.Request, handler):
                 # clients and operators can tell a browned-out image
                 # from a generation bug
                 response.headers["X-Quality-Degraded"] = f"tier-{tier}"
+                tracer.mark_retain("degraded", span.ctx)
         return response
 
 
@@ -367,6 +420,16 @@ async def handle_init(request: web.Request) -> web.Response:
     # (session_id, room) this response sets stays self-consistent
     session_id = _session_id(request) or str(uuid.uuid4())
     fabric = request.app[_FABRIC]
+    if _explicit_room(request) == PROBE_ROOM:
+        # canary init (ISSUE 18): resets the probe session to the
+        # unsolved known-answer round; no cookies (the prober carries
+        # ?session=) and no http.init — probe traffic must be
+        # invisible to player-facing counters
+        room, game = await _resolve_probe_game(request, fabric)
+        await game.init_client(session_id)
+        return web.json_response(
+            {"message": "Session initialized",
+             "session_id": session_id, "room": room})
     room = _explicit_room(request) or \
         fabric.directory.room_for_session(session_id)
     if not fabric.directory.has_room(room):
@@ -393,10 +456,14 @@ async def handle_status(request: web.Request) -> web.Response:
 
 
 async def handle_fetch_contents(request: web.Request) -> web.Response:
-    _, game = await _resolve_game(request)
+    room, game = await _resolve_game(request)
     session = _session_id(request) or str(uuid.uuid4())
     await game.ensure_client(session)
-    with metrics.timer("http.fetch_contents_s"):
+    # probe requests bypass the route histogram: the canary plays this
+    # path constantly, and its timings must not dilute the player
+    # latency series the SLOs and exemplars are built from (ISSUE 18)
+    registry = NULL_METRICS if room == PROBE_ROOM else metrics
+    with registry.timer("http.fetch_contents_s"):
         image_b64 = await game.fetch_masked_image_b64(session)
         prompt = await game.fetch_prompt_json(session)
         story = await game.fetch_story()
@@ -512,8 +579,11 @@ async def handle_compute_score(request: web.Request) -> web.Response:
         # scores (engine min_score), marked so clients/operators can
         # tell degradation from wrong guesses
     await game.ensure_client(session)
+    # same exclusion as fetch: the canary's score timings stay out of
+    # the player histogram (its own series is probe.e2e_s)
+    registry = NULL_METRICS if room == PROBE_ROOM else metrics
     try:
-        with metrics.timer("http.compute_score_s"):
+        with registry.timer("http.compute_score_s"):
             scores = await game.compute_client_scores(session, inputs)
     except OverloadShed as exc:
         # adaptive admission shed this request (serving/overload.py):
@@ -553,11 +623,14 @@ async def handle_clock(request: web.Request) -> web.WebSocketResponse:
     metrics.inc("ws.connections")
 
     async def sender() -> None:
+        # first tick goes out immediately: a fresh client (or a canary
+        # probe on a tight timeout) sees the clock without waiting out
+        # the first sleep
         while not ws.closed:
             if session:
                 await game.sessions.add_client(session)
-            await asyncio.sleep(1.0)
             await ws.send_json(await game.clock_payload())
+            await asyncio.sleep(1.0)
 
     send_task = asyncio.ensure_future(sender())
     try:
@@ -720,12 +793,21 @@ async def handle_metrics(request: web.Request) -> web.Response:
         else:
             federation = {"disabled": True}
     accept = request.headers.get("Accept", "")
+    if "application/openmetrics-text" in accept:
+        # OpenMetrics exposition (ISSUE 18): same series as the plain
+        # text form plus histogram-bucket exemplar annotations
+        # ({trace_id=...} → /debugz?trace=) and the # EOF terminator
+        return web.Response(
+            body=registry.openmetrics().encode(),
+            headers={"Content-Type": "application/openmetrics-text; "
+                                     "version=1.0.0; charset=utf-8"})
     if "text/plain" in accept or "openmetrics" in accept:
         return web.Response(
             body=registry.prometheus().encode(),
             headers={"Content-Type":
                      "text/plain; version=0.0.4; charset=utf-8"})
-    snap = registry.snapshot()
+    snap = registry.snapshot(
+        exemplars=request.query.get("exemplars") == "1")
     if federation is not None:
         snap["federation"] = federation
     return web.json_response(snap)
@@ -896,6 +978,15 @@ async def handle_readyz(request: web.Request) -> web.Response:
     # drains a worker also says whether HBM pressure or a compile
     # storm explains it
     status["device_telemetry"] = device_metrics.device_block()
+    # the canary block (ISSUE 18): last black-box probe verdict per
+    # target worker. Advisory like the SLO block — a failing canary is
+    # the "players can't play" smoking gun next to whatever white-box
+    # verdict drained the worker
+    prober = request.app[_PROBER].get("prober")
+    if prober is not None:
+        status["canary"] = prober.status_block()
+    else:
+        status["canary"] = {"enabled": False}
     if ready:
         return web.json_response(status)
     if status.get("state") != "draining":
@@ -1029,7 +1120,8 @@ async def handle_wordlist(request: web.Request) -> web.Response:
 
 def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
                start_timer: bool = True,
-               device_health: bool = False) -> web.Application:
+               device_health: bool = False,
+               self_addr: Optional[str] = None) -> web.Application:
     """Build the aiohttp app over a Game (legacy single-room callers)
     or a RoomFabric (sharded multi-room serving). A bare Game wraps
     into a one-room fabric whose default room is that game — identical
@@ -1060,6 +1152,7 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
     app[_OBS_CFG] = cfg.obs
     app[_PEER_HTTP] = {"session": None}
     app[_OBS_TASKS] = []
+    app[_PROBER] = {"prober": None}
     app[_SLO] = SloEngine(
         default_objectives(cfg),
         fast_window_s=cfg.obs.slo_fast_window_s,
@@ -1127,6 +1220,17 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
         if not _env_flag_set("CASSMANTLE_NO_SLO"):
             tasks.append(loop.create_task(
                 _slo_loop(app_[_SLO], cfg.obs.slo_eval_interval_s)))
+        # the synthetic canary (ISSUE 18): plays the real game surface
+        # over this worker's own listener (self_addr) and every live
+        # peer's. CASSMANTLE_NO_PROBER=1 at boot leaves ZERO probe
+        # artifacts — no task, no metrics, no store keys, no /readyz
+        # canary verdicts (the block reports enabled: false)
+        if not _env_flag_set("CASSMANTLE_NO_PROBER"):
+            from cassmantle_tpu.obs.prober import CanaryProber
+
+            prober = CanaryProber(fabric, cfg, self_addr=self_addr)
+            app_[_PROBER]["prober"] = prober
+            tasks.append(loop.create_task(prober.run()))
 
     async def on_shutdown(app_: web.Application) -> None:
         # graceful SIGTERM handoff (ISSUE 12): leave membership, drain
@@ -1520,7 +1624,12 @@ def _run_worker(args, cfg: FrameworkConfig) -> None:
                           store_addr=args.store,
                           worker_id=getattr(args, "worker_id", None),
                           advertise_addr=getattr(args, "advertise", None))
-    web.run_app(create_app(fabric, cfg, device_health=not args.fake),
+    web.run_app(create_app(fabric, cfg, device_health=not args.fake,
+                           # the canary dials this worker's own
+                           # listener over loopback — the probe must
+                           # traverse the real HTTP stack, middlewares
+                           # included, not call handlers in-process
+                           self_addr=f"http://127.0.0.1:{args.port}"),
                 host=args.host, port=args.port,
                 reuse_port=(args.workers > 1))
 

@@ -360,7 +360,17 @@ class BatchingQueue(Generic[T, R]):
                 self.name, reason="chaos",
                 retry_after_s=(self.admission.retry_after_s(depth)
                                if self.admission is not None else 1.0))
-        if self.admission is not None:
+        # canary-probe exemption (ISSUE 18): a probe-marked request
+        # (server/app.py _resolve_probe_game stamps the trace context)
+        # bypasses adaptive admission and never feeds the limiter's
+        # latency/capacity estimator — the probe measures the system,
+        # it must not steer it. The static max_pending wall and the
+        # degraded/device-lost fail-fasts still apply: a probe that
+        # can't be served should FAIL (that is its job), not queue-jump
+        # a dead device.
+        ctx = current_ctx()
+        probe = bool(ctx is not None and ctx.marks.get("probe"))
+        if self.admission is not None and not probe:
             verdict = self.admission.admit(depth, priority, deadline_s)
             if verdict is not None:
                 if verdict.reason == "predicted_late":
@@ -386,9 +396,10 @@ class BatchingQueue(Generic[T, R]):
         # trace propagation rides the future, not the queue tuple: the
         # (item, fut) shape is a stable seam (tests poke it directly),
         # and a future without these attributes simply goes untraced
-        fut._obs_ctx = current_ctx()        # type: ignore[attr-defined]
+        fut._obs_ctx = ctx                  # type: ignore[attr-defined]
         fut._obs_t = time.perf_counter()    # type: ignore[attr-defined]
         fut._obs_priority = priority        # type: ignore[attr-defined]
+        fut._obs_probe = probe              # type: ignore[attr-defined]
         q = (self._bg_queue if priority == PRIORITY_BACKGROUND
              else self._queue)
         try:
@@ -635,21 +646,28 @@ class BatchingQueue(Generic[T, R]):
             parent_id=parent.span_id if parent is not None else None,
             start_wall=start_wall, duration_s=service_s, status=status,
             attrs={"queue": self.name, "batch_size": len(futures)})
-        if self.admission is not None and status == "ok":
+        # probe members are invisible to the limiter's estimator AND
+        # the queue-wait histogram (ISSUE 18): the canary's timings
+        # belong to probe.e2e_s, never to the series that size
+        # admission or alarm players' latency
+        player = [f for f in futures
+                  if not getattr(f, "_obs_probe", False)]
+        if self.admission is not None and status == "ok" and player:
             # the AIMD signal: the batch's end-to-end latency is its
             # service time plus its slowest member's queue wait (error
             # batches excluded — a handler bug is not a latency signal)
             waits = [t_dispatch - t
                      for t in (getattr(f, "_obs_t", None)
-                               for f in futures) if t is not None]
+                               for f in player) if t is not None]
             self.admission.observe_batch(
-                max(waits) if waits else 0.0, service_s, len(futures))
+                max(waits) if waits else 0.0, service_s, len(player))
         for fut in futures:
             t_submit = getattr(fut, "_obs_t", None)
             if t_submit is None:
                 continue
             wait_s = t_dispatch - t_submit
-            metrics.observe(f"{self.name}.queue_wait_s", wait_s)
+            if not getattr(fut, "_obs_probe", False):
+                metrics.observe(f"{self.name}.queue_wait_s", wait_s)
             ctx = getattr(fut, "_obs_ctx", None)
             if ctx is None:
                 continue

@@ -30,6 +30,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -117,9 +118,16 @@ def _flat_name(key: SeriesKey) -> str:
 
 class Histogram:
     """Cumulative fixed-bucket histogram: constant memory per series,
-    all-time percentile estimates via in-bucket linear interpolation."""
+    all-time percentile estimates via in-bucket linear interpolation.
 
-    __slots__ = ("bounds", "counts", "total", "sum")
+    ``exemplars`` maps a bucket index to the LAST retained trace that
+    landed in that bucket — ``(trace_id, value, unix_ts)`` — so a p99
+    spike in any dashboard dereferences in one hop to a full waterfall
+    at ``/debugz?trace=``. Bounded by construction (one slot per
+    bucket); only rendered by the OpenMetrics exposition and the
+    ``?exemplars=1`` JSON form, never by :meth:`Metrics.prometheus`."""
+
+    __slots__ = ("bounds", "counts", "total", "sum", "exemplars")
 
     def __init__(self, bounds: Sequence[float]) -> None:
         self.bounds = tuple(sorted(float(b) for b in bounds))
@@ -127,6 +135,7 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
         self.total = 0
         self.sum = 0.0
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}
 
     def observe(self, value: float) -> None:
         # Prometheus buckets are le= (inclusive upper bounds)
@@ -184,6 +193,19 @@ class Metrics:
         self._gauges: Dict[SeriesKey, float] = {}
         self._hists: Dict[SeriesKey, Histogram] = {}
         self._default_buckets = tuple(default_buckets)
+        # exemplar machinery (ISSUE 18): an injected source answers
+        # "which trace is this observation from, and is that trace
+        # already durably retained?" — (trace_id, certain). Certain
+        # observations write their bucket exemplar immediately;
+        # uncertain ones (a pending tail-sampled trace whose retention
+        # verdict lands at root completion) park as candidates until
+        # retain_exemplars/discard_exemplars resolves them. A fresh
+        # Metrics() has no source, so exemplars are strictly opt-in.
+        self._exemplar_source = None
+        self._exemplar_pending: \
+            "OrderedDict[str, List[Tuple[Histogram, int, float, float]]]" \
+            = OrderedDict()
+        self._exemplar_pending_cap = 256
 
     def set_default_buckets(self, bounds: Sequence[float]) -> None:
         """Default bounds for histograms created AFTER this call;
@@ -219,12 +241,52 @@ class Metrics:
         """Record into the series' histogram. ``buckets`` applies only
         on first observation of a series (fixing its bounds for life)."""
         key = _series_key(name, labels)
+        source = self._exemplar_source
+        tagged = source() if source is not None else None
         with self._lock:
             hist = self._hists.get(key)
             if hist is None:
                 hist = Histogram(buckets or self._default_buckets)
                 self._hists[key] = hist
             hist.observe(value)
+            if tagged is not None:
+                trace_id, certain = tagged
+                idx = bisect.bisect_left(hist.bounds, value)
+                if certain:
+                    hist.exemplars[idx] = (trace_id, float(value),
+                                           time.time())
+                else:
+                    slots = self._exemplar_pending.get(trace_id)
+                    if slots is None:
+                        slots = []
+                        self._exemplar_pending[trace_id] = slots
+                        while len(self._exemplar_pending) > \
+                                self._exemplar_pending_cap:
+                            self._exemplar_pending.popitem(last=False)
+                    slots.append((hist, idx, float(value), time.time()))
+
+    # -- exemplars (ISSUE 18) ---------------------------------------------
+    def set_exemplar_source(self, fn) -> None:
+        """Install the trace-association callback ``fn() -> None |
+        (trace_id, certain)`` called on every histogram observation.
+        The obs layer owns the policy (ambient span context, kill
+        switch); this registry only stores the linkage."""
+        self._exemplar_source = fn
+
+    def retain_exemplars(self, trace_id: str) -> None:
+        """A pending trace was tail-retained: promote its parked
+        candidate observations into their buckets' exemplar slots
+        (last-writer-wins = last retained trace per bucket)."""
+        with self._lock:
+            for hist, idx, value, ts in \
+                    self._exemplar_pending.pop(trace_id, ()):
+                hist.exemplars[idx] = (trace_id, value, ts)
+
+    def discard_exemplars(self, trace_id: str) -> None:
+        """A pending trace was dropped at root completion: its parked
+        candidates must never surface as exemplars."""
+        with self._lock:
+            self._exemplar_pending.pop(trace_id, None)
 
     @contextmanager
     def timer(self, name: str, labels: Optional[Dict[str, str]] = None):
@@ -313,11 +375,15 @@ class Metrics:
             return True
 
     # -- exposition -------------------------------------------------------
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self, exemplars: bool = False) -> Dict[str, object]:
         """The backward-compatible JSON shape: flat counters/gauges plus
         ``timings`` entries of ``{count, mean_s, p50_s, p99_s}`` (the
         ``_s`` keys are historical; non-seconds histograms like
-        ``*.batch_size`` report their native unit under them)."""
+        ``*.batch_size`` report their native unit under them).
+        ``exemplars=True`` (the ``/metrics?exemplars=1`` form) adds a
+        top-level ``exemplars`` map — per histogram, per bucket upper
+        bound, the last retained trace — WITHOUT touching the default
+        key set (pinned backward-compatible)."""
         with self._lock:
             timings = {
                 _flat_name(key): {
@@ -328,13 +394,28 @@ class Metrics:
                 }
                 for key, h in self._hists.items() if h.total
             }
-            return {
+            out: Dict[str, object] = {
                 "counters": {_flat_name(k): v
                              for k, v in self._counters.items()},
                 "gauges": {_flat_name(k): v
                            for k, v in self._gauges.items()},
                 "timings": timings,
             }
+            if exemplars:
+                ex: Dict[str, dict] = {}
+                for key, h in self._hists.items():
+                    if not h.exemplars:
+                        continue
+                    per = {}
+                    for idx, (tid, value, ts) in \
+                            sorted(h.exemplars.items()):
+                        le = ("+Inf" if idx >= len(h.bounds)
+                              else repr(float(h.bounds[idx])))
+                        per[le] = {"trace_id": tid, "value": value,
+                                   "ts": ts}
+                    ex[_flat_name(key)] = per
+                out["exemplars"] = ex
+            return out
 
     def prometheus(self) -> str:
         """Text exposition (format version 0.0.4): counters as
@@ -383,6 +464,68 @@ class Metrics:
             lines.append(f"{pname}_count{suffix} {total}")
         return "\n".join(lines) + "\n"
 
+    def openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition (the
+        ``application/openmetrics-text`` negotiation): same series as
+        :meth:`prometheus` — counters declared on their BASE name with
+        ``_total`` samples per the OpenMetrics grammar — plus
+        ``# {trace_id="..."} value ts`` exemplar annotations on
+        histogram ``_bucket`` lines and the mandatory ``# EOF``
+        terminator. The plain Prometheus exposition stays byte-identical
+        (exemplars render ONLY here and in ``snapshot(exemplars=True)``)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (h.bounds, tuple(h.counts), h.sum, h.total,
+                         dict(h.exemplars))
+                     for k, h in self._hists.items()}
+        lines = []
+        typed = set()
+
+        def _emit_type(pname: str, kind: str) -> None:
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} {kind}")
+
+        def _fmt(v: float) -> str:
+            return repr(v) if isinstance(v, float) and not v.is_integer() \
+                else str(int(v))
+
+        def _exemplar(ex) -> str:
+            if ex is None:
+                return ""
+            trace_id, value, ts = ex
+            return (f' # {{trace_id="{trace_id}"}} '
+                    f"{repr(float(value))} {repr(float(ts))}")
+
+        for key in sorted(counters):
+            pname, suffix = _prom_name(key[0], key[1])
+            _emit_type(pname, "counter")
+            lines.append(f"{pname}_total{suffix} {_fmt(counters[key])}")
+        for key in sorted(gauges):
+            pname, suffix = _prom_name(key[0], key[1])
+            _emit_type(pname, "gauge")
+            lines.append(f"{pname}{suffix} {_fmt(gauges[key])}")
+        for key in sorted(hists):
+            bounds, counts, total_sum, total, exemplars = hists[key]
+            pname, suffix = _prom_name(key[0], key[1])
+            _emit_type(pname, "histogram")
+            label_body = suffix[1:-1] + "," if suffix else ""
+            cum = 0
+            for i, (bound, count) in enumerate(zip(bounds, counts)):
+                cum += count
+                lines.append(
+                    f'{pname}_bucket{{{label_body}le="{_fmt(bound)}"}} '
+                    f"{cum}{_exemplar(exemplars.get(i))}")
+            cum += counts[-1]
+            lines.append(
+                f'{pname}_bucket{{{label_body}le="+Inf"}} {cum}'
+                f"{_exemplar(exemplars.get(len(bounds)))}")
+            lines.append(f"{pname}_sum{suffix} {repr(float(total_sum))}")
+            lines.append(f"{pname}_count{suffix} {total}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
 
 def _parse_labels(raw) -> Optional[Dict[str, str]]:
     if not raw:
@@ -422,5 +565,32 @@ def merge_states(states: Sequence[Tuple[str, Dict[str, list]]]
                                         hsum, total)
     return merged
 
+
+class _NullMetrics:
+    """A no-op registry with the Metrics emission surface. The canary
+    probe Game (obs/prober.py) runs the REAL engine code paths but must
+    leave zero marks on player-facing series (``game.guesses`` feeds
+    leaderboard dashboards; cache counters feed capacity planning), so
+    it swaps this in for its instance-level emissions. Reads are not
+    supported on purpose — nothing should aggregate from a null sink."""
+
+    def inc(self, name, value=1.0, labels=None):
+        pass
+
+    def gauge(self, name, value, labels=None):
+        pass
+
+    def remove_gauge(self, name, labels=None):
+        pass
+
+    def observe(self, name, value, labels=None, buckets=None):
+        pass
+
+    @contextmanager
+    def timer(self, name, labels=None):
+        yield
+
+
+NULL_METRICS = _NullMetrics()
 
 metrics = Metrics()

@@ -97,6 +97,8 @@ FAST_MODULES = frozenset({
     "test_js_runtime", "test_layers_norm", "test_masking",
     "test_masking_agreement", "test_multihost",
     "test_native_store", "test_obs", "test_obs_cluster", "test_ops",
+    # canary prober (ISSUE 18): in-process HTTP probes, no device work
+    "test_prober",
     # overload control plane (ISSUE 13): limiter/ladder/priority units
     # plus the ~10s spawned-worker goodput smoke — the overload
     # acceptance bar must run in every quick sweep

@@ -475,6 +475,24 @@ async def test_metrics_content_negotiation():
         assert "# TYPE cassmantle_http_init_total counter" in text
         assert 'cassmantle_score_batch_seconds_bucket{le="+Inf"}' in text
         assert "cassmantle_score_batch_seconds_count" in text
+        assert "# EOF" not in text       # plain Prometheus: no OM marks
+        # OpenMetrics negotiation (ISSUE 18): counters declared on the
+        # base name, mandatory # EOF terminator, exemplar-capable
+        res = await client.get(
+            "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert res.status == 200
+        assert "application/openmetrics-text" in \
+            res.headers["Content-Type"]
+        om = await res.text()
+        assert om.endswith("# EOF\n")
+        assert "# TYPE cassmantle_http_init counter" in om
+        assert "cassmantle_http_init_total" in om
+        # ?exemplars=1 adds the map WITHOUT touching the default keys
+        res = await client.get("/metrics", params={"exemplars": "1"})
+        data = await res.json()
+        assert "exemplars" in data
+        assert {"counters", "gauges", "timings"} <= set(data)
     finally:
         await client.close()
 
@@ -562,3 +580,171 @@ async def test_round_generation_gets_background_trace():
         assert gen_traces, "round.generate root span not recorded"
     finally:
         await client.close()
+
+
+# -- tail-based trace retention (ISSUE 18) ---------------------------------
+
+def _root(tr, name, sleep_s=0.0, status="ok", mark=None):
+    """One completed root trace; returns its trace id."""
+    import time as _time
+
+    try:
+        with tr.span(name, root=True) as h:
+            if mark is not None:
+                tr.mark_retain(mark, h.ctx)
+            if sleep_s:
+                _time.sleep(sleep_s)
+            if status != "ok":
+                raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    return h.trace_id
+
+
+def test_tail_retains_slow_drops_healthy():
+    """The acceptance bar: with the head floor at 0, a forced-slow
+    request is retained while EVERY healthy same-route request drops —
+    interesting-trace recall without head-sampling's storage cost."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    tr = Tracer(capacity=64, sample_rate=0.0)
+    tr.configure(tail_slow_default_s=0.05)
+    retained_before = metrics.counter_total("obs.tail_retained")
+    healthy = [_root(tr, "http.get /fetch") for _ in range(30)]
+    slow = _root(tr, "http.get /fetch", sleep_s=0.08)
+    assert all(tr.get_trace(t) is None for t in healthy)
+    spans = tr.get_trace(slow)
+    assert spans and spans[0]["status"] == "ok"
+    assert metrics.counter_total("obs.tail_retained") == \
+        retained_before + 1
+    # verdicts reclaim pending occupancy either way
+    assert tr.stats()["pending"] == 0
+
+
+def test_tail_retains_errors_and_marks():
+    tr = Tracer(capacity=8, sample_rate=0.0)
+    tr.configure(tail_slow_default_s=10.0)
+    errored = _root(tr, "http.post /x", status="error")
+    assert tr.get_trace(errored)[0]["status"] == "error"
+    # fast + ok but explicitly marked (shed/degraded/chaos/probe hook)
+    marked = _root(tr, "http.post /x", mark="probe")
+    assert tr.get_trace(marked) is not None
+    # the per-route threshold overrides the default
+    tr.configure(tail_slow_routes={"http.get /slowroute": 0.0})
+    routed = _root(tr, "http.get /slowroute")
+    assert tr.get_trace(routed) is not None
+
+
+def test_tail_baseline_demotion():
+    """The HTTP layer's routine-non-2xx verdict ("baseline": 307
+    ownership hops, 4xx) demotes the error status — slow still
+    retains, the status alone does not."""
+    tr = Tracer(capacity=8, sample_rate=0.0)
+    tr.configure(tail_slow_default_s=0.05)
+    routine = _root(tr, "http.get /init", status="error",
+                    mark="baseline")
+    assert tr.get_trace(routine) is None
+    slow = _root(tr, "http.get /init", sleep_s=0.08, mark="baseline")
+    assert tr.get_trace(slow) is not None
+
+
+def test_head_sampled_traces_stay_durable():
+    """The healthy-baseline floor: a head-coin trace is durable
+    immediately, never parked in pending."""
+    tr = Tracer(capacity=8, sample_rate=1.0)
+    tid = _root(tr, "http.get /fetch")
+    assert tr.get_trace(tid) is not None
+    assert tr.stats()["pending"] == 0
+
+
+def test_pending_ttl_abandonment():
+    """A pending trace whose root never completes (client disconnect,
+    watchdog kill) ages out and its id is poisoned against torn
+    revival."""
+    import time as _time
+
+    from cassmantle_tpu.utils.logging import metrics
+
+    tr = Tracer(capacity=8, sample_rate=0.0)
+    tr.configure(pending_ttl_s=0.0)
+    abandoned_before = metrics.counter_total("obs.traces_abandoned")
+    orphan = tr.new_root_ctx()
+    assert not orphan.head
+    tr.record_span("w.orphan", tr.child_ctx(orphan),
+                   start_wall=_time.time(), duration_s=0.0)
+    assert tr.stats()["pending"] == 1
+    _time.sleep(0.002)
+    # the next pending insert sweeps oldest-first
+    other = tr.new_root_ctx()
+    tr.record_span("w.other", tr.child_ctx(other),
+                   start_wall=_time.time(), duration_s=0.0)
+    assert metrics.counter_total("obs.traces_abandoned") == \
+        abandoned_before + 1
+    assert tr.get_trace(orphan.trace_id) is None
+    tr.record_span("w.late", tr.child_ctx(orphan),
+                   start_wall=_time.time(), duration_s=0.0)
+    assert tr.get_trace(orphan.trace_id) is None
+
+
+def test_no_tail_sampling_kill_switch_is_pre_tail_exact(monkeypatch):
+    """CASSMANTLE_NO_TAIL_SAMPLING=1: the sampling coin IS the
+    decision again — same rng stream, no pending buffer, no exemplar
+    linkage. (Per-read: no restart needed.)"""
+    import random as _random
+
+    from cassmantle_tpu.obs.trace import _exemplar_probe
+
+    monkeypatch.setenv("CASSMANTLE_NO_TAIL_SAMPLING", "1")
+    tr = Tracer(capacity=32, sample_rate=0.5,
+                rng=_random.Random(7))
+    reference = _random.Random(7)
+    for _ in range(32):
+        ctx = tr.new_root_ctx()
+        assert ctx.sampled == (reference.random() < 0.5)
+        assert ctx.head    # nothing is ever deferred
+    # sampled roots are durable immediately; unsampled record nothing;
+    # the pending buffer never fills either way
+    kept = [_root(tr, "http.get /fetch") for _ in range(16)]
+    recorded = [t for t in kept if tr.get_trace(t) is not None]
+    assert 0 < len(recorded) < 16
+    assert tr.stats()["pending"] == 0
+    with tr.span("http.get /x", root=True):
+        assert _exemplar_probe() is None
+
+
+def test_exemplars_follow_retention_verdict():
+    """A histogram observation inside a pending trace parks as an
+    exemplar candidate: retention promotes it into the bucket (visible
+    in snapshot(exemplars=True) and the OpenMetrics exposition),
+    a drop discards it — and the plain Prometheus exposition never
+    shows exemplars at all."""
+    from cassmantle_tpu.utils.logging import metrics
+
+    rate, slow = tracer.sample_rate, tracer.tail_slow_default_s
+    tracer.configure(sample_rate=0.0, tail_slow_default_s=10.0)
+    try:
+        with tracer.span("exms.root", root=True) as keep:
+            metrics.observe("exms.kept_s", 0.004)
+            tracer.mark_retain("probe", keep.ctx)
+        with tracer.span("exms.root", root=True):
+            metrics.observe("exms.dropped_s", 0.004)
+        ex = metrics.snapshot(exemplars=True)["exemplars"]
+        kept = {e["trace_id"] for e in ex["exms.kept_s"].values()}
+        assert kept == {keep.trace_id}
+        assert "exms.dropped_s" not in ex
+        # a dropped trace's same-bucket observation must not clobber
+        # the retained exemplar
+        with tracer.span("exms.root", root=True):
+            metrics.observe("exms.kept_s", 0.004)
+        ex = metrics.snapshot(exemplars=True)["exemplars"]
+        assert {e["trace_id"] for e in ex["exms.kept_s"].values()} == \
+            {keep.trace_id}
+        # default snapshot shape untouched (pinned backward-compatible)
+        assert "exemplars" not in metrics.snapshot()
+        om = metrics.openmetrics()
+        assert om.endswith("# EOF\n")
+        assert f'# {{trace_id="{keep.trace_id}"}}' in om
+        prom = metrics.prometheus()
+        assert "trace_id=" not in prom and "# EOF" not in prom
+    finally:
+        tracer.configure(sample_rate=rate, tail_slow_default_s=slow)

@@ -1,0 +1,372 @@
+"""Canary prober tests (ISSUE 18, fast tier).
+
+The synthetic probe plays the real HTTP surface (/init → /clock tick →
+/fetch/contents → /compute_score) against a known-answer probe room,
+so these tests pin the properties the canary's verdicts depend on:
+
+- **determinism**: every worker derives the SAME probe round from the
+  fixed sentence (cross-worker probes know remote answers a priori),
+  and seeding is idempotent;
+- **isolation**: probe traffic leaves ZERO player-visible artifacts —
+  no game.guesses, no http.init, no store keys outside the
+  ``probe:<worker>:`` prefix, no admission-limiter estimate movement,
+  and the probe room answers 404 to non-cluster outsiders;
+- **verdicts**: a healthy worker probes ok; a dead one fails within
+  the single probe that observed it, counts ``probe.failures``, lands
+  a ``probe.fail`` flight-recorder event, and its trace is retained
+  and linked from a ``probe.e2e_s`` bucket exemplar;
+- **kill switch**: ``CASSMANTLE_NO_PROBER=1`` leaves zero probe
+  artifacts — no background task, no SLO objectives, no probe metrics.
+"""
+
+import dataclasses
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from cassmantle_tpu.config import test_config as _tiny_config
+from cassmantle_tpu.engine.content import (
+    FakeContentBackend,
+    hash_embed,
+    hash_similarity,
+)
+from cassmantle_tpu.engine.game import PROBE_ROOM, Game
+from cassmantle_tpu.engine.rounds import IMAGE_KEY
+from cassmantle_tpu.engine.store import MemoryStore
+from cassmantle_tpu.fabric.rooms import RoomFabric
+from cassmantle_tpu.obs.prober import (
+    CanaryProber,
+    ensure_probe_round,
+    probe_answers,
+    probe_state,
+    prober_disabled,
+)
+from cassmantle_tpu.obs.recorder import flight_recorder
+from cassmantle_tpu.obs.trace import tracer
+from cassmantle_tpu.utils.logging import metrics
+
+
+def make_cfg(num_rooms=1, **obs_kw):
+    cfg = _tiny_config()
+    return cfg.replace(
+        game=dataclasses.replace(
+            cfg.game, rate_limit_default=1e6, rate_limit_api=1e6,
+            time_per_prompt=30.0),
+        fabric=dataclasses.replace(
+            cfg.fabric, num_rooms=num_rooms, heartbeat_s=30.0),
+        obs=dataclasses.replace(
+            cfg.obs, slo_eval_interval_s=300.0,
+            process_sample_interval_s=60.0,
+            cluster_fanout_timeout_s=1.0, probe_interval_s=3600.0,
+            probe_timeout_s=2.0, **obs_kw),
+    )
+
+
+def make_game(cfg, store=None, room="default"):
+    return Game(cfg, store or MemoryStore(),
+                FakeContentBackend(image_size=32),
+                hash_embed, hash_similarity, room=room)
+
+
+def counter_base_total(counters, base):
+    """Sum one counter across its label sets (flat snapshot keys are
+    ``name`` or ``name{k=v}``)."""
+    return sum(v for k, v in counters.items()
+               if k.split("{", 1)[0] == base)
+
+
+async def _serve(cfg, game):
+    """A legacy single-game app on a real socket (the for_game wrap —
+    probe_game() must derive an isolated engine even from this path)."""
+    from cassmantle_tpu.server import app as app_mod
+
+    app = app_mod.create_app(game, cfg, start_timer=False)
+    server = TestServer(app)
+    await server.start_server()
+    fabric = app[app_mod._FABRIC]
+    url = f"http://127.0.0.1:{server.port}"
+    fabric.membership.addr = url
+    return server, fabric, url
+
+
+# -- determinism + seeding -------------------------------------------------
+
+def test_probe_state_identical_across_workers():
+    cfg = make_cfg()
+    a, b = make_game(cfg), make_game(cfg)
+    sa, sb = probe_state(a), probe_state(b)
+    assert sa["masks"] == sb["masks"] and sa["tokens"] == sb["tokens"]
+    answers = probe_answers(sa)
+    assert answers and all(v not in ("", "*") for v in answers.values())
+    # memoized: the derivation runs once per game
+    assert probe_state(a) is sa
+
+
+@pytest.mark.asyncio
+async def test_ensure_probe_round_seeds_once_and_keeps_clock_alive():
+    cfg = make_cfg()
+    store = MemoryStore()
+    game = make_game(cfg, store, room=PROBE_ROOM)
+    state = await ensure_probe_round(game)
+    prompt = await game.rounds.fetch_current_prompt()
+    assert prompt["masks"] == state["masks"]
+    assert await game.rounds.current_image_version() == 1
+    assert await game.rounds.remaining() > 60.0
+    # idempotent: a second call re-seeds nothing (the stored image is
+    # the SAME object — a rewrite would mint fresh bytes)
+    img = await store.hget(IMAGE_KEY, "current")
+    await ensure_probe_round(game)
+    assert await store.hget(IMAGE_KEY, "current") is img
+
+
+# -- probe room isolation --------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_probe_leaves_zero_player_artifacts():
+    """The acceptance bar: a full successful probe moves no player
+    surface — store keys stay under the probe prefix, game.guesses and
+    http.init stay flat, and the probe room never enters the fabric's
+    room map."""
+    import aiohttp
+
+    cfg = make_cfg()
+    store = MemoryStore()
+    game = make_game(cfg, store)
+    server, fabric, url = await _serve(cfg, game)
+    prober = CanaryProber(fabric, cfg, self_addr=url)
+    keys_before = set(store._data)
+    before = dict(metrics.snapshot()["counters"])
+    try:
+        verdict = await prober.probe_once()
+        assert verdict["ok"], verdict
+        counters = dict(metrics.snapshot()["counters"])
+        assert counter_base_total(counters, "probe.ok") == \
+            counter_base_total(before, "probe.ok") + 1
+        for base in ("game.guesses", "http.init"):
+            assert counter_base_total(counters, base) == \
+                counter_base_total(before, base), base
+        new_keys = set(store._data) - keys_before
+        assert new_keys, "the probe room must have seeded"
+        assert all(k.startswith(f"probe:{fabric.worker_id}:")
+                   for k in new_keys), sorted(new_keys)
+        # the probe game is NOT in the room directory/placement map
+        assert PROBE_ROOM not in fabric._games
+        # probes are always tail-retained: the ok trace is queryable
+        assert tracer.get_trace(verdict["trace"])
+        # /readyz carries the canary block (advisory)
+        async with aiohttp.ClientSession() as http:
+            body = await (await http.get(url + "/readyz")).json()
+        assert "canary" in body
+    finally:
+        await prober.close()
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_probe_room_is_cluster_gated(monkeypatch):
+    """?room=__probe__ answers 404 "unknown room" to anyone who is not
+    loopback/member/token-bearing — outsiders cannot discover or play
+    the probe room. The cluster token opens it (the cross-worker path)."""
+    import aiohttp
+
+    from cassmantle_tpu.server import app as app_mod
+
+    cfg = make_cfg()
+    server, fabric, url = await _serve(cfg, make_game(cfg))
+    await fabric._ensure_cluster_key()
+    try:
+        # the test client connects from loopback, which is ALSO the
+        # advertised member host — disable both ambient trust legs so
+        # only the explicit token can open the gate
+        monkeypatch.setattr(app_mod, "_is_loopback",
+                            lambda request: False)
+        monkeypatch.setattr(fabric, "peer_hosts", lambda: set())
+        params = {"room": PROBE_ROOM, "session": "x"}
+        async with aiohttp.ClientSession() as http:
+            res = await http.get(url + "/init", params=params)
+            assert res.status == 404
+            res = await http.get(
+                url + "/init", params=params,
+                headers={"X-Cluster-Auth": fabric.cluster_token()})
+            assert res.status == 200
+    finally:
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_probe_submits_bypass_admission_estimator():
+    """A probe-marked request skips admission.admit and never feeds
+    observe_batch — the limiter's estimate and the queue-wait histogram
+    must not move (probes measure the system; they must not steer it)."""
+    from cassmantle_tpu.serving.overload import AdaptiveLimiter
+    from cassmantle_tpu.serving.queue import BatchingQueue
+
+    limiter = AdaptiveLimiter("probeq", target_s=0.5)
+    q = BatchingQueue(lambda items: [1.0 for _ in items], max_batch=4,
+                      max_delay_ms=1.0, name="probeq",
+                      admission=limiter)
+    try:
+        limit_before = limiter._limit
+        with tracer.span("probe.run", root=True) as s:
+            tracer.mark_retain("probe", s.ctx)
+            s.ctx.marks["probe"] = True
+            assert await q.submit("canary") == 1.0
+        assert limiter._limit == limit_before
+        assert metrics.gauge_values("probeq.admit_limit") == []
+        assert metrics.hist_totals("probeq.queue_wait_s") is None
+        # a PLAYER submit feeds the estimator as before
+        assert await q.submit("player") == 1.0
+        assert metrics.gauge_values("probeq.admit_limit") != []
+        assert metrics.hist_totals("probeq.queue_wait_s") is not None
+    finally:
+        await q.stop()
+
+
+# -- verdicts --------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_failed_probe_counts_and_links_exemplar():
+    """A dead target fails the single probe that observed it: the
+    verdict names the leg, probe.failures counts, probe.fail lands in
+    the flight recorder, the trace is tail-retained, and the
+    probe.e2e_s bucket exemplar points at exactly that trace."""
+    cfg = make_cfg()
+    server, fabric, url = await _serve(cfg, make_game(cfg))
+    prober = CanaryProber(fabric, cfg, self_addr=url)
+    try:
+        ok = await prober.probe_once()
+        assert ok["ok"], ok
+        await server.close()          # the worker "dies"
+        failures = metrics.counter_total("probe.failures")
+        watermark = flight_recorder.stats()["total_recorded"]
+        verdict = await prober.probe_once()
+        assert not verdict["ok"]
+        assert verdict["error"]
+        assert metrics.counter_total("probe.failures") == failures + 1
+        events = [e for e in flight_recorder.tail(kind="probe.fail")
+                  if e["seq"] > watermark]
+        assert len(events) == 1
+        assert events[0]["trace"] == verdict["trace"]
+        assert tracer.get_trace(verdict["trace"])
+        ex = metrics.snapshot(exemplars=True)["exemplars"]
+        linked = {e["trace_id"]
+                  for e in ex.get("probe.e2e_s", {}).values()}
+        assert verdict["trace"] in linked
+        # the streak feeds the /readyz canary block
+        block = prober.status_block()
+        assert block["consecutive_failures"] == 1
+        assert block["ok"] is False
+    finally:
+        await prober.close()
+
+
+@pytest.mark.asyncio
+async def test_cross_worker_probe_over_membership():
+    """Worker A probes worker B through the membership table with the
+    cluster token: B's probe room seeds under B's OWN prefix in the
+    shared store, and the verdict is recorded per target."""
+    from cassmantle_tpu.server.app import create_app
+    from cassmantle_tpu.serving.supervisor import ServingSupervisor
+
+    cfg = make_cfg(num_rooms=2)
+    store = MemoryStore()
+
+    async def start(worker_id):
+        sup = ServingSupervisor()
+        backend = FakeContentBackend(image_size=32)
+
+        def factory(room, room_store):
+            return Game(cfg, room_store, backend, hash_embed,
+                        hash_similarity, supervisor=sup, room=room)
+
+        fabric = RoomFabric(cfg, store, factory, worker_id=worker_id,
+                            start_timers=False, heartbeat=False,
+                            supervisor=sup)
+        server = TestServer(create_app(fabric, cfg, start_timer=False))
+        await server.start_server()
+        fabric.membership.addr = f"http://127.0.0.1:{server.port}"
+        return server, fabric
+
+    server_a, fabric_a = await start("w-a")
+    server_b, fabric_b = await start("w-b")
+    try:
+        for f in (fabric_a, fabric_b):
+            await f.membership.heartbeat(len(f._games))
+        for f in (fabric_a, fabric_b):
+            await f.membership.refresh()
+        prober = CanaryProber(fabric_a, cfg,
+                              self_addr=fabric_a.membership.addr)
+        try:
+            targets = dict(prober._targets())
+            assert set(targets) == {"w-a", "w-b"}
+            await prober.probe_all()
+            block = prober.status_block()
+            assert set(block["targets"]) == {"w-a", "w-b"}
+            assert block["ok"] is True, block
+            probe_keys = [k for k in store._data
+                          if k.startswith("probe:")]
+            owners = {k.split(":", 2)[1] for k in probe_keys}
+            assert owners == {"w-a", "w-b"}
+        finally:
+            await prober.close()
+    finally:
+        await server_a.close()
+        await server_b.close()
+
+
+# -- kill switch -----------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_no_prober_kill_switch_zero_artifacts(monkeypatch):
+    """CASSMANTLE_NO_PROBER=1: no background task, canary disabled in
+    /readyz, no probe SLO objectives, and no probe.* series moves."""
+    import aiohttp
+
+    from cassmantle_tpu.obs.slo import default_objectives
+    from cassmantle_tpu.server import app as app_mod
+
+    monkeypatch.setenv("CASSMANTLE_NO_PROBER", "1")
+    assert prober_disabled()
+    cfg = make_cfg()
+    names = {o.name for o in default_objectives(cfg)}
+    assert not any(n.startswith("probe") for n in names)
+    app = app_mod.create_app(make_game(cfg), cfg, start_timer=False)
+    server = TestServer(app)
+    await server.start_server()
+    before = dict(metrics.snapshot()["counters"])
+    try:
+        assert app[app_mod._PROBER]["prober"] is None
+        url = f"http://{server.host}:{server.port}"
+        async with aiohttp.ClientSession() as http:
+            body = await (await http.get(url + "/readyz")).json()
+            assert body["canary"] == {"enabled": False}
+            # normal player traffic still serves, minting no probe.*
+            res = await http.get(url + "/init", params={"session": "p"})
+            assert res.status == 200
+        counters = dict(metrics.snapshot()["counters"])
+        for base in ("probe.ok", "probe.failures"):
+            assert counter_base_total(counters, base) == \
+                counter_base_total(before, base), base
+    finally:
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_prober_enabled_objectives_and_app_task():
+    """The default path: create_app arms the prober and the two
+    black-box SLO objectives exist."""
+    from cassmantle_tpu.obs.slo import default_objectives
+    from cassmantle_tpu.server import app as app_mod
+
+    cfg = make_cfg()
+    names = {o.name for o in default_objectives(cfg)}
+    assert {"probe_success", "probe_latency"} <= names
+    app = app_mod.create_app(make_game(cfg), cfg, start_timer=False)
+    server = TestServer(app)
+    await server.start_server()
+    try:
+        prober = app[app_mod._PROBER]["prober"]
+        assert prober is not None
+        assert prober.interval_s() == 3600.0
+    finally:
+        await server.close()
