@@ -1,0 +1,304 @@
+"""Exception-flow pass: swallowed errors and overbroad catches.
+
+The failure-containment plane (breakers, integrity verdicts, recovery
+manager) only works when failures actually REACH it — and the repo's
+worst silent bugs were all exception-flow bugs: the PR 8 replication
+pump whose swallowed cancellation left ``close()`` awaiting a loop that
+would never exit (gh-86296), and log-only broad catches that turned
+dispatch failures into invisible log lines no alert ever read. Two
+rules over the serving/engine/fabric/server/native layers:
+
+``swallowed-error`` — an ``except`` handler that catches broadly
+(``Exception``, ``BaseException``, bare) and then neither
+
+- re-raises,
+- counts a metric (``metrics.inc/observe/gauge/timer``),
+- flight-records / traces (``*.record``, ``*.record_span``,
+  ``*.mark_retain``),
+- classifies through the recovery plane (``note_*``, ``*classify*``,
+  ``on_dispatch_error``), nor
+- carries the error to a waiter (``*.set_exception``, ``*fail*``)
+
+is a black hole: the failure happened, nothing counted it, no
+dashboard or drill can see it. Log-only handlers count as swallowed on
+purpose — a log line is not a signal the SLO engine or an alert reads.
+The same rule flags the PR 8 cancel-swallow shape directly: a handler
+catching ``asyncio.CancelledError`` inside a loop of an ``async def``
+that neither re-raises nor breaks/returns makes the task UNCANCELLABLE
+— ``stop()``/``close()`` then awaits it forever.
+
+``overbroad-except`` — ``except BaseException`` or a bare ``except:``
+outside documented shutdown paths (``stop``/``close``/``shutdown``/
+``__exit__``-shaped functions) and not re-raising or carrying the
+exception to a future: these catch ``KeyboardInterrupt``/``SystemExit``
+and cancellation, hiding even the intent to die.
+
+Exempt by construction: the cancelled-task reap idiom (``t.cancel()``
+then ``try: await t except ...: pass`` — the error already reached its
+owner when the task was cancelled), and narrow typed catches
+(``except KeyError`` is control flow, not swallowing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Set
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    dotted_name,
+)
+
+RULE_SWALLOW = "swallowed-error"
+RULE_OVERBROAD = "overbroad-except"
+
+#: the async handler/engine/fabric layers whose exceptions must reach
+#: the containment plane (ops/models raise to their callers normally)
+REPO_DIRS = ("cassmantle_tpu/serving/", "cassmantle_tpu/engine/",
+             "cassmantle_tpu/fabric/", "cassmantle_tpu/server/",
+             "cassmantle_tpu/native/")
+
+_BROAD = {"Exception", "BaseException"}
+_METRIC_METHODS = {"inc", "observe", "gauge", "timer"}
+_RECORD_METHODS = {"record", "record_span", "mark_retain"}
+#: functions whose job is teardown: a broadest-possible catch there is
+#: the documented shutdown-path exemption for overbroad-except
+_SHUTDOWN_PREFIXES = ("stop", "close", "shutdown", "drain", "retire",
+                      "terminate", "aclose")
+_SHUTDOWN_NAMES = {"__exit__", "__aexit__", "__del__", "join"}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> Set[str]:
+    """Dotted names of the caught types; empty set = bare ``except:``."""
+    t = handler.type
+    if t is None:
+        return set()
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = set()
+    for e in elts:
+        name = dotted_name(e)
+        if name is not None:
+            names.add(name)
+    return names
+
+
+def _is_shutdown_path(func_name: Optional[str]) -> bool:
+    if func_name is None:
+        return False
+    bare = func_name.lstrip("_")
+    return func_name in _SHUTDOWN_NAMES or \
+        bare.startswith(_SHUTDOWN_PREFIXES)
+
+
+def _walk_body(nodes: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested defs (their
+    bodies execute elsewhere, under their own handlers)."""
+    stack: List[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _accounts_for_error(handler: ast.ExceptHandler) -> bool:
+    """True if the handler body re-raises or routes the failure into
+    something the containment plane can see."""
+    for node in _walk_body(handler.body):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            continue
+        segments = name.split(".")
+        last = segments[-1]
+        if last in _METRIC_METHODS and "metrics" in segments:
+            return True
+        if last in _RECORD_METHODS:
+            return True
+        if last == "set_exception" or "fail" in last:
+            return True
+        if last.startswith("note_") or "classify" in last or \
+                last == "on_dispatch_error":
+            return True
+    return False
+
+
+def _terminates(handler: ast.ExceptHandler) -> bool:
+    """Raise/Return/Break anywhere in the handler body: the loop (and
+    so the task) actually ends on this path."""
+    return any(isinstance(n, (ast.Raise, ast.Return, ast.Break))
+               for n in _walk_body(handler.body))
+
+
+def _cancelled_receivers(fn: ast.AST) -> Set[str]:
+    """Dotted receivers of every ``X.cancel()`` call in the function."""
+    receivers: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "cancel":
+            recv = dotted_name(node.func.value)
+            if recv is not None:
+                receivers.add(recv)
+    return receivers
+
+
+def _is_reap_idiom(try_node: ast.Try, cancelled: Set[str]) -> bool:
+    """``try: await X`` (alone) where the function cancels ``X``
+    somewhere: awaiting a task you just cancelled raises its
+    CancelledError at you — suppressing THAT is teardown, not
+    swallowing (the owner initiated the death it is now observing)."""
+    if len(try_node.body) != 1:
+        return False
+    for node in ast.walk(try_node.body[0]):
+        if not isinstance(node, ast.Await):
+            continue
+        awaited = node.value
+        if isinstance(awaited, ast.Call):  # await wait_for(X, ...)
+            if not awaited.args:
+                continue
+            awaited = awaited.args[0]
+        recv = dotted_name(awaited)
+        if recv is not None and recv in cancelled:
+            return True
+    return False
+
+
+class ExceptionFlowPass(LintPass):
+    name = "exceptionflow"
+    description = ("broad except bodies that swallow errors invisibly; "
+                   "BaseException/bare catches outside shutdown paths")
+
+    def __init__(self, dirs: Optional[Sequence[str]] = None) -> None:
+        # None = lint every module handed in (fixtures); the repo run
+        # scopes to the layers whose failures feed the containment plane
+        self.dirs = tuple(dirs) if dirs else None
+
+    @classmethod
+    def for_repo(cls) -> "ExceptionFlowPass":
+        return cls(dirs=REPO_DIRS)
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if self.dirs and not any(module.rel.startswith(d)
+                                 for d in self.dirs):
+            return
+        yield from self._scan(module.tree.body, module,
+                              func=None, is_async=False, in_loop=False,
+                              cancelled=set())
+
+    def _scan(self, nodes, module: Module, *, func: Optional[str],
+              is_async: bool, in_loop: bool,
+              cancelled: Set[str]) -> Iterator[Finding]:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan(
+                    node.body, module, func=node.name,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    in_loop=False, cancelled=_cancelled_receivers(node))
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                yield from self._scan(
+                    node.body + node.orelse, module, func=func,
+                    is_async=is_async, in_loop=True, cancelled=cancelled)
+                continue
+            if isinstance(node, ast.Try):
+                yield from self._check_try(node, module, func=func,
+                                           is_async=is_async,
+                                           in_loop=in_loop,
+                                           cancelled=cancelled)
+                yield from self._scan(
+                    node.body + node.orelse + node.finalbody, module,
+                    func=func, is_async=is_async, in_loop=in_loop,
+                    cancelled=cancelled)
+                for handler in node.handlers:
+                    yield from self._scan(handler.body, module, func=func,
+                                          is_async=is_async,
+                                          in_loop=in_loop,
+                                          cancelled=cancelled)
+                continue
+            if isinstance(node, ast.ClassDef):
+                yield from self._scan(node.body, module, func=func,
+                                      is_async=is_async, in_loop=in_loop,
+                                      cancelled=cancelled)
+                continue
+            # other compound statements (With, If, ...): recurse into
+            # their statement bodies via child iteration
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    # handled via the generic field walk below
+                    pass
+            yield from self._scan(
+                [c for c in ast.iter_child_nodes(node)
+                 if isinstance(c, ast.stmt)],
+                module, func=func, is_async=is_async, in_loop=in_loop,
+                cancelled=cancelled)
+
+    def _check_try(self, node: ast.Try, module: Module, *,
+                   func: Optional[str], is_async: bool, in_loop: bool,
+                   cancelled: Set[str]) -> Iterator[Finding]:
+        reap = _is_reap_idiom(node, cancelled)
+        for handler in node.handlers:
+            names = _handler_names(handler)
+            bare = handler.type is None
+            end = handler.body[0].lineno if handler.body else None
+            # -- overbroad-except -----------------------------------------
+            if (bare or "BaseException" in names) and not reap and \
+                    not _is_shutdown_path(func) and \
+                    not self._carries(handler):
+                what = "bare except:" if bare else "except BaseException"
+                yield Finding(
+                    RULE_OVERBROAD, module.rel, handler.lineno,
+                    f"{what} outside a shutdown path catches "
+                    f"KeyboardInterrupt/SystemExit and cancellation "
+                    f"without re-raising — catch Exception, or re-raise "
+                    f"after cleanup", end)
+                continue  # the stronger claim; don't double-report
+            # -- cancel-swallow (the PR 8 close-hang shape) ---------------
+            catches_cancel = bare or \
+                any(n.rsplit(".", 1)[-1] == "CancelledError"
+                    for n in names) or "BaseException" in names
+            if catches_cancel and is_async and in_loop and not reap and \
+                    not _terminates(handler):
+                yield Finding(
+                    RULE_SWALLOW, module.rel, handler.lineno,
+                    f"cancellation swallowed in a loop of async "
+                    f"{func or '<module>'!r}: the task becomes "
+                    f"uncancellable and stop()/close() awaits it "
+                    f"forever (the gh-86296 pump shape) — re-raise "
+                    f"CancelledError", end)
+                continue
+            # -- swallowed-error ------------------------------------------
+            broad = bare or bool(names & _BROAD)
+            if broad and not reap and not _accounts_for_error(handler):
+                yield Finding(
+                    RULE_SWALLOW, module.rel, handler.lineno,
+                    f"broad except in {func or '<module>'!r} swallows "
+                    f"the error invisibly (no re-raise, metric, "
+                    f"flight-record, classification, or set_exception) "
+                    f"— a failure here is unobservable; count it or "
+                    f"let it propagate", end)
+
+    @staticmethod
+    def _carries(handler: ast.ExceptHandler) -> bool:
+        """Re-raises or hands the exception to a waiter — the two
+        legitimate broadest-catch shapes (the dispatch-thread carrier
+        in serving/queue.py is the canonical one)."""
+        for node in _walk_body(handler.body):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name is not None and \
+                        name.rsplit(".", 1)[-1] == "set_exception":
+                    return True
+        return False

@@ -1,0 +1,364 @@
+"""Future-discipline pass: futures that can strand their waiters.
+
+A future is a contract: someone awaits it, so SOME code path must
+resolve it — success, error, or cancellation. The repo's PR 6 outage
+shape was exactly this contract broken at shutdown: ``BatchingQueue``
+handed callers loop-bound futures, ``stop()`` killed the loop, and the
+queued futures were never resolved — callers blocked in
+``cf.result()`` forever with no timeout. The fix (drain every queue
+and ``set_exception(QueueStopped(...))`` on each pending future) is an
+idiom this pass now enforces structurally. One rule,
+``future-discipline``, with three sub-shapes:
+
+1. **error-path stranding** — a ``try`` whose body (or ``else``)
+   resolves a future with ``set_result`` while a broad ``except``
+   neither re-raises nor ``set_exception``s the same future: on the
+   error path the waiter waits forever.
+2. **unguarded set** — ``set_result``/``set_exception`` on a future
+   the function did NOT just create, without a ``done()``/
+   ``cancelled()`` guard, ``set_running_or_notify_cancel()``, or
+   ``contextlib.suppress(InvalidStateError)``: in racy contexts
+   (timeouts, cancellation, duplicate completion) the second setter
+   raises ``InvalidStateError`` from an arbitrary thread.
+3. **stop-strand** (the PR 6 shape) — a class whose methods enqueue
+   locally-created futures (``put_nowait``/``put``/``append`` of a
+   fresh future, alone or in a tuple) and whose ``stop``/``close``/
+   ``shutdown`` path shows NO evidence of failing them
+   (``set_exception``, or a ``*fail*``/``*drain*`` same-class callee,
+   directly or one call level deep). Cancelling the consumer task is
+   deliberately NOT evidence: that is precisely what the broken PR 6
+   ``stop()`` did — the task died, the queued futures stayed pending.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    dotted_name,
+)
+from cassmantle_tpu.analysis.exceptionflow import (
+    REPO_DIRS,
+    _handler_names,
+    _walk_body,
+)
+
+RULE = "future-discipline"
+
+_BROAD = {"Exception", "BaseException"}
+#: calls that mint a fresh, still-pending future
+_FUTURE_CTORS = {"loop.create_future", "create_future", "asyncio.Future",
+                 "Future", "concurrent.futures.Future", "futures.Future"}
+_ENQUEUE_METHODS = {"put_nowait", "put", "append", "appendleft"}
+_STOP_NAMES = ("stop", "close", "shutdown", "aclose")
+
+
+def _is_future_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name is None:
+        return False
+    return name in _FUTURE_CTORS or name.endswith(".create_future") or \
+        name.endswith(".Future")
+
+
+def _stop_like(name: str) -> bool:
+    return name.lstrip("_").startswith(_STOP_NAMES)
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    lineno: int
+    #: stop-ish method name -> node
+    stop_methods: Dict[str, ast.AST] = field(default_factory=dict)
+    #: every method, for the one-level transitive callee walk
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    #: (method name, lineno) of each enqueue-of-fresh-future site
+    enqueue_sites: List[tuple] = field(default_factory=list)
+
+
+class FutureDisciplinePass(LintPass):
+    name = "futuredisc"
+    description = ("futures that can escape unresolved: error-path "
+                   "stranding, unguarded set_result/set_exception, "
+                   "enqueued futures no stop() path ever fails")
+
+    def __init__(self, dirs: Optional[Sequence[str]] = None) -> None:
+        self.dirs = tuple(dirs) if dirs else None
+
+    @classmethod
+    def for_repo(cls) -> "FutureDisciplinePass":
+        # same layers as exceptionflow: where futures cross threads/loops
+        return cls(dirs=REPO_DIRS)
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if self.dirs and not any(module.rel.startswith(d)
+                                 for d in self.dirs):
+            return
+        for fn in self._outermost_functions(module.tree):
+            yield from self._check_function(fn, module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(node, module)
+
+    @classmethod
+    def _outermost_functions(cls, node: ast.AST) -> Iterator[ast.AST]:
+        """Module-level functions and methods, but NOT nested defs: a
+        closure that resolves a future created by its enclosing
+        function must be checked in that enclosing scope (the
+        created/guard sets cover the whole lexical body)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child
+            elif isinstance(child, (ast.ClassDef, ast.If, ast.Try,
+                                    ast.With, ast.For, ast.While)):
+                yield from cls._outermost_functions(child)
+
+    # -- sub-shapes 1 & 2: per-function --------------------------------------
+
+    def _check_function(self, fn: ast.AST,
+                        module: Module) -> Iterator[Finding]:
+        created = self._created_futures(fn)
+        guarded = self._guard_receivers(fn)
+        notified = self._notify_receivers(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Try):
+                yield from self._check_error_path(node, fn, module)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("set_result", "set_exception"):
+                recv = dotted_name(node.func.value)
+                if recv is None or recv in created or recv in guarded or \
+                        recv in notified:
+                    continue
+                if self._under_done_guard(node, fn, recv) or \
+                        self._under_suppress(node, fn):
+                    continue
+                yield Finding(
+                    RULE, module.rel, node.lineno,
+                    f"{node.func.attr} on {recv!r} (not created in "
+                    f"{fn.name!r}) without a done()/cancelled() guard — "
+                    f"a racing completer (timeout, cancellation, "
+                    f"duplicate resolve) raises InvalidStateError; "
+                    f"guard with `if not {recv}.done():`")
+
+    @staticmethod
+    def _created_futures(fn: ast.AST) -> Set[str]:
+        """Names bound to a fresh future inside this function: the
+        creator is the sole resolver, so no race guard is needed."""
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_future_ctor(node.value):
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name is not None:
+                        names.add(name)
+        return names
+
+    @staticmethod
+    def _guard_receivers(fn: ast.AST) -> Set[str]:
+        """Receivers tested with ``X.done()``/``X.cancelled()`` anywhere
+        in the function — coarse, but a visible guard shows the author
+        thought about the race (the precise path check is sub-shape 1's
+        job)."""
+        receivers: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("done", "cancelled"):
+                recv = dotted_name(node.func.value)
+                if recv is not None:
+                    receivers.add(recv)
+        return receivers
+
+    @staticmethod
+    def _notify_receivers(fn: ast.AST) -> Set[str]:
+        """Receivers of ``set_running_or_notify_cancel()`` — the
+        concurrent.futures handshake that makes a later set safe."""
+        receivers: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "set_running_or_notify_cancel":
+                recv = dotted_name(node.func.value)
+                if recv is not None:
+                    receivers.add(recv)
+        return receivers
+
+    @staticmethod
+    def _under_done_guard(call: ast.Call, fn: ast.AST,
+                          recv: str) -> bool:
+        """The call sits under an ``if`` whose test mentions
+        ``recv.done()`` / ``recv.cancelled()``."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            test_calls = [n for n in ast.walk(node.test)
+                          if isinstance(n, ast.Call) and
+                          isinstance(n.func, ast.Attribute) and
+                          n.func.attr in ("done", "cancelled") and
+                          dotted_name(n.func.value) == recv]
+            if test_calls and any(n is call for n in ast.walk(node)):
+                return True
+        return False
+
+    @staticmethod
+    def _under_suppress(call: ast.Call, fn: ast.AST) -> bool:
+        """``with contextlib.suppress(...InvalidStateError...)`` around
+        the call, or a try/except catching InvalidStateError."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call) and \
+                            (call_name(ctx) or "").endswith("suppress") and \
+                            any("InvalidStateError" in (dotted_name(a) or "")
+                                for a in ctx.args):
+                        if any(n is call for n in ast.walk(node)):
+                            return True
+            if isinstance(node, ast.Try):
+                caught = set()
+                for h in node.handlers:
+                    caught |= _handler_names(h)
+                if any(n.rsplit(".", 1)[-1] == "InvalidStateError"
+                       for n in caught):
+                    if any(n is call for n in
+                           ast.walk(ast.Module(body=node.body,
+                                               type_ignores=[]))):
+                        return True
+        return False
+
+    def _check_error_path(self, try_node: ast.Try, fn: ast.AST,
+                          module: Module) -> Iterator[Finding]:
+        """Sub-shape 1: set_result in try body/else, broad except that
+        neither re-raises nor set_exceptions the same receiver."""
+        resolved: Set[str] = set()
+        for node in _walk_body(try_node.body + try_node.orelse):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "set_result":
+                recv = dotted_name(node.func.value)
+                if recv is not None:
+                    resolved.add(recv)
+        if not resolved:
+            return
+        for handler in try_node.handlers:
+            names = _handler_names(handler)
+            if handler.type is not None and not (names & _BROAD):
+                continue
+            failed: Set[str] = set()
+            raises = False
+            for node in _walk_body(handler.body):
+                if isinstance(node, ast.Raise):
+                    raises = True
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "set_exception":
+                    recv = dotted_name(node.func.value)
+                    if recv is not None:
+                        failed.add(recv)
+            if raises:
+                continue
+            stranded = resolved - failed
+            if stranded:
+                who = ", ".join(sorted(stranded))
+                end = handler.body[0].lineno if handler.body else None
+                yield Finding(
+                    RULE, module.rel, handler.lineno,
+                    f"error path strands waiter(s) of {who}: the try "
+                    f"body set_result()s but this broad except neither "
+                    f"re-raises nor set_exception()s — on failure the "
+                    f"future never resolves and its awaiter blocks "
+                    f"forever", end)
+
+    # -- sub-shape 3: per-class stop-strand (the PR 6 pin) -------------------
+
+    def _check_class(self, cls: ast.ClassDef,
+                     module: Module) -> Iterator[Finding]:
+        info = self._collect(cls)
+        if not info.enqueue_sites or not info.stop_methods:
+            return
+        if self._stop_fails_futures(info):
+            return
+        sites = ", ".join(f"{m}:{ln}" for m, ln in info.enqueue_sites[:3])
+        for stop_name, stop_node in sorted(info.stop_methods.items()):
+            yield Finding(
+                RULE, module.rel, stop_node.lineno,
+                f"{cls.name}.{stop_name}() never fails the futures "
+                f"enqueued at {sites}: after stop the consumer is gone "
+                f"and queued futures stay pending forever (the PR 6 "
+                f"stranding shape) — drain the queue and "
+                f"set_exception() each pending future; cancelling the "
+                f"consumer task is not enough")
+
+    @staticmethod
+    def _collect(cls: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(cls.name, cls.lineno)
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            info.methods[stmt.name] = stmt
+            if _stop_like(stmt.name):
+                info.stop_methods[stmt.name] = stmt
+            # find locally-created futures enqueued onto queues/deques
+            local_futs: Set[str] = set()
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and \
+                        _is_future_ctor(node.value):
+                    for tgt in node.targets:
+                        name = dotted_name(tgt)
+                        if name is not None:
+                            local_futs.add(name)
+            if not local_futs:
+                continue
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _ENQUEUE_METHODS):
+                    continue
+                for arg in node.args:
+                    elts = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+                    if any((dotted_name(e) or "") in local_futs
+                           for e in elts):
+                        info.enqueue_sites.append((stmt.name, node.lineno))
+                        break
+        return info
+
+    @staticmethod
+    def _stop_fails_futures(info: _ClassInfo) -> bool:
+        """Evidence that the stop path resolves pending futures: a
+        ``set_exception`` call, or a same-class ``self._x()`` callee
+        whose name says fail/drain — checked in the stop methods and
+        one transitive level of same-class callees."""
+        frontier = list(info.stop_methods.values())
+        seen: Set[str] = set(info.stop_methods)
+        for _ in range(2):  # stop methods, then their direct callees
+            next_frontier: List[ast.AST] = []
+            for fn in frontier:
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = call_name(node)
+                    if name is None:
+                        continue
+                    last = name.rsplit(".", 1)[-1]
+                    if last == "set_exception" or "fail" in last or \
+                            "drain" in last:
+                        return True
+                    if name.startswith("self.") and "." not in last and \
+                            last in info.methods and last not in seen:
+                        seen.add(last)
+                        next_frontier.append(info.methods[last])
+            frontier = next_frontier
+            if not frontier:
+                break
+        return False

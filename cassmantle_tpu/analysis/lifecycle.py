@@ -1,0 +1,480 @@
+"""Resource-lifecycle pass: leaked tasks, threads, and OS resources.
+
+The static half of the leak story (the runtime half is
+``utils/leak_sentinel.py``, armed per-test by conftest). Three rules:
+
+``task-leak`` — fire-and-forget ``asyncio.create_task`` /
+``ensure_future`` as a bare expression statement: nothing retains the
+task, so (a) the event loop holds only a weak reference and the task
+can be garbage-collected MID-FLIGHT (the documented asyncio footgun),
+and (b) its exception is silently dropped at GC time. Store the task,
+gather it, or attach a done-callback.
+
+``thread-leak`` — threads whose shutdown story is missing:
+
+- a ``self._x = Thread(...)`` started in a class that HAS a
+  ``stop``/``close``/``shutdown``/``join`` method, none of which
+  ever joins it — ``stop()`` returns while the thread still runs,
+  the PR 2 disowned-watchdog shape and the flaky-teardown shape the
+  leak sentinel catches at runtime;
+- a non-daemon ``self._x`` thread in a class with NO stop-ish method
+  at all — nothing can ever end it, so process exit hangs;
+- an anonymous non-daemon ``Thread(...).start()`` — unjoinable by
+  construction;
+- a function-local non-daemon thread never joined in that function.
+
+Anonymous DAEMON threads are exempt by design (the health prober's
+device-probe and the server's worker-death watcher are deliberate
+fire-and-forget daemons) — a documented blind spot the runtime
+sentinel's allowlist mirrors.
+
+``resource-leak`` — ``open()``, ``socket.socket()``,
+``ThreadPoolExecutor``/``ProcessPoolExecutor``, ``subprocess.Popen``
+bound to a name with neither a ``with`` block, a close-ish call on a
+close path (same function for locals; any ``stop``/``close``-shaped
+method for ``self._x``), nor an ownership transfer (returned or passed
+onward). Each leaked fd/executor is invisible until the process hits
+EMFILE under load.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from cassmantle_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    dotted_name,
+)
+
+RULE_TASK = "task-leak"
+RULE_THREAD = "thread-leak"
+RULE_RESOURCE = "resource-leak"
+
+_SPAWN_METHODS = {"create_task", "ensure_future"}
+_STOP_PREFIXES = ("stop", "close", "shutdown", "join", "terminate",
+                  "aclose", "retire", "drain")
+_STOP_DUNDERS = {"__exit__", "__aexit__", "__del__"}
+#: ctor dotted-name suffixes -> what leaks
+_RESOURCE_CTORS = {
+    "open": "file",
+    "socket.socket": "socket",
+    "ThreadPoolExecutor": "executor",
+    "ProcessPoolExecutor": "executor",
+    "subprocess.Popen": "subprocess",
+    "Popen": "subprocess",
+}
+_CLOSE_METHODS = {"close", "shutdown", "terminate", "kill", "wait",
+                  "communicate", "release"}
+
+
+def _is_stop_like(name: str) -> bool:
+    return name in _STOP_DUNDERS or \
+        name.lstrip("_").startswith(_STOP_PREFIXES)
+
+
+def _self_aliases(fn: ast.AST) -> Dict[str, str]:
+    """Local name -> ``self.attr`` for plain and tuple-unpacking
+    assigns — the grab-under-lock-then-join-outside idiom
+    (``t = self._thread`` / ``jobs, t = self._jobs, self._thread``)
+    must count as join evidence for the aliased attribute."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        if isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                and len(tgt.elts) == len(val.elts):
+            pairs = zip(tgt.elts, val.elts)
+        else:
+            pairs = [(tgt, val)]
+        for t, v in pairs:
+            src = dotted_name(v)
+            if isinstance(t, ast.Name) and src and \
+                    src.startswith("self."):
+                aliases[t.id] = src
+    return aliases
+
+
+def _resource_kind(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if name is None:
+        return None
+    for ctor, kind in _RESOURCE_CTORS.items():
+        if name == ctor or name.endswith("." + ctor):
+            return kind
+    return None
+
+
+def _is_thread_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    name = call_name(call)
+    return name is not None and (name == "Thread" or
+                                 name.endswith(".Thread"))
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _has_explicit_daemon(call: ast.Call) -> bool:
+    return any(kw.arg == "daemon" for kw in call.keywords)
+
+
+class LifecyclePass(LintPass):
+    name = "lifecycle"
+    description = ("fire-and-forget tasks, threads stop() never joins, "
+                   "resources opened without close-on-stop")
+
+    def __init__(self, dirs: Optional[Sequence[str]] = None) -> None:
+        self.dirs = tuple(dirs) if dirs else None
+
+    @classmethod
+    def for_repo(cls) -> "LifecyclePass":
+        # whole package: leaks matter everywhere, not just serving
+        return cls(dirs=("cassmantle_tpu/",))
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if self.dirs and not any(module.rel.startswith(d)
+                                 for d in self.dirs):
+            return
+        yield from self._check_task_leaks(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class_threads(node, module)
+                yield from self._check_class_resources(node, module)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_local_threads(node, module)
+                yield from self._check_local_resources(node, module)
+        yield from self._check_anonymous_threads(module)
+
+    # -- task-leak -----------------------------------------------------------
+
+    def _check_task_leaks(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Expr) and
+                    isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            spawn = self._spawn_call(call)
+            if spawn is not None:
+                yield Finding(
+                    RULE_TASK, module.rel, call.lineno,
+                    f"fire-and-forget {spawn}: the loop keeps only a "
+                    f"weak reference, so the task can be GC'd mid-"
+                    f"flight and its exception is dropped silently — "
+                    f"store the task, await/gather it, or attach a "
+                    f"done-callback")
+
+    @staticmethod
+    def _spawn_call(call: ast.Call) -> Optional[str]:
+        """The spawn call's display name if this expression statement is
+        a bare create_task/ensure_future — including the chained
+        ``<spawn>(...).add_done_callback(...)`` form, which is FINE
+        (the callback retains and observes the task)."""
+        func = call.func
+        # chained .add_done_callback on the spawn result: not a leak
+        if isinstance(func, ast.Attribute) and \
+                func.attr == "add_done_callback":
+            return None
+        name = call_name(call)
+        if name is None:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if last in _SPAWN_METHODS:
+            return name
+        return None
+
+    # -- thread-leak: class-owned threads ------------------------------------
+
+    def _check_class_threads(self, cls: ast.ClassDef,
+                             module: Module) -> Iterator[Finding]:
+        methods: Dict[str, ast.AST] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[stmt.name] = stmt
+        stop_methods = {n: m for n, m in methods.items()
+                        if _is_stop_like(n)}
+        # self._x = Thread(...) assignments, with daemon-ness
+        threads: Dict[str, Tuple[int, bool]] = {}  # attr -> (line, daemon)
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and \
+                        _is_thread_ctor(node.value):
+                    for tgt in node.targets:
+                        attr = dotted_name(tgt)
+                        if attr and attr.startswith("self."):
+                            threads[attr] = (node.lineno,
+                                             _daemon_true(node.value))
+        if not threads:
+            return
+        # which of those attrs are actually .start()ed?
+        started: Dict[str, int] = {}
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "start":
+                    recv = dotted_name(node.func.value)
+                    if recv in threads:
+                        started[recv] = node.lineno
+        if not started:
+            return
+        joined = self._joined_attrs(stop_methods, methods)
+        for attr, start_line in sorted(started.items()):
+            _, daemon = threads[attr]
+            if attr in joined:
+                continue
+            if stop_methods:
+                yield Finding(
+                    RULE_THREAD, module.rel, start_line,
+                    f"{cls.name} starts {attr} but "
+                    f"{'/'.join(sorted(stop_methods))}() never joins "
+                    f"it: stop returns while the thread still runs — "
+                    f"join with a bounded timeout (and flight-record "
+                    f"on overrun)")
+            elif not daemon:
+                yield Finding(
+                    RULE_THREAD, module.rel, start_line,
+                    f"{cls.name} starts non-daemon {attr} and has no "
+                    f"stop()/close() at all: nothing can end the "
+                    f"thread, so process exit hangs on it — add a "
+                    f"stop path that joins, or make it daemon with a "
+                    f"documented reason")
+
+    @staticmethod
+    def _joined_attrs(stop_methods: Dict[str, ast.AST],
+                      methods: Dict[str, ast.AST]) -> Set[str]:
+        """``self._x`` receivers of ``.join()`` reachable from the stop
+        methods (one transitive level of same-class callees, the same
+        budget lockorder uses for release-path evidence)."""
+        joined: Set[str] = set()
+        frontier = list(stop_methods.values())
+        seen = set(stop_methods)
+        for _ in range(2):
+            nxt: List[ast.AST] = []
+            for fn in frontier:
+                aliases = _self_aliases(fn)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "join":
+                        recv = dotted_name(node.func.value)
+                        if recv:
+                            joined.add(aliases.get(recv, recv))
+                    name = call_name(node)
+                    if name and name.startswith("self."):
+                        callee = name.rsplit(".", 1)[-1]
+                        if callee in methods and callee not in seen:
+                            seen.add(callee)
+                            nxt.append(methods[callee])
+            frontier = nxt
+            if not frontier:
+                break
+        return joined
+
+    # -- thread-leak: anonymous + function-local threads ---------------------
+
+    def _check_anonymous_threads(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == "start" and
+                    _is_thread_ctor(node.func.value)):
+                continue
+            ctor = node.func.value
+            assert isinstance(ctor, ast.Call)
+            if _daemon_true(ctor):
+                continue  # documented blind spot: deliberate daemons
+            yield Finding(
+                RULE_THREAD, module.rel, node.lineno,
+                "anonymous non-daemon Thread(...).start(): no name "
+                "ever references it, so it can never be joined and "
+                "blocks process exit — keep a reference and join it, "
+                "or pass daemon=True with a comment saying why "
+                "fire-and-forget is safe here")
+
+    def _check_local_threads(self, fn: ast.AST,
+                             module: Module) -> Iterator[Finding]:
+        locals_: Dict[str, Tuple[int, bool, bool]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name and "." not in name:
+                        locals_[name] = (node.lineno,
+                                         _daemon_true(node.value),
+                                         _has_explicit_daemon(node.value))
+        if not locals_:
+            return
+        started: Dict[str, int] = {}
+        joined: Set[str] = set()
+        escaped: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value)
+                if recv in locals_:
+                    if node.func.attr == "start":
+                        started[recv] = node.lineno
+                    elif node.func.attr == "join":
+                        joined.add(recv)
+            # x.daemon = True after construction counts
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "daemon" and \
+                            dotted_name(tgt.value) in locals_ and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value:
+                        nm = dotted_name(tgt.value)
+                        ln, _, _ = locals_[nm]
+                        locals_[nm] = (ln, True, True)
+                # escapes: returned, stored on self, appended, passed on
+                src = dotted_name(node.value)
+                if src in locals_ and node.targets and \
+                        any(isinstance(t, (ast.Attribute, ast.Subscript))
+                            for t in node.targets):
+                    escaped.add(src)
+            if isinstance(node, ast.Return) and node.value is not None:
+                src = dotted_name(node.value)
+                if src in locals_:
+                    escaped.add(src)
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    src = dotted_name(arg)
+                    if src in locals_ and not (
+                            isinstance(node.func, ast.Attribute) and
+                            node.func.attr in ("start", "join")):
+                        escaped.add(src)
+        for name, start_line in sorted(started.items()):
+            _, daemon, _ = locals_[name]
+            if daemon or name in joined or name in escaped:
+                continue
+            yield Finding(
+                RULE_THREAD, module.rel, start_line,
+                f"local non-daemon thread {name!r} started but never "
+                f"joined in {getattr(fn, 'name', '<fn>')!r} and never "
+                f"handed to an owner — it outlives the function with "
+                f"no shutdown story; join it, store it on an owner "
+                f"with a stop path, or make it daemon")
+
+    # -- resource-leak -------------------------------------------------------
+
+    def _check_class_resources(self, cls: ast.ClassDef,
+                               module: Module) -> Iterator[Finding]:
+        methods: Dict[str, ast.AST] = {
+            s.name: s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        close_methods = {n: m for n, m in methods.items()
+                         if _is_stop_like(n)}
+        closed = self._closed_attrs(close_methods, methods)
+        for m in methods.values():
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                kind = _resource_kind(node.value)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    attr = dotted_name(tgt)
+                    if not attr or not attr.startswith("self."):
+                        continue
+                    if attr in closed:
+                        continue
+                    yield Finding(
+                        RULE_RESOURCE, module.rel, node.lineno,
+                        f"{cls.name} opens a {kind} into {attr} but no "
+                        f"stop()/close() path ever closes it — each "
+                        f"instance leaks an fd/worker pool until the "
+                        f"process hits EMFILE; close it on the stop "
+                        f"path or use a context manager")
+
+    @staticmethod
+    def _closed_attrs(close_methods: Dict[str, ast.AST],
+                      methods: Dict[str, ast.AST]) -> Set[str]:
+        closed: Set[str] = set()
+        frontier = list(close_methods.values())
+        seen = set(close_methods)
+        for _ in range(2):
+            nxt: List[ast.AST] = []
+            for fn in frontier:
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _CLOSE_METHODS:
+                        recv = dotted_name(node.func.value)
+                        if recv:
+                            closed.add(recv)
+                    name = call_name(node)
+                    if name and name.startswith("self."):
+                        callee = name.rsplit(".", 1)[-1]
+                        if callee in methods and callee not in seen:
+                            seen.add(callee)
+                            nxt.append(methods[callee])
+            frontier = nxt
+            if not frontier:
+                break
+        return closed
+
+    def _check_local_resources(self, fn: ast.AST,
+                               module: Module) -> Iterator[Finding]:
+        opened: Dict[str, Tuple[int, str]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                kind = _resource_kind(node.value)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name and "." not in name:
+                        opened[name] = (node.lineno, kind)
+        if not opened:
+            return
+        closed: Set[str] = set()
+        escaped: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    recv = dotted_name(node.func.value)
+                    if recv in opened and \
+                            node.func.attr in _CLOSE_METHODS:
+                        closed.add(recv)
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    src = dotted_name(arg)
+                    if src in opened:
+                        escaped.add(src)  # ownership transfer
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    src = dotted_name(sub)
+                    if src in opened:
+                        escaped.add(src)
+            if isinstance(node, ast.Assign):
+                src = dotted_name(node.value)
+                if src in opened and \
+                        any(isinstance(t, (ast.Attribute, ast.Subscript))
+                            for t in node.targets):
+                    escaped.add(src)
+        for name, (lineno, kind) in sorted(opened.items()):
+            if name in closed or name in escaped:
+                continue
+            yield Finding(
+                RULE_RESOURCE, module.rel, lineno,
+                f"local {kind} {name!r} opened in "
+                f"{getattr(fn, 'name', '<fn>')!r} without with-block, "
+                f"close(), or ownership transfer — the fd leaks on "
+                f"every call (and on every exception path)")

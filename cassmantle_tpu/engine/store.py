@@ -502,6 +502,7 @@ class ReplicatedStore(StateStore):
             _, _, applied = await asyncio.wait_for(
                 client.repl_offset(), timeout=self.op_timeout_s)
             return role, applied
+        # lint: ignore[swallowed-error] — unreachable is the probed-for outcome: _drop resets the connection and the election proceeds on the survivors
         except (Exception, asyncio.TimeoutError):
             await self._drop(idx)
             return None
@@ -542,6 +543,13 @@ class ReplicatedStore(StateStore):
                         self._client(best).repl_promote(),
                         timeout=self.op_timeout_s)
                 except (Exception, asyncio.TimeoutError):
+                    from cassmantle_tpu.utils.logging import metrics
+
+                    # a failed promotion is an election that found a
+                    # winner and could not seat it — the cluster stays
+                    # leaderless another round; that must be countable,
+                    # not just a longer outage
+                    metrics.inc("repl.promote_failures")
                     promoted = False
                     await self._drop(best)
                 if promoted:
@@ -650,7 +658,9 @@ class ReplicatedStore(StateStore):
                 # a separate table and stay untouched). The dead
                 # follower still counts toward lag at its last-known
                 # offset — an outage must read as lag GROWTH, not as a
-                # healthy caught-up cluster
+                # healthy caught-up cluster. Counted too: lag growth
+                # says "behind", the counter says "the pump is failing"
+                metrics.inc("repl.ship_failures")
                 await self._drop(i, pump=True)
                 await self._drop(leader_idx, pump=True)
                 applied = self._follower_applied.get(i, 0)

@@ -98,6 +98,7 @@ class ClusterMembership:
             worker = field if isinstance(field, str) else field.decode()
             try:
                 info = json.loads(value.decode())
+            # lint: ignore[swallowed-error] — torn/foreign row skip is the documented merge rule; the row simply isn't membership data
             except Exception:
                 continue  # torn/foreign field, same rule as refresh()
             age = now - float(info.get("t", 0.0))

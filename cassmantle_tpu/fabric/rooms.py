@@ -265,7 +265,10 @@ class RoomFabric:
             # READONLY follower mid-election / store hiccup: no key
             # means signature trust is simply unavailable this beat
             # (loopback/host legs still work); the next heartbeat
-            # retries
+            # retries. Counted: a worker stuck without signature trust
+            # for many beats is a real degradation a log line can't
+            # alert on
+            metrics.inc("fabric.cluster_key_failures")
             log.exception("cluster key fetch failed; retrying next beat")
             self._cluster_key = None
 
@@ -461,6 +464,7 @@ class RoomFabric:
                     for w, row in table.items()
                     if w != self.worker_id and not row["stale"]
                 }
+            # lint: ignore[swallowed-error] — handoff baseline is best-effort: no snapshot degrades adoption-wait to its bounded timeout, and this worker is shutting down
             except Exception:
                 baseline = {}
         # move the ring NOW: ownership answers flip to the survivors
@@ -502,6 +506,7 @@ class RoomFabric:
         while asyncio.get_running_loop().time() < deadline:
             try:
                 table = await self.membership.table()
+            # lint: ignore[swallowed-error] — store unreachable during shutdown: nothing left to confirm, returning ends the bounded adoption wait
             except Exception:
                 return  # store unreachable: nothing left to confirm
             live = {w: row for w, row in table.items()

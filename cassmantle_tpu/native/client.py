@@ -49,6 +49,7 @@ def _binary_runs() -> bool:
     try:
         proc = subprocess.Popen([BINARY, "0"], stdout=subprocess.DEVNULL,
                                 stderr=subprocess.PIPE)
+    # lint: ignore[swallowed-error] — "does the binary run" probe: False IS the answer, and callers rebuild or fall back on it
     except Exception:
         return False
     try:
@@ -56,6 +57,7 @@ def _binary_runs() -> bool:
         if not ready:  # neither died nor spoke: treat as unusable
             return False
         return b"listening" in proc.stderr.readline()
+    # lint: ignore[swallowed-error] — same probe contract: an unreadable stderr means unusable, which is the False the caller acts on
     except Exception:
         return False
     finally:
@@ -81,6 +83,7 @@ def ensure_built() -> Optional[str]:
             check=True, capture_output=True, timeout=120,
         )
         return BINARY if os.path.exists(BINARY) else None
+    # lint: ignore[swallowed-error] — documented degrade ladder: stale binary beats no store, None falls back to the memory store; both logged and visible in the store banner
     except Exception as exc:  # no toolchain: callers fall back to memory
         if runnable:
             # a stale-but-runnable binary beats no store at all (git

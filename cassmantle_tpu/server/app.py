@@ -64,6 +64,7 @@ from cassmantle_tpu.obs.trace import (
 )
 from cassmantle_tpu.serving import overload
 from cassmantle_tpu.serving.queue import OverloadShed
+from cassmantle_tpu.utils import leak_sentinel
 from cassmantle_tpu.utils.logging import (
     NULL_METRICS,
     get_logger,
@@ -498,6 +499,7 @@ async def _hedge_score(request: web.Request, room: str, session: str,
         return None
     try:
         table = await fabric.membership.table()
+    # lint: ignore[swallowed-error] — hedge is best-effort: None means "no peer answered" and the caller's floor-score path takes over
     except Exception:
         return None
     peers = []
@@ -904,6 +906,7 @@ async def _probe_store(fabric: RoomFabric) -> bool:
     try:
         await asyncio.wait_for(fabric.store.exists("healthz"), timeout=2.0)
         return True
+    # lint: ignore[swallowed-error] — liveness probe: False IS the signal, surfaced as the /healthz verdict the orchestrator acts on
     except Exception:
         return False
 
@@ -1203,7 +1206,10 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
                 engine.evaluate()
             except Exception:
                 # advisory machinery: an evaluation bug must never take
-                # the loop (or anything else) down with it
+                # the loop (or anything else) down with it — but a
+                # silently dead evaluator means burn-rate alerts stop
+                # firing, so the failure itself must be countable
+                metrics.inc("slo.eval_failures")
                 log.exception("slo evaluation failed; continuing")
 
     async def on_startup(app_: web.Application) -> None:
@@ -1231,6 +1237,20 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
             prober = CanaryProber(fabric, cfg, self_addr=self_addr)
             app_[_PROBER]["prober"] = prober
             tasks.append(loop.create_task(prober.run()))
+        # opt-in leak census (CASSMANTLE_LEAK_SENTINEL=1): log-only —
+        # thread/task origin tracking plus a periodic scan() that
+        # counts leaks.* and flight-records leak.detected when the
+        # live census grows past its high-water mark. Same cadence as
+        # the process self-metrics: leak growth IS a process self-
+        # metric.
+        leak_sentinel.maybe_enable_from_env()
+        if leak_sentinel.sentinel_active():
+            async def _leak_scan_loop() -> None:
+                while True:
+                    await asyncio.sleep(cfg.obs.process_sample_interval_s)
+                    leak_sentinel.scan()
+
+            tasks.append(loop.create_task(_leak_scan_loop()))
 
     async def on_shutdown(app_: web.Application) -> None:
         # graceful SIGTERM handoff (ISSUE 12): leave membership, drain
@@ -1244,6 +1264,7 @@ def create_app(game: "Game | RoomFabric", cfg: FrameworkConfig,
         # and /readyz reports "draining" throughout.
         try:
             await fabric.handoff()
+        # lint: ignore[swallowed-error] — best-effort drain while the process is exiting: the log is for the operator tailing the drain, and handoff() counts its own moves
         except Exception:
             log.exception("graceful handoff failed; shutting down anyway")
 

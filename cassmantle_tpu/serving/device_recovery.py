@@ -237,6 +237,7 @@ class DeviceRecoveryManager:
             if self.on_permanent is not None:
                 try:
                     self.on_permanent(reason)
+                # lint: ignore[swallowed-error] — advisory drain hook: the permanent-loss event itself is counted and flight-recorded just above
                 except Exception:
                     log.exception("permanent-loss drain hook failed")
         finally:

@@ -385,6 +385,10 @@ def degraded_dispatch_variant(cache: dict, sampler_cfg, mesh,
             cache[key] = entry
         return entry
     except Exception:
+        # counted: the ladder believes it engaged a cheaper tier, but
+        # this config is quietly serving full quality — invisible in
+        # the tier gauge, so the mismatch needs its own counter
+        metrics.inc("pipeline.brownout_delta_unusable")
         log_.exception("brownout tier delta unusable for this config; "
                        "serving full quality")
         return None
@@ -643,6 +647,7 @@ class Text2ImagePipeline:
             self._staged = None
             try:
                 staged.stop()
+            # lint: ignore[swallowed-error] — the staged server is dropped and rebuilt regardless; recovery's warm-pass counters cover the reload outcome
             except Exception:
                 log.exception("staged server stop during reload failed")
         self._param_loader()
@@ -1255,6 +1260,7 @@ class PromptGenerator:
         try:
             return jax.tree_util.tree_map(
                 jnp.asarray, load_quantized(self._int8_path))
+        # lint: ignore[swallowed-error] — load-time degrade: the fp fallback is the documented recovery, logged with the re-quantize instruction; serving correctness is unaffected
         except Exception:
             log.exception(
                 "quantized checkpoint %s failed to load (model config "

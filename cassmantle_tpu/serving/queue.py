@@ -174,6 +174,35 @@ class _DispatchWorker:
             self._jobs.put((fn, args, cf, started))
             return cf, started
 
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Retire a DEDICATED worker's thread when its owning queue
+        shuts down: send the retire sentinel, then join with a bounded
+        timeout. Unbounded join would trade a thread leak for a
+        shutdown hang when a handler is wedged in XLA; on overrun the
+        thread is disowned exactly like ``replace()`` does (daemon, so
+        it cannot pin process exit) — but counted and flight-recorded,
+        because a teardown that abandons a live dispatch thread is the
+        flaky-test / slow-drain shape the leak sentinel exists to
+        catch. The process-global worker is never stopped: it is a
+        process-lifetime singleton by contract."""
+        with self._lock:
+            jobs, thread = self._jobs, self._thread
+            self._jobs = None
+            self._thread = None
+        if jobs is not None:
+            jobs.put(None)  # retire when the current call returns
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout_s)
+            if thread.is_alive():
+                metrics.inc("dispatch.stop_overruns")
+                flight_recorder.record("dispatch.stop_overrun",
+                                       worker=self.name,
+                                       timeout_s=timeout_s)
+                log.warning(
+                    "%s dispatch thread still running %.1fs after "
+                    "stop; disowning it (wedged handler?)",
+                    self.name, timeout_s)
+
     def replace(self) -> None:
         """Disown a wedged thread and start a fresh one. Jobs the old
         thread had not started move to the new thread; the in-flight call
@@ -301,6 +330,13 @@ class BatchingQueue(Generic[T, R]):
             stopped += 1
         if stopped:
             metrics.inc(f"{self.name}.stopped_pending", stopped)
+        # a DEDICATED dispatch worker dies with its queue (bounded
+        # join; see _DispatchWorker.stop) — before this, every staged
+        # server start/stop cycle abandoned a live stage.*_dispatch
+        # thread. The shared process-global worker outlives any one
+        # queue on purpose and is never stopped here.
+        if self._dispatcher is not _dispatcher:
+            self._dispatcher.stop()
 
     def _expire(self, fut: asyncio.Future) -> None:
         if not fut.done():
@@ -612,6 +648,7 @@ class BatchingQueue(Generic[T, R]):
                     # must not change the per-item failure contract
                     try:
                         self.on_dispatch_error(exc)
+                    # lint: ignore[swallowed-error] — advisory classification hook: the batch failure itself is counted and carried to every waiter below
                     except Exception:
                         log.exception("%s on_dispatch_error hook "
                                       "failed", self.name)

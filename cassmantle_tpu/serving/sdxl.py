@@ -293,6 +293,7 @@ class SDXLPipeline:
             self._staged = None
             try:
                 staged.stop()
+            # lint: ignore[swallowed-error] — the staged server is dropped and rebuilt regardless; recovery's warm-pass counters cover the reload outcome
             except Exception:
                 log.exception("staged server stop during reload failed")
         self._param_loader()

@@ -818,6 +818,7 @@ class StagedImageServer:
                     self._params["unet"], lat1,
                     jax.ShapeDtypeStruct((1,), jnp.int32), cond1)
                 self._flops_img = flops * self.num_steps
+            # lint: ignore[swallowed-error] — accounting-only degrade: retirements carry flops_est=0, which is itself visible in every stage.denoise.service span
             except Exception:
                 log.exception("staged denoise cost trace failed; "
                               "retirements carry no FLOPs attribution")
@@ -878,7 +879,11 @@ class StagedImageServer:
                     attrs=attrs)
             if sup is not None:
                 sup.note_stage_progress("denoise")
-            u.done.set_result(row)
+            # guarded: stop()/deadline/integrity can _fail_unit a slot
+            # the denoise thread is concurrently retiring — the loser
+            # of that race must not raise InvalidStateError here
+            if not u.done.done():
+                u.done.set_result(row)
 
     # -- wedge watchdog ----------------------------------------------------
 

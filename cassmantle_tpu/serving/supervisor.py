@@ -250,6 +250,7 @@ class ServingSupervisor:
         if self.fabric_status is not None:
             try:
                 status["fabric"] = self.fabric_status()
+            # lint: ignore[swallowed-error] — the failure is carried into the status payload itself ({"error": "unavailable"}), which every probe consumer sees
             except Exception:
                 # the cluster block is advisory: a torn membership
                 # snapshot must never break the readiness verdict

@@ -1,0 +1,330 @@
+"""LeakSentinel: the runtime half of the resource-lifecycle defense.
+
+The static passes (``cassmantle_tpu/analysis/lifecycle.py`` and
+friends) prove what they can see — fire-and-forget tasks, threads no
+``stop()`` joins, resources with no close path. This sentinel covers
+the rest at runtime: a per-test snapshot/diff of live threads, asyncio
+tasks, and open fds, armed for EVERY test by an autouse conftest
+fixture — the same static-pass + runtime-sentinel pairing as
+``lockorder.py``/``utils/locks.py`` and ``recompile.py``/
+``utils/jit_sentinel.py``.
+
+How it listens: while armed, ``threading.Thread.start`` and
+``BaseEventLoop.create_task`` (the choke point under both
+``asyncio.create_task`` and ``ensure_future``) are wrapped to stamp
+each new thread/task with a monotonic sequence number and its
+**creation site** (the first stack frame outside threading/asyncio/
+this module), registered in ``WeakSet``s. :func:`verify` then reports
+every tracked thread still alive / task still pending that was created
+after the snapshot — with the origin site, so the failure message says
+*who leaked*, not just "a thread leaked". Objects created before
+arming (pytest's own machinery, jax's compilation pools) are invisible
+by construction: the wrapper wasn't installed when they started.
+
+Fd accounting is diff-only (``/proc/self/fd`` where available): no
+per-fd origin, and lazy module-level caches (the mmap'd embedding
+table, a jax backend initializing mid-suite) legitimately open
+process-lifetime fds — so the conftest fixture runs fds in LOG-ONLY
+mode by default (``fd_policy="log"``) while threads/tasks raise. Tests
+that seed a deliberate fd leak assert with ``fd_policy="raise"``.
+
+Known limits, by design:
+
+- anonymous daemon threads on the static pass's allowlist (the health
+  prober's ``device-probe``, the process-global queue dispatcher) are
+  mirrored here by the thread-name allowlist — process-lifetime
+  singletons by contract, not per-test leaks; tasks CREATED on an
+  allowlisted worker's loop (stamped with the creating thread's name)
+  are that worker's working set — the staged server's queue-getter
+  tasks between batches — and exempt the same way;
+- a task that finishes (or is cancelled by ``asyncio.run``'s exit
+  sweep) before the diff runs is NOT a leak — the sentinel measures
+  what outlives the test, which is exactly the flaky-teardown shape.
+
+Usage (tests — the autouse conftest fixture arms + verifies per test):
+
+    snap = leak_sentinel.snapshot()
+    ... test body ...
+    leak_sentinel.verify(snap)        # raises LeakError, with origins
+
+Production: ``CASSMANTLE_LEAK_SENTINEL=1`` arms log-only tracking at
+server boot; :func:`scan` (called from the server's watchdog cadence)
+counts ``leaks.threads``/``leaks.tasks``/``leaks.fds`` gauges and
+flight-records ``leak.detected`` with the oldest origins whenever the
+tracked-live census GROWS past its high-water mark — steady growth is
+the leak signal; a stable census is just the working set
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import sys
+import threading
+import weakref
+from typing import Dict, List, Optional, Set
+
+from cassmantle_tpu.utils.logging import get_logger, metrics
+
+log = get_logger("leak_sentinel")
+
+#: process-lifetime singletons, by contract (mirrors the static pass's
+#: anonymous-daemon exemption): the shared dispatch worker survives
+#: across tests on purpose; the device probe is fire-and-forget with a
+#: bounded life of its own
+_THREAD_ALLOWLIST = (
+    "cassmantle-queue.dispatch_worker",
+    "device-probe",
+    # the staged image server (loop/denoise/stage-dispatch threads) is
+    # shared MODULE-scoped across tests for compile economics — its
+    # threads are the module's working set, not a per-test leak. The
+    # stop-retires-the-thread contract this could otherwise mask is
+    # pinned directly by the _DispatchWorker.stop() unit in
+    # tests/test_check_lifecycle.py.
+    "cassmantle-stage*",
+    # jax/XLA internals spin pools lazily on first dispatch mid-test
+    "jax*", "ThreadPoolExecutor-*", "pjit*",
+)
+
+
+class LeakError(AssertionError):
+    """A thread/task/fd created during the test outlived it. The
+    message carries each leaked object's creation site."""
+
+
+_lock = threading.Lock()
+_seq = 0
+_armed = False
+_orig_thread_start = None
+_orig_create_task = None
+_tracked_threads: "weakref.WeakSet" = weakref.WeakSet()
+_tracked_tasks: "weakref.WeakSet" = weakref.WeakSet()
+#: prod scan() high-water marks (census sizes at the last scan)
+_hiwater = {"threads": 0, "tasks": 0, "fds": 0}
+
+_SKIP_FRAMES = (os.sep + "threading.py", os.sep + "asyncio" + os.sep,
+                "leak_sentinel.py")
+
+
+def _origin() -> str:
+    """First stack frame outside threading/asyncio/this module — the
+    site that actually asked for the thread/task. Raw-frame walk (no
+    traceback.extract_stack: that builds FrameSummaries with source
+    lookup for the WHOLE stack, and this runs on every spawn while the
+    suite is armed)."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        fn = frame.f_code.co_filename
+        if not any(s in fn for s in _SKIP_FRAMES):
+            return f"{fn}:{frame.f_lineno} in {frame.f_code.co_name}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+def _next_seq() -> int:
+    global _seq
+    with _lock:
+        _seq += 1
+        return _seq
+
+
+def _wrapped_thread_start(self, *args, **kwargs):
+    if not getattr(self, "_leak_seq", None):
+        self._leak_seq = _next_seq()
+        self._leak_origin = _origin()
+        _tracked_threads.add(self)
+    return _orig_thread_start(self, *args, **kwargs)
+
+
+def _wrapped_create_task(loop, coro, **kwargs):
+    task = _orig_create_task(loop, coro, **kwargs)
+    try:
+        task._leak_seq = _next_seq()
+        task._leak_origin = _origin()
+        # create_task runs ON the loop's thread: an allowlisted
+        # process/module-lifetime worker's tasks (the staged server's
+        # queue getters between batches) are its working set, exempt
+        # the same way the worker thread itself is
+        task._leak_thread = threading.current_thread().name
+        _tracked_tasks.add(task)
+    except Exception:  # pragma: no cover — a task subclass with slots
+        pass
+    return task
+
+
+def enable_sentinel() -> None:
+    """Install the Thread.start / loop.create_task wrappers
+    (idempotent). Cheap: one sequence bump + one extract_stack per
+    spawn, nothing on any hot dispatch path."""
+    global _armed, _orig_thread_start, _orig_create_task
+    with _lock:
+        if _armed:
+            return
+        _armed = True
+    import asyncio.base_events
+
+    _orig_thread_start = threading.Thread.start
+    threading.Thread.start = _wrapped_thread_start
+    _orig_create_task = asyncio.base_events.BaseEventLoop.create_task
+    asyncio.base_events.BaseEventLoop.create_task = _wrapped_create_task
+
+
+def disable_sentinel() -> None:
+    global _armed, _orig_thread_start, _orig_create_task
+    with _lock:
+        if not _armed:
+            return
+        _armed = False
+    import asyncio.base_events
+
+    threading.Thread.start = _orig_thread_start
+    asyncio.base_events.BaseEventLoop.create_task = _orig_create_task
+    _orig_thread_start = None
+    _orig_create_task = None
+
+
+def sentinel_active() -> bool:
+    return _armed
+
+
+def maybe_enable_from_env() -> None:
+    """Production arming: CASSMANTLE_LEAK_SENTINEL=1 turns on log-only
+    origin tracking (the server's watchdog cadence calls :func:`scan`).
+    Called from server boot so deployments opt in with one env var."""
+    if os.environ.get("CASSMANTLE_LEAK_SENTINEL", "") not in ("", "0"):
+        enable_sentinel()
+
+
+def _allowlisted_name(name: str) -> bool:
+    return any(fnmatch.fnmatch(name or "", pat)
+               for pat in _THREAD_ALLOWLIST)
+
+
+def _allowlisted(thread: threading.Thread) -> bool:
+    return _allowlisted_name(thread.name)
+
+
+def _open_fds() -> Optional[Set[int]]:
+    try:
+        return {int(x) for x in os.listdir("/proc/self/fd")}
+    except (OSError, ValueError):  # macOS/sandbox: fd diffing is off
+        return None
+
+
+def snapshot() -> Dict[str, object]:
+    """The per-test baseline: the spawn-sequence high-water mark plus
+    the open-fd set. Anything tracked with a LATER sequence number that
+    is still alive at :func:`verify` time leaked."""
+    return {"seq": _seq, "fds": _open_fds()}
+
+
+def _live_after(snap_seq: int):
+    threads = [t for t in list(_tracked_threads)
+               if getattr(t, "_leak_seq", 0) > snap_seq
+               and t.is_alive() and not _allowlisted(t)]
+    tasks = [t for t in list(_tracked_tasks)
+             if getattr(t, "_leak_seq", 0) > snap_seq and not t.done()
+             and not _allowlisted_name(getattr(t, "_leak_thread", ""))]
+    return threads, tasks
+
+
+def verify(snap: Dict[str, object], *, raise_on_leak: bool = True,
+           fd_policy: str = "log") -> List[str]:
+    """Diff live threads/tasks/fds against ``snap``; returns the leak
+    descriptions (empty = clean). ``raise_on_leak`` raises
+    :class:`LeakError` on thread/task leaks — the test-mode contract.
+    ``fd_policy``: ``"log"`` (default — fd growth logs + counts but
+    never raises: lazy process-lifetime caches open fds mid-suite),
+    ``"raise"`` (seeded-leak tests), or ``"off"``."""
+    threads, tasks = _live_after(int(snap["seq"]))
+    leaks = [
+        f"thread {t.name!r} (daemon={t.daemon}) still alive, "
+        f"created at {getattr(t, '_leak_origin', '<unknown>')}"
+        for t in threads
+    ] + [
+        f"task {t.get_name()!r} still pending, "
+        f"created at {getattr(t, '_leak_origin', '<unknown>')}"
+        for t in tasks
+    ]
+    if threads:
+        metrics.inc("leaks.threads", float(len(threads)))
+    if tasks:
+        metrics.inc("leaks.tasks", float(len(tasks)))
+    fd_leaks: List[str] = []
+    if fd_policy != "off" and snap.get("fds") is not None:
+        now = _open_fds()
+        if now is not None:
+            grew = now - snap["fds"]  # type: ignore[operator]
+            if grew:
+                fd_leaks = [f"{len(grew)} fd(s) opened and not closed: "
+                            f"{sorted(grew)[:8]}"]
+                metrics.inc("leaks.fds", float(len(grew)))
+    if leaks or fd_leaks:
+        _record(leaks + fd_leaks)
+    if raise_on_leak and (leaks or (fd_policy == "raise" and fd_leaks)):
+        detail = "\n  ".join(leaks + fd_leaks)
+        raise LeakError(
+            f"{len(leaks) + len(fd_leaks)} leak(s) outlived the test:\n"
+            f"  {detail}\nJoin the thread / await-or-cancel the task / "
+            f"close the fd in teardown (or allowlist a documented "
+            f"process-lifetime singleton in utils/leak_sentinel.py)")
+    return leaks + fd_leaks
+
+
+def _record(leaks: List[str]) -> None:
+    # lazy import: utils never depends on obs at module scope (the
+    # circuit-breaker rule, same as locks.py / jit_sentinel.py)
+    from cassmantle_tpu.obs.recorder import flight_recorder
+
+    flight_recorder.record("leak.detected", count=len(leaks),
+                           leaks=leaks[:8])
+    for line in leaks:
+        log.warning("leak: %s", line)
+
+
+def scan() -> Dict[str, int]:
+    """Production sweep (log-only): census of tracked-live threads/
+    tasks (+ open fds) vs the high-water marks. Growth counts the
+    ``leaks.*`` metrics and flight-records ``leak.detected``; the
+    returned census feeds whatever status block calls it. Never
+    raises — prod mode observes, tests enforce."""
+    threads, tasks = _live_after(0)
+    fds = _open_fds()
+    census = {"threads": len(threads), "tasks": len(tasks),
+              "fds": len(fds) if fds is not None else 0}
+    grew: List[str] = []
+    for key in ("threads", "tasks"):
+        if census[key] > _hiwater[key]:
+            objs = threads if key == "threads" else tasks
+            oldest = sorted(objs,
+                            key=lambda o: getattr(o, "_leak_seq", 0))
+            grew.append(f"{key} census {census[key]} > high-water "
+                        f"{_hiwater[key]}; oldest from "
+                        + "; ".join(
+                            getattr(o, "_leak_origin", "<unknown>")
+                            for o in oldest[:3]))
+            metrics.inc(f"leaks.{key}",
+                        float(census[key] - _hiwater[key]))
+            _hiwater[key] = census[key]
+    if fds is not None and census["fds"] > _hiwater["fds"]:
+        if _hiwater["fds"]:  # first scan just sets the baseline
+            metrics.inc("leaks.fds",
+                        float(census["fds"] - _hiwater["fds"]))
+            grew.append(f"fd census {census['fds']} > high-water "
+                        f"{_hiwater['fds']}")
+        _hiwater["fds"] = census["fds"]
+    if grew:
+        _record(grew)
+    return census
+
+
+def reset() -> None:
+    """Drop tracking state (tests): the WeakSets, the sequence counter,
+    and the prod high-water marks."""
+    global _seq
+    with _lock:
+        _seq = 0
+        _hiwater.update(threads=0, tasks=0, fds=0)
+    _tracked_threads.clear()
+    _tracked_tasks.clear()

@@ -68,6 +68,11 @@ FAST_MODULES = frozenset({
     "test_chaos",
     "test_check_concurrency",
     "test_check_jax", "test_check_metrics",
+    # exception-flow/lifecycle lints + leak sentinel (ISSUE 19): the
+    # golden violating/fixed pairs (PR 6 stop-strand, PR 8 cancel-
+    # swallow), the repo-lints-clean gate, and the seeded-leak sentinel
+    # units are stdlib-fast acceptance bars for the leak defense
+    "test_check_lifecycle",
     # consistency distillation + few-step serving (ISSUE 15): the
     # toy-geometry training smoke, checkpoint-layout pin, the ≤8-
     # forwards acceptance counter, and the brownout few-step tier are
@@ -223,6 +228,38 @@ def _jit_sentinel():
     yield
     jit_sentinel.disable_sentinel()
     jit_sentinel.reset_counts()
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel():
+    """Arm the thread/task/fd leak sentinel (utils/leak_sentinel.py)
+    for EVERY test — the lifecycle counterpart of the two sentinels
+    above. Threads still alive and tasks still pending after teardown
+    fail the test with their creation site (Thread.start/create_task
+    are wrapped to stamp origin stacks while armed). Fd accounting is
+    log-only here: lazy process-lifetime caches (the mmap'd embedding
+    table, a jax backend initializing mid-suite) legitimately open fds
+    that are not per-test leaks; seeded-fd-leak tests opt into
+    fd_policy="raise" themselves. Autouse fixtures set up before the
+    test's requested fixtures and so tear down after them — the
+    verify here runs AFTER the test's own fixtures have stopped their
+    servers/queues, which is exactly the window where a still-alive
+    thread means a real shutdown bug, not work in progress. Tracking
+    state resets per test so one test's leak (already reported)
+    cannot fail its neighbors."""
+    from cassmantle_tpu.utils import leak_sentinel
+
+    leak_sentinel.reset()
+    leak_sentinel.enable_sentinel()
+    snap = leak_sentinel.snapshot()
+    try:
+        yield
+    finally:
+        try:
+            leak_sentinel.verify(snap)
+        finally:
+            leak_sentinel.disable_sentinel()
+            leak_sentinel.reset()
 
 
 @pytest.fixture(scope="session")

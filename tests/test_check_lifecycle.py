@@ -1,0 +1,651 @@
+"""Exception-flow & resource-lifecycle lint + leak sentinel gate
+(fast tier).
+
+Golden fixture snippets pin each rule of the three
+``cassmantle_tpu/analysis`` lifecycle passes (known violations must
+fail; suppressed / fixed variants must pass). Two repo-history shapes
+are pinned as golden violating/fixed pairs the way PR 4 pinned the
+PR 1 dispatch deadlock for ``lock-order-cycle``:
+
+- the **PR 6 stop-stranding** shape (``future-discipline``): a class
+  that enqueues futures its ``stop()`` only cancels — the queued
+  futures stay pending forever;
+- the **PR 8 cancel-swallow** shape (``swallowed-error``): a loop
+  handler in an async pump that eats ``CancelledError``, making the
+  task uncancellable (gh-86296) so ``close()`` awaits it forever.
+
+The repo itself must lint clean through the real entry point
+(``tools/check_lifecycle.py``), ``tools/lint_all.py`` must actually
+run the lifecycle passes in its one walk, and the
+``utils/leak_sentinel`` runtime counterpart must fail seeded
+thread/task/fd leaks with the leaker's creation site while staying
+vacuous when disarmed and log-only in prod ``scan()`` mode.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from cassmantle_tpu.analysis.core import parse_source, run_passes
+from cassmantle_tpu.analysis.exceptionflow import ExceptionFlowPass
+from cassmantle_tpu.analysis.futuredisc import FutureDisciplinePass
+from cassmantle_tpu.analysis.lifecycle import LifecyclePass
+from cassmantle_tpu.utils import leak_sentinel
+from cassmantle_tpu.utils.leak_sentinel import LeakError
+
+
+def lint(src, *passes, rel="<fixture>"):
+    return run_passes([parse_source(textwrap.dedent(src), rel)],
+                      list(passes))
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- swallowed-error ---------------------------------------------------------
+
+def test_log_only_broad_except_fails_and_suppression_passes():
+    src = """
+        def handle(self, req):
+            try:
+                return self.dispatch(req)
+            except Exception:{sup}
+                log.warning("dispatch failed")
+    """
+    findings = lint(src.format(sup=""), ExceptionFlowPass())
+    assert rules(findings) == ["swallowed-error"]
+    assert "unobservable" in findings[0].message
+    sup = "  # lint: ignore[swallowed-error] — fixture reason"
+    assert lint(src.format(sup=sup), ExceptionFlowPass()) == []
+
+
+def test_metric_record_reraise_and_narrow_catches_are_clean():
+    assert lint("""
+        def a(self, req):
+            try:
+                return self.dispatch(req)
+            except Exception:
+                metrics.inc("dispatch.failures")
+
+        def b(self, req):
+            try:
+                return self.dispatch(req)
+            except Exception as exc:
+                flight_recorder.record("dispatch.error", err=str(exc))
+
+        def c(self, req):
+            try:
+                return self.dispatch(req)
+            except Exception:
+                log.warning("context for the re-raise")
+                raise
+
+        def d(self, req):
+            try:
+                return self.table[req]
+            except KeyError:
+                return None
+    """, ExceptionFlowPass()) == []
+
+
+def test_pr8_cancel_swallow_pump_fails_and_reraise_fixes_it():
+    """The golden PR 8 pair: the replication pump whose loop handler
+    ate CancelledError left close() awaiting an uncancellable task
+    (gh-86296). The violating shape fails; ``raise`` fixes it."""
+    violating = """
+        async def _pump(self):
+            while True:
+                try:
+                    await self._ship_once()
+                except asyncio.CancelledError:
+                    pass
+                except Exception:
+                    metrics.inc("repl.pump_errors")
+    """
+    findings = lint(violating, ExceptionFlowPass())
+    assert rules(findings) == ["swallowed-error"]
+    assert "gh-86296" in findings[0].message
+    fixed = violating.replace("pass", "raise")
+    assert lint(fixed, ExceptionFlowPass()) == []
+
+
+def test_cancelled_task_reap_idiom_is_exempt():
+    # awaiting a task you just cancelled raises its CancelledError at
+    # you — suppressing that is teardown, not swallowing
+    assert lint("""
+        async def reap(self):
+            self._task.cancel()
+            try:
+                await self._task
+            except Exception:
+                pass
+    """, ExceptionFlowPass()) == []
+
+
+# -- overbroad-except --------------------------------------------------------
+
+def test_bare_except_on_hot_path_fails_and_suppression_passes():
+    src = """
+        def fetch(self):
+            try:
+                return self._get()
+            except BaseException:{sup}
+                return None
+    """
+    findings = lint(src.format(sup=""), ExceptionFlowPass())
+    assert rules(findings) == ["overbroad-except"]
+    sup = "  # lint: ignore[overbroad-except] — fixture reason"
+    assert lint(src.format(sup=sup), ExceptionFlowPass()) == []
+
+
+def test_shutdown_path_exempts_overbroad_but_not_swallow():
+    # stop() may catch broadest, but a silent pass is still a swallow:
+    # the stronger overbroad claim is waived, the visibility one is not
+    findings = lint("""
+        def stop(self):
+            try:
+                self._sock.close()
+            except BaseException:
+                pass
+    """, ExceptionFlowPass())
+    assert rules(findings) == ["swallowed-error"]
+
+
+def test_carrier_that_set_exceptions_a_future_is_clean():
+    # the dispatch-thread carrier shape: broadest catch whose whole job
+    # is handing the error to the waiter
+    assert lint("""
+        def _worker(self, fut):
+            try:
+                fut.set_result(self._run())
+            except BaseException as exc:
+                fut.set_exception(exc)
+    """, ExceptionFlowPass()) == []
+
+
+def test_exceptionflow_scoped_to_containment_layers():
+    src = """
+        def handle(self, req):
+            try:
+                return self.dispatch(req)
+            except Exception:
+                log.warning("boom")
+    """
+    p = ExceptionFlowPass.for_repo()
+    assert lint(src, p, rel="cassmantle_tpu/ops/attn.py") == []
+    assert rules(lint(src, p, rel="cassmantle_tpu/serving/x.py")) == \
+        ["swallowed-error"]
+
+
+# -- future-discipline: error-path stranding ---------------------------------
+
+def test_error_path_stranding_fails_and_set_exception_fixes_it():
+    violating = """
+        def _complete(self, payload):
+            fut = loop.create_future()
+            try:
+                fut.set_result(self._decode(payload))
+            except Exception:
+                log.warning("decode failed")
+            return fut
+    """
+    findings = lint(violating, FutureDisciplinePass())
+    assert rules(findings) == ["future-discipline"]
+    assert "strands waiter" in findings[0].message
+    fixed = violating.replace(
+        'log.warning("decode failed")',
+        "fut.set_exception(exc)").replace(
+        "except Exception:", "except Exception as exc:")
+    assert lint(fixed, FutureDisciplinePass()) == []
+
+
+def test_error_path_that_reraises_is_clean():
+    assert lint("""
+        def _complete(self, payload):
+            fut = loop.create_future()
+            try:
+                fut.set_result(self._decode(payload))
+            except Exception:
+                raise
+            return fut
+    """, FutureDisciplinePass()) == []
+
+
+# -- future-discipline: unguarded set ----------------------------------------
+
+def test_unguarded_set_on_foreign_future_fails_and_guard_fixes_it():
+    src = """
+        def finish(self, fut, value):
+            {body}
+    """
+    findings = lint(src.format(body="fut.set_result(value)"),
+                    FutureDisciplinePass())
+    assert rules(findings) == ["future-discipline"]
+    assert "InvalidStateError" in findings[0].message
+    guarded = "if not fut.done():\n                fut.set_result(value)"
+    assert lint(src.format(body=guarded), FutureDisciplinePass()) == []
+
+
+def test_suppress_invalidstate_and_creator_sets_are_clean():
+    assert lint("""
+        def finish(self, fut, value):
+            with contextlib.suppress(asyncio.InvalidStateError):
+                fut.set_result(value)
+
+        def mint(self):
+            fut = loop.create_future()
+            fut.set_result(None)   # creator is the sole resolver
+            return fut
+    """, FutureDisciplinePass()) == []
+
+
+# -- future-discipline: the PR 6 stop-strand pair ----------------------------
+
+PR6_VIOLATING = """
+    class BatchQueue:
+        def submit(self, item):
+            fut = concurrent.futures.Future()
+            self._jobs.put((item, fut))
+            return fut
+
+        def stop(self):{sup}
+            self._task.cancel()
+"""
+
+PR6_FIXED = """
+    class BatchQueue:
+        def submit(self, item):
+            fut = concurrent.futures.Future()
+            self._jobs.put((item, fut))
+            return fut
+
+        def stop(self):
+            self._task.cancel()
+            self._drain_pending()
+
+        def _drain_pending(self):
+            while not self._jobs.empty():
+                _, fut = self._jobs.get_nowait()
+                if not fut.done():   # a racing completer may have won
+                    fut.set_exception(RuntimeError("queue stopped"))
+"""
+
+
+def test_pr6_stop_strand_fails_and_drain_fixes_it():
+    """The golden PR 6 pair: stop() that only cancels the consumer
+    strands every queued future (callers block in cf.result()
+    forever); the drain + set_exception fix is clean."""
+    findings = lint(PR6_VIOLATING.format(sup=""), FutureDisciplinePass())
+    assert rules(findings) == ["future-discipline"]
+    assert "PR 6" in findings[0].message
+    assert "cancelling the consumer task is not enough" in \
+        findings[0].message
+    assert lint(PR6_FIXED, FutureDisciplinePass()) == []
+
+
+def test_pr6_shape_suppression_passes():
+    sup = "  # lint: ignore[future-discipline] — fixture reason"
+    assert lint(PR6_VIOLATING.format(sup=sup),
+                FutureDisciplinePass()) == []
+
+
+# -- task-leak ---------------------------------------------------------------
+
+def test_fire_and_forget_create_task_fails_and_suppression_passes():
+    src = """
+        async def kick(self):
+            asyncio.create_task(self._refresh()){sup}
+    """
+    findings = lint(src.format(sup=""), LifecyclePass())
+    assert rules(findings) == ["task-leak"]
+    assert "GC'd mid-flight" in findings[0].message
+    sup = "  # lint: ignore[task-leak] — fixture reason"
+    assert lint(src.format(sup=sup), LifecyclePass()) == []
+
+
+def test_stored_and_callback_retained_tasks_are_clean():
+    assert lint("""
+        async def kick(self):
+            self._refresher = asyncio.create_task(self._refresh())
+            asyncio.create_task(self._probe()).add_done_callback(_log)
+    """, LifecyclePass()) == []
+
+
+# -- thread-leak -------------------------------------------------------------
+
+def test_stop_without_join_fails_and_bounded_join_fixes_it():
+    src = """
+        class Worker:
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True)
+                self._thread.start(){sup}
+
+            def stop(self):
+                {body}
+    """
+    findings = lint(src.format(sup="", body="self._stopping = True"),
+                    LifecyclePass())
+    assert rules(findings) == ["thread-leak"]
+    assert "never joins" in findings[0].message
+    assert lint(src.format(
+        sup="", body="self._thread.join(timeout=5.0)"),
+        LifecyclePass()) == []
+    sup = "  # lint: ignore[thread-leak] — fixture reason"
+    assert lint(src.format(sup=sup, body="self._stopping = True"),
+                LifecyclePass()) == []
+
+
+def test_grab_under_lock_alias_join_counts():
+    # the serving/queue.py _DispatchWorker.stop() idiom: snapshot the
+    # attrs under the lock, join the local alias outside it
+    assert lint("""
+        class Worker:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def stop(self):
+                jobs, thread = self._jobs, self._thread
+                jobs.put(None)
+                thread.join(timeout=5.0)
+    """, LifecyclePass()) == []
+
+
+def test_nondaemon_thread_with_no_stop_path_fails():
+    findings = lint("""
+        class Prober:
+            def boot(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+    """, LifecyclePass())
+    assert rules(findings) == ["thread-leak"]
+    assert "no stop()/close() at all" in findings[0].message
+
+
+def test_anonymous_thread_fails_unless_daemon():
+    findings = lint("""
+        def fire(work):
+            threading.Thread(target=work).start()
+    """, LifecyclePass())
+    assert rules(findings) == ["thread-leak"]
+    assert "anonymous non-daemon" in findings[0].message
+    # deliberate fire-and-forget daemons are the documented blind spot
+    # the runtime sentinel's allowlist mirrors
+    assert lint("""
+        def fire(work):
+            threading.Thread(target=work, daemon=True).start()
+    """, LifecyclePass()) == []
+
+
+def test_local_thread_joined_or_handed_off_is_clean():
+    findings = lint("""
+        def probe_once(target):
+            t = threading.Thread(target=target)
+            t.start()
+    """, LifecyclePass())
+    assert rules(findings) == ["thread-leak"]
+    assert lint("""
+        def probe_once(target):
+            t = threading.Thread(target=target)
+            t.start()
+            t.join(timeout=2.0)
+
+        def spawn(target, registry):
+            t = threading.Thread(target=target)
+            t.start()
+            registry.adopt(t)   # ownership transfer
+            return t
+    """, LifecyclePass()) == []
+
+
+# -- resource-leak -----------------------------------------------------------
+
+def test_class_resource_without_close_path_fails_and_close_fixes_it():
+    src = """
+        class Sink:
+            def open_log(self):
+                self._fh = open("/tmp/x.log", "a"){sup}
+
+            def stop(self):
+                {body}
+    """
+    findings = lint(src.format(sup="", body="self._stopping = True"),
+                    LifecyclePass())
+    assert rules(findings) == ["resource-leak"]
+    assert "EMFILE" in findings[0].message
+    assert lint(src.format(sup="", body="self._fh.close()"),
+                LifecyclePass()) == []
+    sup = "  # lint: ignore[resource-leak] — fixture reason"
+    assert lint(src.format(sup=sup, body="self._stopping = True"),
+                LifecyclePass()) == []
+
+
+def test_local_resource_leak_fails_with_and_transfer_clean():
+    findings = lint("""
+        def slurp(path):
+            fh = open(path)
+            data = fh.read()
+            return data
+    """, LifecyclePass())
+    assert rules(findings) == ["resource-leak"]
+    assert lint("""
+        def slurp(path):
+            with open(path) as fh:
+                return fh.read()
+
+        def closed(path):
+            fh = open(path)
+            data = fh.read()
+            fh.close()
+            return data
+
+        def handoff(path):
+            fh = open(path)
+            return fh   # caller owns it now
+    """, LifecyclePass()) == []
+
+
+# -- the repo itself lints clean ---------------------------------------------
+
+def test_repo_is_lifecycle_clean():
+    from tools.check_lifecycle import check
+
+    assert check() == []
+
+
+def test_check_lifecycle_cli_exits_zero():
+    from tools.check_lifecycle import main
+
+    assert main([]) == 0
+
+
+def test_lint_all_includes_lifecycle_passes():
+    """lint_all's pass set must actually run the lifecycle family in
+    its one walk — a task-leak fixture under a serving/ rel path goes
+    red through all_passes (non-package root, so registry orphan
+    directions stay out of the way)."""
+    import pathlib
+
+    from tools.lint_all import REPO, all_passes
+
+    module = parse_source(textwrap.dedent("""
+        import asyncio
+
+        async def kick(refresh):
+            asyncio.create_task(refresh())
+    """), "cassmantle_tpu/serving/bad_fixture.py")
+    findings = run_passes([module],
+                          all_passes(pathlib.Path(REPO) / "tools"))
+    assert rules(findings) == ["task-leak"]
+
+
+def test_new_rules_documented():
+    import pathlib
+
+    doc = pathlib.Path(__file__).resolve().parents[1] / "docs" / \
+        "STATIC_ANALYSIS.md"
+    text = doc.read_text()
+    for rule in ("swallowed-error", "overbroad-except",
+                 "future-discipline", "task-leak", "thread-leak",
+                 "resource-leak"):
+        assert rule in text, f"rule {rule} missing from catalog"
+    assert "leak_sentinel" in text
+    assert "CASSMANTLE_LEAK_SENTINEL" in text
+
+
+# -- leak sentinel (runtime counterpart) -------------------------------------
+# (the autouse conftest fixture arms the sentinel + resets per test)
+
+def test_seeded_thread_leak_fails_with_creation_site():
+    release = threading.Event()
+    snap = leak_sentinel.snapshot()
+    t = threading.Thread(target=release.wait, name="seeded-leaker")
+    t.start()
+    try:
+        with pytest.raises(LeakError) as exc:
+            leak_sentinel.verify(snap)
+        msg = str(exc.value)
+        assert "seeded-leaker" in msg
+        # the failure names WHO leaked: this file, the t.start() site
+        assert "test_check_lifecycle.py" in msg
+        assert "test_seeded_thread_leak_fails_with_creation_site" in msg
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+
+
+async def test_seeded_task_leak_fails_with_creation_site():
+    import asyncio
+
+    snap = leak_sentinel.snapshot()
+    task = asyncio.get_running_loop().create_task(
+        asyncio.sleep(60), name="seeded-task-leaker")
+    try:
+        with pytest.raises(LeakError) as exc:
+            leak_sentinel.verify(snap)
+        msg = str(exc.value)
+        assert "seeded-task-leaker" in msg
+        assert "test_check_lifecycle.py" in msg
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+def test_seeded_fd_leak_logs_by_default_and_raises_on_request():
+    snap = leak_sentinel.snapshot()
+    if snap["fds"] is None:
+        pytest.skip("no /proc/self/fd on this platform")
+    r, w = os.pipe()
+    try:
+        # default policy: reported, counted, never raised (lazy
+        # process-lifetime caches open fds mid-suite legitimately)
+        leaks = leak_sentinel.verify(snap)
+        assert leaks and "fd(s) opened" in leaks[0]
+        with pytest.raises(LeakError):
+            leak_sentinel.verify(snap, fd_policy="raise")
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+def test_disarmed_sentinel_is_vacuous():
+    leak_sentinel.disable_sentinel()
+    assert not leak_sentinel.sentinel_active()
+    release = threading.Event()
+    snap = leak_sentinel.snapshot()
+    t = threading.Thread(target=release.wait)
+    t.start()
+    try:
+        # not tracked → not reported: disarmed costs nothing and
+        # claims nothing (prod default)
+        assert leak_sentinel.verify(snap) == []
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+
+
+def test_tasks_of_allowlisted_worker_loops_are_not_leaks():
+    """Tasks created ON an allowlisted process/module-lifetime
+    worker's loop (the staged server's queue getters between batches)
+    are its working set, not the test's leak."""
+    import asyncio
+
+    snap = leak_sentinel.snapshot()
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, name="device-probe")
+    t.start()
+    made = threading.Event()
+    box = {}
+
+    def _mk():
+        box["task"] = loop.create_task(asyncio.sleep(60))
+        made.set()
+
+    loop.call_soon_threadsafe(_mk)
+    assert made.wait(5.0)
+    try:
+        # fd_policy off: the loop's own epoll/self-pipe fds are the
+        # subject of teardown below, not of this assertion
+        assert leak_sentinel.verify(snap, fd_policy="off") == []
+    finally:
+        def _fin():
+            box["task"].add_done_callback(lambda _: loop.stop())
+            box["task"].cancel()
+
+        loop.call_soon_threadsafe(_fin)
+        t.join(timeout=5.0)
+        loop.close()
+
+
+def test_dispatch_worker_stop_retires_its_thread():
+    """The stop-retires-the-thread contract the `cassmantle-stage*`
+    allowlist entry could otherwise mask: a DEDICATED dispatch
+    worker's thread must be dead after stop() (bounded join), so a
+    staged-server stop cycle abandons nothing."""
+    from cassmantle_tpu.serving.queue import _DispatchWorker
+
+    worker = _DispatchWorker("stage.test_retire", rank=21)
+    fut, started = worker.submit(lambda: 42)
+    assert fut.result(timeout=5.0) == 42
+    thread = worker._thread
+    assert thread is not None and thread.is_alive()
+    worker.stop()
+    assert not thread.is_alive()
+    assert worker._thread is None
+
+
+def test_allowlisted_singletons_are_not_leaks():
+    release = threading.Event()
+    snap = leak_sentinel.snapshot()
+    t = threading.Thread(target=release.wait, name="device-probe")
+    t.start()
+    try:
+        assert leak_sentinel.verify(snap) == []
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+
+
+def test_prod_scan_counts_growth_log_only():
+    from cassmantle_tpu.utils.logging import metrics
+
+    before = metrics.snapshot()["counters"].get("leaks.threads", 0)
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="prod-leaker")
+    t.start()
+    try:
+        census = leak_sentinel.scan()   # growth vs high-water: counts
+        assert census["threads"] >= 1
+        after = metrics.snapshot()["counters"].get("leaks.threads", 0)
+        assert after >= before + 1
+        # census unchanged → no new growth, no double count
+        leak_sentinel.scan()
+        assert metrics.snapshot()["counters"].get(
+            "leaks.threads", 0) == after
+    finally:
+        release.set()
+        t.join(timeout=5.0)

@@ -1,0 +1,74 @@
+"""Exception-flow & resource-lifecycle lint gate: swallowed errors,
+future discipline, leaked tasks/threads/resources.
+
+Runs the three ``cassmantle_tpu/analysis`` lifecycle passes over the
+package (rule catalog: ``docs/STATIC_ANALYSIS.md``):
+
+- ``swallowed-error`` / ``overbroad-except`` — broad ``except`` bodies
+  in serving/engine/fabric/server/native code that neither re-raise,
+  count a metric, flight-record, classify through the recovery plane,
+  nor carry the error to a waiter; plus the PR 8 cancel-swallow shape
+  (a loop handler that makes its task uncancellable, gh-86296) and
+  ``BaseException``/bare catches outside shutdown paths;
+- ``future-discipline`` — futures that can escape unresolved:
+  error-path stranding, unguarded ``set_result``/``set_exception`` in
+  racy contexts, and classes that enqueue futures their ``stop()``
+  never fails (the PR 6 stranding shape);
+- ``task-leak`` / ``thread-leak`` / ``resource-leak`` — fire-and-forget
+  ``create_task``/``ensure_future``, threads ``stop()`` never joins,
+  sockets/files/executors opened without close-on-stop.
+
+The static half pairs with the runtime leak sentinel
+(``utils/leak_sentinel.py``, armed per-test by conftest), exactly how
+``check_concurrency`` pairs with ``utils/locks.OrderedLock`` and
+``check_jax`` with the jit sentinel.
+
+Run standalone: ``python tools/check_lifecycle.py [cassmantle_tpu/]
+[--json]`` (exit 1 on violations). Gated as a fast-tier test in
+``tests/test_check_lifecycle.py``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from cassmantle_tpu.analysis.core import (  # noqa: E402
+    PACKAGE,
+    iter_modules,
+    main_for,
+    run_passes,
+)
+
+
+def lifecycle_passes(root: pathlib.Path = PACKAGE):
+    """The pass set this tool (and lint_all) runs, fresh instances per
+    walk for symmetry with jax_passes (these passes are stateless
+    today, but the fresh-instance rule is the framework contract)."""
+    from cassmantle_tpu.analysis.exceptionflow import ExceptionFlowPass
+    from cassmantle_tpu.analysis.futuredisc import FutureDisciplinePass
+    from cassmantle_tpu.analysis.lifecycle import LifecyclePass
+
+    del root  # no whole-package-only directions in this family
+    return [ExceptionFlowPass.for_repo(), FutureDisciplinePass.for_repo(),
+            LifecyclePass.for_repo()]
+
+
+def check(root: pathlib.Path = PACKAGE) -> List[str]:
+    """All violations as human-readable strings; empty = clean."""
+    return [str(f) for f in
+            run_passes(iter_modules(root), lifecycle_passes(root))]
+
+
+def main(argv=None) -> int:
+    return main_for(lifecycle_passes, argv, default_root=PACKAGE,
+                    prog="check_lifecycle")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,33 +1,52 @@
 """All static passes, one exit code: metrics + concurrency + jax +
-env flags + fault points.
+env flags + fault points + lifecycle.
 
 The single CI/pre-commit gate: runs the metric-name pass
 (``tools/check_metrics.py``), the three concurrency passes
 (``tools/check_concurrency.py``), the four JAX dispatch-discipline
 passes (``tools/check_jax.py`` — recompile hazards, tracer leaks,
-buffer escapes, env-flag registry), and the fault-point registry pass
-(``analysis/faultpoints.py`` vs docs/CHAOS.md) over the package in one
-module walk, and exits 1 if any pass finds anything. Gated as a
-fast-tier test via ``tests/test_check_concurrency.py``,
-``tests/test_check_jax.py``, and ``tests/test_chaos.py``.
+buffer escapes, env-flag registry), the fault-point registry pass
+(``analysis/faultpoints.py`` vs docs/CHAOS.md), and the three
+exception-flow/lifecycle passes (``tools/check_lifecycle.py`` —
+swallowed errors, future discipline, task/thread/resource leaks) over
+the package in one module walk, and exits 1 if any pass finds
+anything. Gated as a fast-tier test via
+``tests/test_check_concurrency.py``, ``tests/test_check_jax.py``,
+``tests/test_chaos.py``, and ``tests/test_check_lifecycle.py``.
 
 Run standalone: ``python tools/lint_all.py [cassmantle_tpu/] [--json]``.
+
+``--changed`` scopes the walk to package files touched in the working
+tree (``git diff HEAD`` + untracked) — the pre-commit fast path. A
+scoped walk skips the orphan directions (env flags documented but
+never read, fault points registered but never called): those claims
+are only meaningful over the whole package, the same root-aware rule
+``core.main_for`` applies when pointed at a subtree.
 """
 
 from __future__ import annotations
 
 import pathlib
+import subprocess
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
-from cassmantle_tpu.analysis.core import PACKAGE, main_for  # noqa: E402
+from cassmantle_tpu.analysis.core import (  # noqa: E402
+    PACKAGE,
+    format_human,
+    main_for,
+    parse_source,
+    run_passes,
+    to_json,
+)
 from cassmantle_tpu.analysis.faultpoints import FaultPointPass  # noqa: E402
 from cassmantle_tpu.analysis.lockorder import default_passes  # noqa: E402
 from cassmantle_tpu.analysis.metric_names import MetricNamePass  # noqa: E402
 from tools.check_jax import jax_passes  # noqa: E402
+from tools.check_lifecycle import lifecycle_passes  # noqa: E402
 
 
 def all_passes(root=PACKAGE):
@@ -40,12 +59,61 @@ def all_passes(root=PACKAGE):
     except AttributeError:  # pragma: no cover - py<3.9
         covers_package = True
     return [MetricNamePass(), *default_passes(), *jax_passes(root),
-            FaultPointPass(check_orphans=covers_package)]
+            FaultPointPass(check_orphans=covers_package),
+            *lifecycle_passes(root)]
+
+
+def changed_modules():
+    """Package modules touched in the working tree: ``git diff HEAD``
+    (staged + unstaged) plus untracked files, filtered to
+    ``cassmantle_tpu/*.py``. Deleted files drop out (nothing to
+    parse)."""
+    names = set()
+    for args in (["git", "diff", "--name-only", "HEAD", "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        out = subprocess.run(args, cwd=REPO, capture_output=True,
+                             text=True, check=True).stdout
+        names.update(line.strip() for line in out.splitlines()
+                     if line.strip())
+    modules = []
+    for rel in sorted(names):
+        if not rel.endswith(".py") or \
+                not rel.startswith("cassmantle_tpu/"):
+            continue
+        path = REPO / rel
+        if path.exists():
+            modules.append(parse_source(path.read_text(), rel))
+    return modules
 
 
 def main(argv=None) -> int:
-    return main_for(all_passes, argv, default_root=PACKAGE,
-                    prog="lint_all")
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="lint_all")
+    parser.add_argument("root", nargs="?", default=str(PACKAGE))
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only package files touched in the "
+                             "working tree (git diff HEAD + untracked)")
+    args = parser.parse_args(argv)
+    if not args.changed:
+        # the whole-tree run is exactly main_for's contract; delegate
+        # so the CLI shape stays identical across every check_* tool
+        forwarded = [args.root] + (["--json"] if args.json else [])
+        return main_for(all_passes, forwarded, default_root=PACKAGE,
+                        prog="lint_all")
+    modules = changed_modules()
+    # a non-package root pins covers_package False: a changed-files
+    # walk never covers the package, so orphan directions stay off
+    findings = run_passes(modules, all_passes(REPO / "tools"))
+    if args.json:
+        print(to_json(findings))
+    else:
+        print(f"{len(modules)} changed module(s)")
+        print(format_human(findings),
+              file=sys.stderr if findings else sys.stdout)
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":
