@@ -727,6 +727,173 @@ def bench_sd15_lcm(weights_dir: str) -> dict:
     }
 
 
+def _w8a8_smoke_geometry() -> bool:
+    return os.environ.get("BENCH_W8A8_SMOKE_GEOMETRY", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def _bench_w8a8_image_ab(metric: str, weights_dir: str,
+                         sdxl: bool) -> dict:
+    """Same-seed A/B for W8A8 quantized image serving (the `sd15_w8a8`
+    / `sdxl_w8a8` entries, ISSUE 20): fp arm = the fixed DDIM-50
+    schedule on the fused-conv tree, w8a8 arm = the SAME schedule with
+    int8 weights AND activations at every attention/MLP projection and
+    fused-conv ResBlock site (ops/quant.py W8A8 leaves through the
+    ops/quant_matmul.py int8 kernels). Both arms run the SAME prompts
+    and seeds; the record carries img/s per arm, the
+    `pipeline.w8a8_dispatches` counter delta verified in-entry (fp arm
+    silent; w8a8 arm = schedule steps per image — the proof the int8
+    kernel path actually dispatched), and the eval/clip_parity.py
+    w8a8 quality report between the arms' same-seed outputs.
+
+    SD1.5 shares one param tree (Text2ImagePipeline quantizes the fp
+    donor's tree at build); SDXL builds two pipelines because
+    SDXLPipeline's donor contract requires matching quantization mode.
+
+    Env: BENCH_W8A8_SMOKE_GEOMETRY=1 swaps in the 64px test geometry
+    with w8a8_min_size=0 so the tiny matmuls quantize — on SD1.5 that
+    config matches the committed calibration artifact's signature
+    (data/act_scales.json), so the smoke also exercises the
+    static-activation-scale path. Off-TPU the int8 kernels run in
+    Pallas interpret mode: the smoke proves kernel-path engagement and
+    epilogue numerics, not MXU throughput, and is NOT hardware
+    evidence (the BENCH_SUITE.json annotation records this).
+    BENCH_W8A8_REPS overrides the timed rep count."""
+    import dataclasses as _dc
+
+    jax = _setup_jax()
+    from cassmantle_tpu.eval.clip_parity import w8a8_quality_report
+    from cassmantle_tpu.ops import quant
+    from cassmantle_tpu.utils.logging import metrics
+
+    smoke = _w8a8_smoke_geometry()
+    if smoke:
+        from cassmantle_tpu.config import test_config, test_sdxl_config
+
+        seed_cfg = test_sdxl_config() if sdxl else test_config()
+        q_cfg = seed_cfg.replace(models=_dc.replace(
+            seed_cfg.models,
+            unet=_dc.replace(seed_cfg.models.unet, fused_conv=True),
+            unet_w8a8=True, w8a8_min_size=0))
+    elif sdxl:
+        from cassmantle_tpu.config import sdxl_config
+
+        seed_cfg = sdxl_config()
+        q_cfg = seed_cfg.replace(models=_dc.replace(
+            seed_cfg.models,
+            unet=_dc.replace(seed_cfg.models.unet, fused_conv=True,
+                             conv_pad_to=128),
+            unet_w8a8=True))
+    else:
+        from cassmantle_tpu.config import w8a8_serving_config
+
+        q_cfg = w8a8_serving_config()
+    # fp arm = the w8a8 config with ONLY the quantization flags off:
+    # same fused-conv tree layout, same schedule — the A/B isolates
+    # quantization, and on SD1.5 lets the arms share one param tree
+    base = q_cfg.replace(models=_dc.replace(
+        q_cfg.models, unet_w8a8=False, lm_w8a8=False))
+
+    if sdxl:
+        from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+        fp_pipe = SDXLPipeline(base, weights_dir=weights_dir)
+        # the SDXL donor contract requires MATCHING quantization mode
+        # (no lossy cross-mode join), so the w8a8 arm builds its own
+        # pipeline — the loader's param cache keeps the second build
+        # cheap
+        q_pipe = SDXLPipeline(q_cfg, weights_dir=weights_dir)
+    else:
+        from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+        fp_pipe = Text2ImagePipeline(base, weights_dir=weights_dir)
+        q_pipe = Text2ImagePipeline(q_cfg, weights_dir=weights_dir,
+                                    share_params_with=fp_pipe)
+
+    batch = 1 if (sdxl or smoke) else BATCH
+    reps = int(os.environ.get("BENCH_W8A8_REPS", "2" if sdxl else "3"))
+    prompts = (PROMPTS * ((batch + len(PROMPTS) - 1) // len(PROMPTS))
+               )[:batch]
+
+    def run_arm(pipe):
+        before = metrics.counter_total("pipeline.w8a8_dispatches")
+        imgs = pipe.generate(prompts, seed=0)     # warmup compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            imgs = pipe.generate(prompts, seed=1)  # same seed both arms
+        elapsed = time.perf_counter() - t0
+        ips = reps * len(prompts) / elapsed / max(
+            1, jax.local_device_count())
+        images = (reps + 1) * len(prompts)
+        dispatched = (metrics.counter_total("pipeline.w8a8_dispatches")
+                      - before) / images
+        return ips, imgs, dispatched
+
+    fp_ips, fp_imgs, fp_counted = run_arm(fp_pipe)
+    q_ips, q_imgs, q_counted = run_arm(q_pipe)
+    steps = q_cfg.sampler.num_steps
+    assert fp_counted == 0.0, "fp arm must not tick the w8a8 counter"
+    assert q_counted == steps, (
+        f"counter says {q_counted} w8a8 UNet dispatches/image, "
+        f"schedule says {steps}")
+
+    harness = _smoke_clip_harness(weights_dir, smoke)
+    quality = w8a8_quality_report(harness, q_imgs, fp_imgs, prompts)
+
+    return {
+        "metric": metric,
+        "value": round(q_ips, 4),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "ab_versus": ("fp arm (same prompts/seed, separate param tree "
+                      "— SDXL donor contract forbids cross-mode share)"
+                      if sdxl else
+                      "fp arm (same prompts/seed, w8a8 tree quantized "
+                      "from the shared donor)"),
+        "full_images_per_sec": round(fp_ips, 4),
+        "speedup_vs_full": round(q_ips / fp_ips, 4) if fp_ips else None,
+        "batch": batch,
+        "timed_rounds": reps,
+        # the CPU smoke runs the int8 kernels in interpret mode on a
+        # shared host — noisier than the MXU entries
+        "noise_tolerance": 0.35,
+        "w8a8": {
+            "sites": quant.w8a8_site_count(q_pipe.unet_params),
+            "static_act_scales": quant.w8a8_calibrated(
+                q_pipe.unet_params),
+            "dispatches_per_image": int(q_counted),
+            "counter": "pipeline.w8a8_dispatches",
+        },
+        "quality": quality,
+    }
+
+
+def bench_sd15_w8a8(weights_dir: str) -> dict:
+    """A/B arm for full W8A8 serving on the fixed DDIM-50 SD1.5 config
+    (config.w8a8_serving_config): int8 weights and activations at
+    every projection and fused-conv ResBlock site, static calibrated
+    activation scales from data/act_scales.json when the signature
+    matches, halved weight-side HBM streaming (the `t2i_w8a8`
+    cost-model entry carries the analytic bytes). Quality rides the
+    record via eval/clip_parity.py::w8a8_quality_report (0.98 floor —
+    the `w8a8` QualityGateConfig row). CASSMANTLE_NO_W8A8=1 reverts
+    bit-exactly at pipeline build."""
+    return _bench_w8a8_image_ab(
+        "sd15_512px_ddim50_w8a8_images_per_sec_per_chip",
+        weights_dir, sdxl=False)
+
+
+def bench_sdxl_w8a8(weights_dir: str) -> dict:
+    """SDXL twin of `sd15_w8a8`: the 1024² DDIM-50 config served W8A8
+    (sdxl_config + fused_conv/128-lane padding + unet_w8a8 — the
+    `sdxl_w8a8` cost-model entry). The arms are two pipelines because
+    the SDXL donor contract requires matching quantization mode;
+    quality gates via the `sdxl_w8a8` QualityGateConfig row."""
+    return _bench_w8a8_image_ab(
+        "sdxl_1024px_ddim50_w8a8_images_per_sec_per_chip",
+        weights_dir, sdxl=True)
+
+
 def bench_scorer(weights_dir: str) -> dict:
     """BASELINE ladder #1: MiniLM guess scorer, 1k pairs coalesced.
 
@@ -846,6 +1013,113 @@ def bench_gpt2_spec(weights_dir: str) -> dict:
         ["The lighthouse keeper walked down the winding stair"],
         "gpt2_spec_ngram_tokens_per_sec", weights_dir,
         config_factory=spec_decode_serving_config)
+
+
+def bench_gpt2_w8a8(weights_dir: str) -> dict:
+    """Same-seed A/B for the W8A8 prompt LM vs the fp `gpt2` path
+    (ISSUE 20): both arms decode the SAME seed through
+    decode_ids_batch with the same methodology as `_bench_gpt2_with`
+    (warmup compile, 5 best-of reps, tokens actually generated per
+    second). The w8a8 arm quantizes every GPT-2 block projection
+    (qkv/out/fc1/fc2) to int8 with PER-TOKEN activation row scales
+    computed in-graph (no calibration artifact — models/gpt2.py
+    hardcodes act_per_token), so decode numerics track each token's
+    own dynamic range. The record carries tokens/sec per arm, the
+    `pipeline.w8a8_dispatches` counter delta verified in-entry (one
+    tick per bucket-group decode dispatch: fp arm silent, w8a8 arm =
+    warmup + timed reps — the proof the int8 kernel path served the
+    tokens), and greedy token agreement between the arms as the
+    quality report (advisory on random-init weights; on the real
+    checkpoint a low agreement is the signal to re-examine per-token
+    scale clipping).
+
+    Env: BENCH_W8A8_SMOKE_GEOMETRY=1 swaps in the tiny test GPT-2 with
+    w8a8_min_size=0 — off-TPU the int8 kernels run in Pallas interpret
+    mode, far too slow for the full GPT-2-small decode on a CPU
+    smoke."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    jax = _setup_jax()
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+    from cassmantle_tpu.utils.logging import metrics
+
+    smoke = _w8a8_smoke_geometry()
+    if smoke:
+        from cassmantle_tpu.config import test_config
+
+        base = test_config()
+        max_new = 16
+        reps = 3
+    else:
+        from cassmantle_tpu.config import FrameworkConfig
+
+        base = FrameworkConfig()
+        max_new = 96
+        reps = 5
+    q_cfg = base.replace(models=_dc.replace(
+        base.models, lm_w8a8=True,
+        w8a8_min_size=0 if smoke else base.models.w8a8_min_size))
+    seeds = ["The lighthouse keeper walked down the winding stair"]
+
+    def run_arm(cfg):
+        from cassmantle_tpu.ops import quant
+
+        gen = PromptGenerator(cfg, weights_dir=weights_dir)
+        before = metrics.counter_total("pipeline.w8a8_dispatches")
+        gen.decode_ids_batch(seeds, max_new_tokens=max_new)  # warmup
+        tps, ids, gen_len = 0.0, None, None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            ids, gen_len = gen.decode_ids_batch(
+                seeds, max_new_tokens=max_new)
+            n = int(jax.block_until_ready(gen_len).sum())
+            tps = max(tps, n / (time.perf_counter() - t0))
+        dispatches = int(metrics.counter_total(
+            "pipeline.w8a8_dispatches") - before)
+        sites = quant.w8a8_site_count(gen.params)
+        return tps, np.asarray(ids)[0], int(np.asarray(gen_len)[0]), \
+            dispatches, sites, bool(gen.loaded_real_weights)
+
+    fp_tps, fp_ids, fp_len, fp_disp, _, _ = run_arm(base)
+    q_tps, q_ids, q_len, q_disp, q_sites, real = run_arm(q_cfg)
+    assert fp_disp == 0, "fp arm must not tick the w8a8 counter"
+    assert q_disp == reps + 1, (
+        f"counter says {q_disp} w8a8 decode dispatches, "
+        f"arm ran {reps + 1} (warmup + {reps} timed)")
+    assert q_sites > 0, "w8a8 arm quantized zero LM sites"
+
+    # greedy token agreement over the shorter arm's generated tokens:
+    # the quality report for an LM A/B (images have CLIP; decode has
+    # exact token identity)
+    n_cmp = min(fp_len, q_len)
+    agree = float(np.mean(fp_ids[:n_cmp] == q_ids[:n_cmp])) \
+        if n_cmp else 0.0
+
+    return {
+        "metric": "gpt2_w8a8_tokens_per_sec",
+        "value": round(q_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "ab_versus": "fp arm (same seed text, greedy, same bucket)",
+        "full_tokens_per_sec": round(fp_tps, 1),
+        "speedup_vs_full": round(q_tps / fp_tps, 4) if fp_tps else None,
+        "max_new_tokens": max_new,
+        "noise_tolerance": 0.35,
+        "w8a8": {
+            "sites": q_sites,
+            "act_scales": "per-token (dynamic, in-graph)",
+            "decode_dispatches": q_disp,
+            "counter": "pipeline.w8a8_dispatches",
+        },
+        "quality": {
+            "greedy_token_agreement": round(agree, 4),
+            "compared_tokens": int(n_cmp),
+            "gen_len": {"fp": fp_len, "w8a8": q_len},
+            "real_weights": real,
+        },
+    }
 
 
 def _bench_sdxl_with(config_factory, metric: str,
@@ -2593,6 +2867,11 @@ _DELTA_COUNTERS = {
     # retention/abandonment accounting — a perf delta that arrives with
     # probe failures or abandoned traces names its own cause
     "probe.ok", "obs.tail_retained", "obs.traces_abandoned",
+    # W8A8 serving (ISSUE 20): UNet forwards / LM bucket-group decode
+    # dispatches that went through the int8 kernel path — zero in the
+    # fp arms and under CASSMANTLE_NO_W8A8, so the A/B deltas are the
+    # kernel-engagement receipts
+    "pipeline.w8a8_dispatches",
 }
 _DELTA_SUFFIXES = (".dispatch_hangs", ".deadline_expired", ".rejected",
                    ".rejected_degraded", ".failures", ".loop_errors",
@@ -2637,16 +2916,19 @@ SUITE = {
     "sd15_deepcache": bench_sd15_deepcache,
     "sd15_fusedconv": bench_sd15_fusedconv,
     "sd15_int8": bench_sd15_int8,
+    "sd15_w8a8": bench_sd15_w8a8,
     "sd15_staged": bench_sd15_staged,
     "sd15_encprop": bench_sd15_encprop,
     "sd15_lcm": bench_sd15_lcm,
     "sd15_b8": bench_sd15_b8,
     "sdxl": bench_sdxl,
     "sdxl_encprop": bench_sdxl_encprop,
+    "sdxl_w8a8": bench_sdxl_w8a8,
     "sdxl_turbo": bench_sdxl_turbo,
     "scorer": bench_scorer,
     "gpt2": bench_gpt2,
     "gpt2_spec": bench_gpt2_spec,
+    "gpt2_w8a8": bench_gpt2_w8a8,
     "gpt2_b4": bench_gpt2_b4,
     "e2e": bench_e2e_round,
     "soak": bench_soak,

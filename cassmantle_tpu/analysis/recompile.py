@@ -28,6 +28,20 @@ One rule (``recompile-hazard``), four statically-checkable shapes:
    branches on (``if``/``while``/``range``): that branch either fails
    to trace or forces the author to mark it static — one compile per
    distinct value.
+
+A sibling rule (``quant-in-dispatch``, ISSUE 20) pins the
+quantize-once-at-load contract of ops/quant.py: the weight-tree
+quantizers (``quantize_tree_host`` / ``w8a8_tree_host`` /
+``w8a8_tree`` / ``quantize_tree``) are LOAD-TIME transforms. Called
+inside a loop they re-quantize the whole param tree per iteration — a
+host-side bandwidth cliff that also defeats the donor/param caches
+(every call materializes a fresh tree, so every dispatch sees new
+buffer ids). Called inside a jit-traced closure the quantize is baked
+into the traced graph and re-executes per dispatch, throwing away the
+entire point of serving int8 trees. Both shapes are flagged; the fix
+is always the same — quantize once in the loader transform
+(serving/pipeline.py ``w8a8_unet_tools``) and pass the quantized tree
+in.
 """
 
 from __future__ import annotations
@@ -53,6 +67,15 @@ from cassmantle_tpu.analysis.jitregions import (
 )
 
 RULE = "recompile-hazard"
+QUANT_RULE = "quant-in-dispatch"
+
+#: the ops/quant.py load-time tree transforms (quantize-once-at-load
+#: contract — see module docstring). Matched by trailing call name, so
+#: ``quant.w8a8_tree_host(...)`` and a bare imported name both hit.
+QUANT_TREE_TRANSFORMS = frozenset({
+    "quantize_tree", "quantize_tree_host",
+    "w8a8_tree", "w8a8_tree_host",
+})
 
 _LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
           ast.DictComp, ast.GeneratorExp)
@@ -133,6 +156,7 @@ class RecompilePass(LintPass):
         yield from self._scan_jit_in_loop(module)
         yield from self._scan_call_sites(module, fns, entries, aliases)
         yield from self._scan_captures(module, fns, entries, mutated)
+        yield from self._scan_quant_in_dispatch(module, fns, entries)
 
     # -- (1) jit built inside a loop --------------------------------------
 
@@ -278,6 +302,62 @@ class RecompilePass(LintPass):
                 scan(child, in_loop)
 
         scan(module.tree, in_loop=False)
+        yield from findings
+
+    # -- quant-in-dispatch: load-time quantizers re-run per call ----------
+
+    @staticmethod
+    def _quant_transform(node: ast.Call) -> Optional[str]:
+        """Trailing name of an ops/quant.py tree-transform call
+        (``quant.w8a8_tree_host(...)`` or the bare imported name);
+        None otherwise."""
+        name = call_name(node)
+        if name is None:
+            return None
+        leaf = name.rsplit(".", 1)[-1]
+        return leaf if leaf in QUANT_TREE_TRANSFORMS else None
+
+    def _scan_quant_in_dispatch(self, module: Module, fns,
+                                entries: Dict[ast.AST, JitEntry]
+                                ) -> Iterator[Finding]:
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+
+        def report(node: ast.Call, leaf: str, why: str) -> None:
+            if node.lineno in seen:
+                return
+            seen.add(node.lineno)
+            findings.append(Finding(
+                QUANT_RULE, module.rel, node.lineno,
+                f"{leaf}(...) {why} — the ops/quant.py tree "
+                f"transforms are quantize-once-at-LOAD; quantize in "
+                f"the loader transform and pass the quantized tree in",
+                getattr(node, "end_lineno", None)))
+
+        def scan(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, _LOOPS):
+                in_loop = True
+            if in_loop and isinstance(node, ast.Call):
+                leaf = self._quant_transform(node)
+                if leaf is not None:
+                    report(node, leaf,
+                           "inside a loop re-quantizes the whole "
+                           "param tree per iteration (a host "
+                           "bandwidth cliff that also hands every "
+                           "dispatch fresh buffer ids)")
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_loop)
+
+        scan(module.tree, in_loop=False)
+        for fn in jit_closure(module.tree, fns, set(entries)):
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    leaf = self._quant_transform(n)
+                    if leaf is not None:
+                        report(n, leaf,
+                               f"inside jit-traced {fn.name!r} bakes "
+                               f"a per-dispatch requantize into the "
+                               f"compiled graph")
         yield from findings
 
     # -- (3) mutable attribute capture ------------------------------------

@@ -218,6 +218,26 @@ class ModelZooConfig:
     # sees bf16 tiles. Quality must be re-gated via tools/clip_report.py
     # when enabled.
     unet_int8: bool = False
+    # Full W8A8 for the diffusion UNet (ISSUE 20): selected kernel
+    # leaves become ActQTensors (ops/quant.py w8a8_tree_host) and the
+    # attention/MLP/fused-conv sites dispatch the int8 Pallas kernels
+    # (ops/quant_matmul.py) — int8 weights AND activations, scales
+    # folded into the int32→fp epilogue. Requires unet.fused_conv for
+    # the conv sites; mutually exclusive with unet_int8. Static
+    # activation scales load from the calibration artifact
+    # (parallel/calibrate.py, data/act_scales.json) when its signature
+    # matches, dynamic absmax otherwise. CASSMANTLE_NO_W8A8 kill switch
+    # reverts bit-exactly at pipeline build (never quantizes).
+    unet_w8a8: bool = False
+    # Full W8A8 for the prompt LM with PER-TOKEN activation scales
+    # (models/gpt2.py); mutually exclusive with lm_int8. Same artifact,
+    # kill switch, and epilogue scheme as unet_w8a8.
+    lm_w8a8: bool = False
+    # Minimum weight-element count for a site to quantize under w8a8
+    # (ops/quant.py w8a8_default_predicate): small kernels aren't worth
+    # the quantize/dequantize round-trip. Tests drop it to 0 so reduced
+    # test-geometry models still exercise the int8 kernel path.
+    w8a8_min_size: int = 1 << 16
 
 
 @dataclasses.dataclass(frozen=True)
@@ -636,6 +656,14 @@ class QualityGateConfig:
         # the biggest step-count win (LCM-class results, PAPERS.md
         # Efficient Diffusion Models survey)
         ("lcm", 0.90),
+        # full W8A8 (int8 weights AND activations, ISSUE 20) rounds
+        # twice per matmul; with per-channel weight scales + calibrated
+        # activation scales it must stay near-lossless, a hair below
+        # the weights-only int8 bar. One row per image pipeline —
+        # SDXL's depth-10 transformer level accumulates more
+        # quantization noise than SD1.5's depth-1 blocks.
+        ("w8a8", 0.98),
+        ("sdxl_w8a8", 0.98),
     )
     # absolute floor for the anchor itself: catches a pipeline bug that
     # degrades every preset uniformly (ratios would all still pass)
@@ -721,6 +749,30 @@ def fusedconv_serving_config() -> FrameworkConfig:
     return base.replace(models=dataclasses.replace(
         base.models, unet=dataclasses.replace(
             base.models.unet, fused_conv=True, conv_pad_to=128)))
+
+
+def w8a8_serving_config() -> FrameworkConfig:
+    """The fixed DDIM-50 config served fully W8A8 (ISSUE 20): int8
+    weights AND activations at every attention/MLP/GEGLU projection and
+    fused-conv ResBlock site in the UNet, plus the prompt LM with
+    per-token activation scales — the quantization lever the Efficient
+    Diffusion survey (PAPERS.md) ranks beside step reduction, composing
+    multiplicatively with encprop/LCM/staged since it changes how
+    matmuls execute, not what the schedule computes. Rides the fused
+    GN+SiLU+conv path (fused_conv=True + 128-lane padding), so this is
+    fusedconv_serving_config plus quantized trees. Static activation
+    scales come from the committed calibration artifact
+    (data/act_scales.json) when its signature matches this config;
+    quality gates via the `w8a8` QualityGateConfig row; this is the ON
+    arm of the `sd15_w8a8`/`gpt2_w8a8` bench A/Bs.
+    CASSMANTLE_NO_W8A8=1 reverts bit-exactly at pipeline build."""
+
+    base = FrameworkConfig()
+    return base.replace(models=dataclasses.replace(
+        base.models,
+        unet=dataclasses.replace(
+            base.models.unet, fused_conv=True, conv_pad_to=128),
+        unet_w8a8=True, lm_w8a8=True))
 
 
 def spec_decode_serving_config() -> FrameworkConfig:

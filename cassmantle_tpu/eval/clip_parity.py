@@ -223,6 +223,46 @@ def consistency_quality_report(
     }
 
 
+# Image-quality floor for W8A8 quantized serving (ISSUE 20): mean
+# CLIP-vision similarity between the int8-kernel arm's images and the
+# fp arm's SAME-SEED images. Higher than the consistency floor —
+# quantization is a numerics approximation of the SAME trajectory
+# (per-channel weight scales + calibrated activation scales), not a
+# learned shortcut; the `w8a8`/`sdxl_w8a8` rows of QualityGateConfig
+# carry the per-pipeline bars. Enforced only on real-weights runs,
+# advisory on random init, like every other gate.
+W8A8_IMAGE_SIM_FLOOR = 0.98
+
+
+def w8a8_quality_report(
+    harness: ClipSimilarityHarness,
+    images_w8a8: np.ndarray,
+    images_fp: np.ndarray,
+    prompts: Sequence[str],
+    floor: float = W8A8_IMAGE_SIM_FLOOR,
+) -> dict:
+    """The W8A8 quality gate: same-seed quantized vs fp outputs
+    compared in CLIP-vision space (the encprop gate's structure applied
+    to the int8 kernel path). ``passes_floor`` is the gate verdict;
+    ``gate_enforced`` says whether it is a real-weights measurement or
+    plumbing-only."""
+    pair = harness.image_similarity(images_w8a8, images_fp)
+    return {
+        "image_sim_mean": float(np.mean(pair)),
+        "image_sim_min": float(np.min(pair)),
+        "floor": float(floor),
+        "passes_floor": bool(np.mean(pair) >= floor),
+        "exact": bool(np.array_equal(images_w8a8, images_fp)),
+        "clip_sim_w8a8": float(
+            np.mean(harness.similarity(images_w8a8, prompts))),
+        "clip_sim_fp": float(
+            np.mean(harness.similarity(images_fp, prompts))),
+        "n": int(images_fp.shape[0]),
+        "real_weights": harness.loaded_real_weights,
+        "gate_enforced": harness.loaded_real_weights,
+    }
+
+
 def encprop_quality_report(
     harness: ClipSimilarityHarness,
     images_encprop: np.ndarray,

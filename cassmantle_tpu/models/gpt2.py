@@ -38,8 +38,12 @@ class GPT2Block(nn.Module):
     def __call__(self, x, mask=None, kv_cache=None, return_kv=False,
                  causal=False):
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
+        # act_per_token: under W8A8 (lm_w8a8) LM activations quantize
+        # with per-token scales — decode activations are outlier-heavy
+        # per position, and a row-max costs nothing against the matmul
         attn_out = MultiHeadAttention(
-            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn"
+            num_heads=self.cfg.num_heads, dtype=self.dtype, name="attn",
+            act_per_token=True,
         )(h, mask=mask, kv_cache=kv_cache, return_kv=return_kv,
           causal=causal)
         if kv_cache is not None or return_kv:
@@ -50,7 +54,7 @@ class GPT2Block(nn.Module):
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
         x = x + TransformerMLP(
             intermediate=self.cfg.hidden_size * 4, dtype=self.dtype,
-            name="mlp",
+            name="mlp", act_per_token=True,
         )(h)
         return x, kv
 

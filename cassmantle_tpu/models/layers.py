@@ -18,6 +18,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from cassmantle_tpu.ops import quant
 from cassmantle_tpu.ops.attention import multi_head_attention
 
 
@@ -73,6 +74,63 @@ def chunk_causal_mask(valid: jax.Array, index: jax.Array, length: int,
     return valid[:, None, None, :] & ok[None, None, :, :]
 
 
+class QDense(nn.Module):
+    """Param-twin of ``nn.Dense`` whose kernel leaf may be quantized.
+
+    Declares kernel/bias with nn.Dense's exact names, shapes,
+    initializers, and RNG fold path, so checkpoints, the init cache, and
+    every converter see one tree. At apply time it branches on the leaf:
+
+    - plain array → nn.Dense's exact computation (same promote_dtype +
+      dot_general + bias reshape), bit-identical to the module it
+      replaces — which is what lets the w8a8 kill switch revert
+      bit-exactly by simply not quantizing at load;
+    - :class:`~cassmantle_tpu.ops.quant.ActQTensor` (the W8A8 serving
+      tree, ops/quant.py ``w8a8_tree_host``) → the int8 Pallas matmul
+      with scales folded into the int32→fp epilogue
+      (ops/quant_matmul.py ``w8a8_dense``), per-token activation scales
+      when ``act_per_token`` (the LM decode path).
+
+    Also the calibration tap: when a ``collect_act_stats`` pass is
+    active (eager, parallel/calibrate.py) it records this site's input
+    absmax under its flax path — zero traced ops otherwise.
+
+    Used at every w8a8-capable site (attention projections, transformer
+    MLPs, GEGLU); plain ``nn.Dense`` remains at quality-sensitive or
+    tiny sites (time embeds, heads, proj_in/out), which the w8a8
+    predicate whitelist (ops/quant.py) therefore must never select.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    act_per_token: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features))
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,)) if self.use_bias else None
+        if quant.act_stats_active():
+            quant.note_act_stat("/".join(self.path), x)
+        if isinstance(kernel, quant.ActQTensor):
+            from cassmantle_tpu.ops.quant_matmul import w8a8_dense
+
+            return w8a8_dense(x, kernel, bias,
+                              out_dtype=self.dtype or x.dtype,
+                              per_token=self.act_per_token)
+        from flax.linen.dtypes import promote_dtype
+
+        x, kernel, bias = promote_dtype(x, kernel, bias,
+                                        dtype=self.dtype)
+        y = jax.lax.dot_general(
+            x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
+        if bias is not None:
+            y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y
+
+
 class MultiHeadAttention(nn.Module):
     """Projection + ops.attention + out-projection.
 
@@ -92,6 +150,9 @@ class MultiHeadAttention(nn.Module):
     # dot — full-forward sites only (UNet); incompatible with the
     # kv-cache decode path, which updates k/v separately.
     fused_qkv: bool = False
+    # W8A8 activation-scale granularity for the projection QDenses:
+    # per-token on the LM path (models/gpt2.py), per-tensor elsewhere.
+    act_per_token: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -118,9 +179,9 @@ class MultiHeadAttention(nn.Module):
         out_dim = self.out_dim or features
         ctx = x if context is None else context
 
-        dense = lambda name, mult=1: nn.Dense(  # noqa: E731
+        dense = lambda name, mult=1: QDense(  # noqa: E731
             mult * inner, use_bias=self.use_bias, dtype=self.dtype,
-            name=name
+            name=name, act_per_token=self.act_per_token
         )
         if self.fused_qkv:
             # One projection dot instead of three: the input activation
@@ -166,11 +227,12 @@ class MultiHeadAttention(nn.Module):
 
         out = multi_head_attention(q, k, v, mask=mask, causal=causal)
         out = out.reshape(out.shape[:-2] + (inner,))
-        out = nn.Dense(
+        out = QDense(
             out_dim,
             use_bias=(self.use_bias if self.out_bias is None
                       else self.out_bias),
             dtype=self.dtype, name="out",
+            act_per_token=self.act_per_token,
         )(out)
         if kv_out is not None:
             return out, kv_out
@@ -182,14 +244,17 @@ class TransformerMLP(nn.Module):
 
     intermediate: int
     activation: Callable = nn.gelu
+    act_per_token: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         features = x.shape[-1]
-        h = nn.Dense(self.intermediate, dtype=self.dtype, name="fc1")(x)
+        h = QDense(self.intermediate, dtype=self.dtype, name="fc1",
+                   act_per_token=self.act_per_token)(x)
         h = self.activation(h)
-        return nn.Dense(features, dtype=self.dtype, name="fc2")(h)
+        return QDense(features, dtype=self.dtype, name="fc2",
+                      act_per_token=self.act_per_token)(h)
 
 
 class GEGLU(nn.Module):
@@ -201,10 +266,10 @@ class GEGLU(nn.Module):
     @nn.compact
     def __call__(self, x):
         features = x.shape[-1]
-        h = nn.Dense(self.intermediate * 2, dtype=self.dtype, name="proj")(x)
+        h = QDense(self.intermediate * 2, dtype=self.dtype, name="proj")(x)
         h, gate = jnp.split(h, 2, axis=-1)
         h = h * nn.gelu(gate)
-        return nn.Dense(features, dtype=self.dtype, name="out")(h)
+        return QDense(features, dtype=self.dtype, name="out")(h)
 
 
 def quick_gelu(x):
@@ -343,7 +408,21 @@ def fused_gn_silu_conv3x3(x, out_channels: int, dtype,
 
     a, b = GroupNorm32(epsilon=epsilon, name=norm_name)(
         x, return_affine=True)
-    kernel, bias = Conv3x3Params(out_channels, name=conv_name)(x.shape[-1])
+    act_stat_of = None
+    if quant.act_stats_active():
+        # calibration probe: the conv's actual input is silu(x*a+b),
+        # which only the kernel normally materializes — reproduce it
+        # lazily here (eager calibration pass only; never traced)
+        act_stat_of = lambda: jax.nn.silu(  # noqa: E731
+            x * a[:, None, None, :].astype(x.dtype)
+            + b[:, None, None, :].astype(x.dtype))
+    kernel, bias = Conv3x3Params(out_channels, name=conv_name)(
+        x.shape[-1], act_stat_of=act_stat_of)
+    if isinstance(kernel, quant.ActQTensor):
+        from cassmantle_tpu.ops.quant_matmul import gn_silu_conv3x3_w8a8
+
+        return gn_silu_conv3x3_w8a8(x, a, b, kernel, bias,
+                                    pad_to=pad_to)
     return gn_silu_conv3x3(x, a, b, kernel.astype(dtype),
                            bias.astype(dtype), pad_to=pad_to)
 
@@ -364,9 +443,14 @@ class Conv3x3Params(nn.Module):
     features: int
 
     @nn.compact
-    def __call__(self, in_features: int):
+    def __call__(self, in_features: int, act_stat_of=None):
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(),
             (3, 3, in_features, self.features))
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        if act_stat_of is not None and quant.act_stats_active():
+            # w8a8 calibration tap (ops/quant.py): records this site's
+            # conv-input absmax under the module path — the same key
+            # the w8a8 tree transform looks up
+            quant.note_act_stat("/".join(self.path), act_stat_of())
         return kernel, bias

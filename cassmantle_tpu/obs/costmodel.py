@@ -177,17 +177,31 @@ def _digest(*parts) -> str:
                           .encode()).hexdigest()[:16]
 
 
+def _w8a8_effective(flag: bool) -> bool:
+    """The ARMED w8a8 state for signature purposes: under the
+    CASSMANTLE_NO_W8A8 kill switch a w8a8 config serves the fp path,
+    and its dispatches must resolve the fp cost entry — same rationale
+    as effective_sampler_cfg for the consistency kill switch."""
+    if not flag:
+        return False
+    from cassmantle_tpu.ops.quant_matmul import w8a8_disabled
+
+    return not w8a8_disabled()
+
+
 def t2i_signature(cfg, sampler_cfg=None) -> str:
     """SD1.5 text→image dispatch signature: everything the analytic
     per-image FLOPs depend on (model archs + the sampler geometry —
     ``consistency`` included, since the few-step path runs num_steps
-    direct forwards of the same UNet)."""
+    direct forwards of the same UNet; the ARMED w8a8 state included,
+    since quantized serving halves weight-side HBM bytes and the
+    committed w8a8 variant carries its own roofline entry)."""
     s = sampler_cfg if sampler_cfg is not None else cfg.sampler
     m = cfg.models
     return _digest("t2i", m.unet.arch(), m.vae.arch(), m.clip_text,
                    s.image_size, s.num_steps, s.kind, s.deepcache,
                    s.encprop, s.encprop_stride, s.encprop_dense_steps,
-                   s.consistency)
+                   s.consistency, _w8a8_effective(m.unet_w8a8))
 
 
 def sdxl_signature(cfg, sampler_cfg=None) -> str:
@@ -196,13 +210,17 @@ def sdxl_signature(cfg, sampler_cfg=None) -> str:
     return _digest("sdxl", m.unet.arch(), m.vae.arch(), m.clip_text,
                    m.clip_text_2, s.image_size, s.num_steps, s.kind,
                    s.deepcache, s.encprop, s.encprop_stride,
-                   s.encprop_dense_steps, s.consistency)
+                   s.encprop_dense_steps, s.consistency,
+                   _w8a8_effective(m.unet_w8a8))
 
 
-def lm_signature(mcfg) -> str:
+def lm_signature(mcfg, w8a8: bool = False) -> str:
     """Prompt-LM signature: the model config alone — decode FLOPs are
-    2·N(params)·tokens regardless of sampler knobs."""
-    return _digest("lm", mcfg)
+    2·N(params)·tokens regardless of sampler knobs. ``w8a8``: the
+    ARMED lm_w8a8 state (the caller owns the ModelZooConfig; pass
+    ``_w8a8_effective(models.lm_w8a8)``) — the quantized tree streams
+    half the weight bytes per token, a separate committed entry."""
+    return _digest("lm", mcfg, _w8a8_effective(w8a8))
 
 
 def scorer_signature(mcfg, seq_len: int) -> str:

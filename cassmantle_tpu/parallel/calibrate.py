@@ -1,0 +1,223 @@
+"""Activation-scale calibration for W8A8 serving (ISSUE 20).
+
+The int8 W8A8 kernels (ops/quant_matmul.py) scale activations before
+quantizing. Dynamic mode computes absmax in-graph per dispatch — always
+correct, but it reads the fp activation twice (max, then quantize) and
+its scale wobbles with batch content. STATIC mode folds a calibrated
+per-site scale into the quantized param tree at load time: one read,
+content-independent numerics, and the scale constant-folds into the
+epilogue. This module is where static scales come from.
+
+The pass runs N real seed prompts (data/seeds.txt — the same titles the
+game serves) through the UNMODIFIED fp pipeline EAGERLY and collects
+per-site activation absmax through the thread-local recorder
+(ops/quant.py collect_act_stats; the recorder skips tracers by design,
+so a jitted forward records nothing — calibration must stay eager).
+Site keys are flax module paths, the exact keys the tree transform
+(w8a8_tree_host) folds scales back into.
+
+Artifact discipline (the cost-model/embed-table contract): the emitted
+``data/act_scales.json`` is signature-gated — a digest over the model
+config and the calibration prompt set. Serving loads scales ONLY when
+an entry's signature matches the runtime config; anything else (config
+drift, edited seeds, missing file) falls back to dynamic scales and
+logs the rebuild command. The committed artifact is emitted from
+``calibration_config()`` (reduced test geometry, random-init weights —
+honest about what a CPU container can run; tier-1 then exercises the
+static-scale path end to end). A production fleet re-emits against its
+own config + real weights and commits that entry alongside.
+
+Rebuild + commit:
+
+    python -m cassmantle_tpu.parallel.calibrate --emit
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+from cassmantle_tpu.utils.logging import get_logger
+
+log = get_logger("calibrate")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ACT_SCALES_PATH = os.path.join(_REPO_ROOT, "data", "act_scales.json")
+
+#: prompts per calibration pass: enough to spread content styles, small
+#: enough that the eager fp forwards stay a one-minute offline job
+NUM_CALIBRATION_PROMPTS = 8
+
+#: denoise timesteps sampled per prompt — a spread across the schedule
+#: (activation ranges drift from pure-noise t≈1000 to near-image t≈0)
+CALIBRATION_TIMESTEPS = (981, 661, 341, 21)
+
+
+def calibration_prompts(n: int = NUM_CALIBRATION_PROMPTS) -> list:
+    """The first ``n`` seed titles — real serving content, versioned
+    with the repo so the calibration set digests deterministically."""
+    from cassmantle_tpu.server.assets import load_seeds
+
+    return list(load_seeds())[:n]
+
+
+def prompts_digest(prompts: Sequence[str]) -> str:
+    return hashlib.sha256("\n".join(prompts).encode()).hexdigest()[:16]
+
+
+def calibration_signature(models_cfg, prompts_dig: str) -> str:
+    """What gates an artifact entry to a runtime config: the UNet arch
+    + text-encoder config (the modules whose activations were recorded)
+    and the calibration-set digest. One definition, used by --emit and
+    by serving's loader — drift on either side un-matches the entry."""
+    from cassmantle_tpu.obs.costmodel import _digest
+
+    return _digest("act_scales", models_cfg.unet.arch(),
+                   models_cfg.clip_text, prompts_dig)
+
+
+def calibration_config():
+    """The config the COMMITTED artifact is emitted from: the tiny CPU
+    test geometry with the fused-conv path on (the w8a8 serving
+    contract requires it, serving/pipeline.py w8a8_unet_tools) and the
+    site floor dropped so every kernel site records. Production fleets
+    emit with their own config instead."""
+    from cassmantle_tpu.config import test_config
+
+    base = test_config()
+    m = base.models
+    return dataclasses.replace(base, models=dataclasses.replace(
+        m,
+        unet=dataclasses.replace(m.unet, fused_conv=True),
+        w8a8_min_size=0,
+    ))
+
+
+def collect_unet_stats(cfg, weights_dir: Optional[str] = None,
+                       prompts: Optional[Sequence[str]] = None,
+                       timesteps: Sequence[int] = CALIBRATION_TIMESTEPS,
+                       ) -> Dict[str, float]:
+    """Per-site activation absmax for the image UNet: eager fp forwards
+    over the calibration prompts at a spread of denoise timesteps.
+    Deterministic for a fixed (config, weights, prompt set): latents
+    come from fixed PRNG keys and the recorder keeps a running max."""
+    import jax
+    import jax.numpy as jnp
+
+    from cassmantle_tpu.ops import quant
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    m = cfg.models
+    assert not (m.unet_w8a8 or m.lm_w8a8), (
+        "calibration runs the UNMODIFIED fp path; strip the w8a8 flags "
+        "from the config first (they would quantize the very "
+        "activations being measured)")
+    prompts = list(prompts if prompts is not None
+                   else calibration_prompts())
+    pipe = Text2ImagePipeline(cfg, weights_dir)
+    ids = jnp.asarray(pipe._tokenize(prompts))
+    # context OUTSIDE the recorder: CLIP's own attention/MLP sites must
+    # not pollute the UNet entry (separate trees, separate paths)
+    ctx = pipe.clip.apply(pipe.clip_params, ids)["hidden"]
+    lat_hw = cfg.sampler.image_size // pipe.vae_scale
+    with quant.collect_act_stats() as stats:
+        for i, t in enumerate(timesteps):
+            lat = jax.random.normal(
+                jax.random.PRNGKey(i),
+                (len(prompts), lat_hw, lat_hw, 4), jnp.float32)
+            tvec = jnp.full((len(prompts),), int(t), jnp.int32)
+            pipe.unet.apply(pipe.unet_params, lat, tvec, ctx)
+    return dict(stats)
+
+
+def emit(path: str = ACT_SCALES_PATH, cfg=None,
+         weights_dir: Optional[str] = None) -> dict:
+    """Run the calibration pass and write the signed artifact."""
+    cfg = cfg or calibration_config()
+    prompts = calibration_prompts()
+    dig = prompts_digest(prompts)
+    stats = collect_unet_stats(cfg, weights_dir, prompts)
+    artifact = {
+        "version": 1,
+        "generated_by": "python -m cassmantle_tpu.parallel.calibrate "
+                        "--emit",
+        "note": "per-site activation absmax from EAGER fp forwards over "
+                "the calibration prompt set (module docstring); scales "
+                "derive as absmax/qmax at load (ops/quant.py "
+                "act_scale_from_absmax). Committed entry: reduced test "
+                "geometry, random-init weights — re-emit per fleet "
+                "against production config + real checkpoints.",
+        "entries": {
+            "unet": {
+                "signature": calibration_signature(cfg.models, dig),
+                "prompts_digest": dig,
+                "num_prompts": len(prompts),
+                "timesteps": list(CALIBRATION_TIMESTEPS),
+                "scales": {k: float(v) for k, v in sorted(stats.items())},
+            },
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log.info("wrote %s: %d sites, signature %s", path, len(stats),
+             artifact["entries"]["unet"]["signature"])
+    return artifact
+
+
+def load_act_scales(models_cfg, path: str = ACT_SCALES_PATH,
+                    ) -> Optional[Dict[str, float]]:
+    """The committed entry's site→absmax map IF its signature matches
+    this runtime config; None otherwise (serving then quantizes with
+    dynamic in-graph scales — correct, just not constant-folded). Never
+    raises: a missing/corrupt artifact must not break serving."""
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except Exception:
+        log.warning(
+            "w8a8: no calibration artifact at %s — dynamic activation "
+            "scales; rebuild with `python -m "
+            "cassmantle_tpu.parallel.calibrate --emit`", path)
+        return None
+    for name, entry in artifact.get("entries", {}).items():
+        if not isinstance(entry, dict):
+            continue
+        expect = calibration_signature(
+            models_cfg, str(entry.get("prompts_digest")))
+        if entry.get("signature") == expect:
+            scales = entry.get("scales") or {}
+            return {str(k): float(v) for k, v in scales.items()}
+    log.warning(
+        "w8a8: no calibration entry in %s matches this model config — "
+        "dynamic activation scales; rebuild with `python -m "
+        "cassmantle_tpu.parallel.calibrate --emit` and commit the "
+        "artifact", path)
+    return None
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--emit", action="store_true",
+                    help="run the calibration pass and write the "
+                         "signed artifact")
+    ap.add_argument("--out", default=ACT_SCALES_PATH)
+    ap.add_argument("--weights-dir", default=None,
+                    help="checkpoint dir (random init when absent — "
+                         "the emitted note says which)")
+    args = ap.parse_args(argv)
+    if not args.emit:
+        ap.print_help()
+        return 2
+    emit(args.out, weights_dir=args.weights_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,6 +128,58 @@ def int8_unet_tools(models_cfg):
                 apply, jnp.dtype(models_cfg.param_dtype)))
 
 
+def unet_w8a8_armed(models_cfg) -> bool:
+    """True when the UNet actually serves through the int8 W8A8 kernels:
+    the config knob AND the kill switch agree. The kill switch is read
+    at pipeline BUILD time (never per dispatch): with it set the param
+    tree is never quantized, so every module takes its plain fp branch
+    and the revert is bit-exact against an unquantized build."""
+    from cassmantle_tpu.ops.quant_matmul import w8a8_disabled
+
+    return bool(models_cfg.unet_w8a8) and not w8a8_disabled()
+
+
+def lm_w8a8_armed(models_cfg) -> bool:
+    """LM twin of :func:`unet_w8a8_armed` (same build-time kill-switch
+    contract; per-token activation scales, models/gpt2.py)."""
+    from cassmantle_tpu.ops.quant_matmul import w8a8_disabled
+
+    return bool(models_cfg.lm_w8a8) and not w8a8_disabled()
+
+
+def w8a8_unet_tools(models_cfg):
+    """Loader transform for the W8A8 UNet option, or None when off —
+    the one place the image-side W8A8 serving contract lives (shared by
+    the SD1.5 and SDXL pipelines, like int8_unet_tools): quantize
+    weights host-side before device placement (per-output-channel int8
+    scales), folding in static activation scales when the committed
+    calibration artifact matches this model config's signature (else
+    the kernels fall back to dynamic per-dispatch absmax). Unlike
+    int8_unet_tools there is NO apply wrapper: the quantized leaves ride
+    the tree into the unchanged ``unet.apply`` and each QDense /
+    fused-conv site branches on its own leaf type."""
+    if not unet_w8a8_armed(models_cfg):
+        return None
+    assert not models_cfg.unet_int8, (
+        "unet_w8a8 and unet_int8 are mutually exclusive: both rewrite "
+        "the same kernel leaves")
+    assert models_cfg.unet.fused_conv, (
+        "unet_w8a8 conv sites ride the fused GN+SiLU+conv path "
+        "(ops/quant_matmul.py quantizes the fused activation); set "
+        "models.unet.fused_conv=True")
+    from cassmantle_tpu.ops.quant import (
+        w8a8_default_predicate,
+        w8a8_tree_host,
+    )
+    from cassmantle_tpu.parallel.calibrate import load_act_scales
+
+    scales = load_act_scales(models_cfg)
+    pred = partial(w8a8_default_predicate,
+                   min_size=models_cfg.w8a8_min_size)
+    return lambda params: w8a8_tree_host(
+        params, act_scales=scales, predicate=pred)
+
+
 def deepcache_schedule(sampler_cfg):
     """Validate a deepcache sampler config and build the matching
     schedule (shared by the SD1.5 and SDXL pipelines, like
@@ -250,6 +302,21 @@ def note_consistency_counter(sampler_cfg, n_images: int) -> None:
     if sampler_cfg.consistency and not consistency_disabled():
         metrics.inc("pipeline.consistency_steps",
                     sampler_cfg.num_steps * n_images)
+
+
+def note_w8a8_counter(models_cfg, sampler_cfg, n_images: int) -> None:
+    """Diagnosis counter for quantized serving (host-side, derived from
+    the static schedule like note_consistency_counter): how many UNet
+    forwards the dispatch ran through the int8 W8A8 kernel path —
+    ``pipeline.w8a8_dispatches``. The `sd15_w8a8`/`sdxl_w8a8` bench A/B
+    receipts attach this delta to prove the kernel path actually
+    engaged (a CPU smoke that silently fell back to fp would otherwise
+    look like a 1.0x win). Silent when the knob is off or the kill
+    switch reverted the build, so A/B counter deltas separate the
+    arms."""
+    if unet_w8a8_armed(models_cfg):
+        metrics.inc("pipeline.w8a8_dispatches",
+                    effective_sampler_steps(sampler_cfg) * n_images)
 
 
 def run_cfg_denoise(sampler_cfg, sample_latents, dc_schedule, unet_apply,
@@ -457,6 +524,12 @@ class Text2ImagePipeline:
         # pixels per latent: one 2x upsample per VAE level transition
         self.vae_scale = 2 ** (len(m.vae.channel_mults) - 1)
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
+        w8a8_transform = w8a8_unet_tools(m)
+        if w8a8_transform is not None:
+            # mutually exclusive with unet_int8 (asserted in
+            # w8a8_unet_tools), so int8_unet_tools returned (None,
+            # identity) and the slot is free
+            unet_transform = w8a8_transform
 
         def load_unet(transform):
             """maybe_load-or-init for the UNet tree, shared by the
@@ -490,9 +563,17 @@ class Text2ImagePipeline:
                 self.clip_params = donor.clip_params
                 self.vae_params = donor.vae_params
                 unet_was_loaded = True
-                if donor.cfg.models.unet_int8 == m.unet_int8:
+                donor_m = donor.cfg.models
+                donor_plain = (not donor_m.unet_int8
+                               and not unet_w8a8_armed(donor_m))
+                if (donor_m.unet_int8 == m.unet_int8
+                        and unet_w8a8_armed(donor_m)
+                        == unet_w8a8_armed(m)):
+                    # same quantization mode (both fp, both int8, or
+                    # both w8a8 with the same effective kill-switch
+                    # state): share the device buffers outright
                     self.unet_params = donor.unet_params
-                elif m.unet_int8:
+                elif m.unet_int8 and donor_plain:
                     # int8 arm joining an fp donor: quantize the donor's
                     # in-memory tree (host-side) — no second checkpoint
                     # read
@@ -502,10 +583,17 @@ class Text2ImagePipeline:
 
                     self.unet_params = quantize_tree_host(
                         donor.unet_params)
+                elif w8a8_transform is not None and donor_plain:
+                    # w8a8 arm joining an fp donor: same derivation,
+                    # through the w8a8 transform (static act scales and
+                    # all)
+                    self.unet_params = w8a8_transform(donor.unet_params)
                 else:
-                    # fp arm joining an int8 donor: dequantization is
-                    # lossy, so load the fp tree properly
-                    self.unet_params, unet_was_loaded = load_unet(None)
+                    # joining a donor quantized in a different mode:
+                    # dequantization is lossy, so load this arm's own
+                    # tree properly (through its own transform, if any)
+                    self.unet_params, unet_was_loaded = load_unet(
+                        unet_transform)
                 # the donor's flag vouches only for tensors actually
                 # taken from the donor; the fp-joins-int8-donor arm
                 # re-loads its own UNet, and if the checkpoint vanished
@@ -562,6 +650,18 @@ class Text2ImagePipeline:
 
         if fc_describe(m.unet):
             log.info("%s", fc_describe(m.unet))
+        if w8a8_transform is not None:
+            from cassmantle_tpu.ops.quant import (
+                w8a8_calibrated,
+                w8a8_site_count,
+            )
+            from cassmantle_tpu.ops.quant_matmul import (
+                describe as w8a8_describe,
+            )
+
+            log.info("%s", w8a8_describe(
+                w8a8_calibrated(self.unet_params),
+                w8a8_site_count(self.unet_params)))
         self._dc_schedule = (deepcache_schedule(cfg.sampler)
                              if cfg.sampler.deepcache else None)
         # fail fast on invalid encprop configs and precompute the
@@ -856,6 +956,8 @@ class Text2ImagePipeline:
                 list(prompts), seed, deadline_s=deadline_s)
             metrics.inc("pipeline.images", len(prompts))
             note_consistency_counter(self.cfg.sampler, len(prompts))
+            note_w8a8_counter(self.cfg.models, self.cfg.sampler,
+                              len(prompts))
             return images
         sample_fn, scfg, ep_counts = (
             degraded if degraded is not None
@@ -894,6 +996,7 @@ class Text2ImagePipeline:
             metrics.inc("pipeline.brownout_images", n)
         note_encprop_counters(ep_counts, n)
         note_consistency_counter(scfg, n)
+        note_w8a8_counter(self.cfg.models, scfg, n)
         return out
 
     # -- img2img ----------------------------------------------------------
@@ -1032,6 +1135,9 @@ class PromptGenerator:
         # direct generate() callers can race it)
         self._dispatch_lock = OrderedLock("pipeline.prompt_dispatch",
                                           rank=12)
+        assert not (cfg.models.lm_int8 and cfg.models.lm_w8a8), (
+            "lm_w8a8 and lm_int8 are mutually exclusive: both rewrite "
+            "the same kernel leaves")
         if cfg.models.mistral is not None:
             m = cfg.models.mistral
             self.model = MistralLM(m)
@@ -1088,6 +1194,20 @@ class PromptGenerator:
                     )
 
                     transform = quantize_tree_host
+                elif lm_w8a8_armed(cfg.models):
+                    # W8A8 LM: same host-side quantize-before-placement
+                    # rationale. No static act scales — the LM path
+                    # quantizes activations per token (row absmax in
+                    # graph, models/gpt2.py), so a calibration artifact
+                    # has nothing to add here.
+                    from cassmantle_tpu.ops.quant import (
+                        w8a8_default_predicate,
+                        w8a8_tree_host,
+                    )
+
+                    pred = partial(w8a8_default_predicate,
+                                   min_size=cfg.models.w8a8_min_size)
+                    transform = partial(w8a8_tree_host, predicate=pred)
                 loaded = maybe_load(
                     weights_dir, loader[0], loader[1], loader[2],
                     cast_to=cfg.models.param_dtype, transform=transform)
@@ -1122,6 +1242,17 @@ class PromptGenerator:
             self._chunk = quantized_apply(self._chunk, dq_dtype)
             log.info("lm_int8: serving %.2f GB quantized param tree",
                      tree_nbytes(self.params) / 1e9)
+        if lm_w8a8_armed(cfg.models):
+            from cassmantle_tpu.ops.quant import (
+                tree_nbytes,
+                w8a8_site_count,
+            )
+
+            log.info(
+                "lm_w8a8: int8 W8A8 matmuls at %d sites (per-token "
+                "activation scales), %.2f GB param tree",
+                w8a8_site_count(self.params),
+                tree_nbytes(self.params) / 1e9)
         self._init_spec_decode(cfg, weights_dir)
         # roofline attribution (obs/costmodel.py): dense decode costs
         # 2·N(params) FLOPs per token processed; resolved lazily (the
@@ -1145,7 +1276,9 @@ class PromptGenerator:
             from cassmantle_tpu.obs import costmodel
 
             self._flops_per_token = costmodel.flops_per_item(
-                "prompt", costmodel.lm_signature(self.mcfg),
+                "prompt",
+                costmodel.lm_signature(
+                    self.mcfg, w8a8=lm_w8a8_armed(self.cfg.models)),
                 tracer=lambda: 2.0 * costmodel.params_count(self.params),
             ) or 0.0
         return self._flops_per_token
@@ -1430,6 +1563,10 @@ class PromptGenerator:
             out_tokens[idxs] = toks_host
             # lint: ignore[host-sync] — per-dispatch sync, not per-item
             out_len[idxs] = np.asarray(gen_len[:n])
+            if lm_w8a8_armed(self.cfg.models):
+                # one int8-kernel decode dispatch per bucket group (the
+                # gpt2_w8a8 bench A/B's proof the path engaged)
+                metrics.inc("pipeline.w8a8_dispatches")
         self._record_spec_stats(spec_stats)
         self._decode_flops_tls.value = dispatch_flops
         self._decode_invalid_tls.value = tuple(sorted(bad_members))

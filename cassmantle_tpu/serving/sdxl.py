@@ -116,9 +116,17 @@ class SDXLPipeline:
         ctx = jnp.zeros((1, self.pad_len, m.unet.context_dim),
                         dtype=jnp.float32)
         add = jnp.zeros((1, m.unet.addition_embed_dim), dtype=jnp.float32)
-        from cassmantle_tpu.serving.pipeline import int8_unet_tools
+        from cassmantle_tpu.serving.pipeline import (
+            int8_unet_tools,
+            w8a8_unet_tools,
+        )
 
         unet_transform, wrap_unet_apply = int8_unet_tools(m)
+        w8a8_transform = w8a8_unet_tools(m)
+        if w8a8_transform is not None:
+            # mutually exclusive with unet_int8 (asserted inside), so
+            # the int8 slot is free (see Text2ImagePipeline)
+            unet_transform = w8a8_transform
 
         def load_all_params() -> None:
             """Load/convert/share every stage tree and publish it on
@@ -128,15 +136,17 @@ class SDXLPipeline:
             if share_params_with is not None:
                 from cassmantle_tpu.serving.pipeline import (
                     share_compatible,
+                    unet_w8a8_armed,
                 )
 
                 donor = share_params_with
                 dm = donor.cfg.models
                 assert share_compatible(dm, m) \
                     and dm.clip_text_2 == m.clip_text_2 \
-                    and dm.unet_int8 == m.unet_int8, (
+                    and dm.unet_int8 == m.unet_int8 \
+                    and unet_w8a8_armed(dm) == unet_w8a8_armed(m), (
                         "share_params_with needs matching SDXL "
-                        "architectures"
+                        "architectures (incl. quantization mode)"
                     )
                 self.clip_params = donor.clip_params
                 self.clip2_params = donor.clip2_params
@@ -234,6 +244,18 @@ class SDXLPipeline:
 
         if fc_describe(m.unet):
             log.info("%s", fc_describe(m.unet))
+        if w8a8_transform is not None:
+            from cassmantle_tpu.ops.quant import (
+                w8a8_calibrated,
+                w8a8_site_count,
+            )
+            from cassmantle_tpu.ops.quant_matmul import (
+                describe as w8a8_describe,
+            )
+
+            log.info("%s", w8a8_describe(
+                w8a8_calibrated(self.unet_params),
+                w8a8_site_count(self.unet_params)))
         from cassmantle_tpu.serving.pipeline import (
             consistency_plan,
             effective_sampler_cfg,
@@ -485,6 +507,7 @@ class SDXLPipeline:
         meshed serving stays monolithic."""
         from cassmantle_tpu.serving.pipeline import (
             note_consistency_counter,
+            note_w8a8_counter,
         )
 
         degraded = self._degraded_sampler()
@@ -493,6 +516,8 @@ class SDXLPipeline:
                 list(prompts), seed, deadline_s=deadline_s)
             metrics.inc("pipeline.sdxl_images", len(prompts))
             note_consistency_counter(self.cfg.sampler, len(prompts))
+            note_w8a8_counter(self.cfg.models, self.cfg.sampler,
+                              len(prompts))
             return images
         sample_fn, scfg, ep_counts = (
             degraded if degraded is not None
@@ -529,4 +554,5 @@ class SDXLPipeline:
 
         note_encprop_counters(ep_counts, n)
         note_consistency_counter(scfg, n)
+        note_w8a8_counter(self.cfg.models, scfg, n)
         return out

@@ -187,8 +187,13 @@ def pytest_collection_modifyitems(config, items):
             # a per-test @pytest.mark.slow inside a fast module (e.g.
             # test_distill's real-geometry compile, test_queue's two
             # real-pipeline service builds — demoted round 21 when the
-            # default tier outgrew its 870s window again) keeps that
-            # test out of the `-m fast` sweep, not just out of tier-1
+            # default tier outgrew its 870s window again; round 25
+            # added test_pipeline's dp-mesh smoke, test_fused_conv's
+            # pipeline flag parity, and test_w8a8's generate-level
+            # kill-switch/SDXL-floor confirmations for the same
+            # pressure, each with its tier-1 coverage duplicated — see
+            # the demoted tests' docstrings) keeps that test out of
+            # the `-m fast` sweep, not just out of tier-1
             item.add_marker(pytest.mark.fast)
         if name in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)

@@ -292,6 +292,66 @@ def test_false_positive_shapes_stay_clean():
     """, RecompilePass()) == []
 
 
+def test_quant_tree_transform_in_loop_fails_and_suppression_passes():
+    """Golden fixture for ``quant-in-dispatch`` (ISSUE 20): the
+    quantize-inside-dispatch-loop hazard — w8a8_tree_host re-run per
+    generate call re-quantizes the whole param tree per iteration."""
+    src = """
+        from cassmantle_tpu.ops.quant import w8a8_tree_host
+
+        def serve(pipe, requests):
+            for req in requests:
+                params = w8a8_tree_host(pipe.unet_params){sup}
+                pipe.generate(req.prompts, params=params)
+    """
+    findings = lint(src.format(sup=""), RecompilePass())
+    assert rules(findings) == ["quant-in-dispatch"]
+    assert "re-quantizes the whole param tree" in findings[0].message
+    sup = "  # lint: ignore[quant-in-dispatch] — fixture reason"
+    assert lint(src.format(sup=sup), RecompilePass()) == []
+
+
+def test_quant_tree_transform_in_jit_fails():
+    """Dotted form inside a jit-traced closure: the requantize is
+    baked into the compiled graph and re-executes per dispatch."""
+    findings = lint("""
+        import jax
+        from cassmantle_tpu.ops import quant
+
+        @jax.jit
+        def denoise(params, latents):
+            qparams = quant.w8a8_tree(params)
+            return apply(qparams, latents)
+    """, RecompilePass())
+    assert rules(findings) == ["quant-in-dispatch"]
+    assert "jit-traced" in findings[0].message
+
+
+def test_quant_tree_transform_at_load_is_clean():
+    """The contract-conforming shape — quantize ONCE in the loader
+    transform (serving/pipeline.py w8a8_unet_tools) — plus a partial
+    reference (not a call) threaded into a loader, and an unrelated
+    call named like a transform member but outside loop/jit."""
+    assert lint("""
+        from functools import partial
+
+        from cassmantle_tpu.ops.quant import (
+            quantize_tree_host,
+            w8a8_tree_host,
+        )
+
+        def w8a8_tools(cfg, scales):
+            return lambda params: w8a8_tree_host(
+                params, act_scales=scales)
+
+        def build(loader, cfg):
+            transform = partial(w8a8_tree_host, predicate=None)
+            params = loader(transform)
+            donor = quantize_tree_host(params)
+            return donor
+    """, RecompilePass()) == []
+
+
 def test_host_concrete_jax_calls_in_conditions_are_clean():
     """jax host APIs (default_backend, devices) are concrete at trace
     time — only jnp.* array results trip the condition check."""

@@ -1,0 +1,471 @@
+"""W8A8 quantized serving (ISSUE 20): the invariants that make int8
+weights+activations trustworthy in production.
+
+1. the Pallas int8 kernels (matmul, conv3x3) are integer-exact against
+   their pure-lax references — int32 accumulation with the fp32
+   epilogue in the same order — including MXU tile padding on
+   non-aligned shapes and the per-output-channel scale epilogue;
+2. QDense is a bit-identical param-twin of nn.Dense on fp leaves: one
+   checkpoint layout, and the foundation of the kill switch's
+   bit-exact revert;
+3. the calibration pass is deterministic and the committed artifact
+   (data/act_scales.json) is signature-gated against model-config and
+   calibration-set drift — tier-1 fails fast with the rebuild command;
+4. CASSMANTLE_NO_W8A8=1 reverts serving bit-exactly (never quantizes a
+   leaf, counter stays silent);
+5. a warmed w8a8 bucket never recompiles (jit-sentinel pinned), and
+   the quality floor holds arm-vs-arm on BOTH image pipelines;
+6. the prompt LM quantizes with per-token scales and ticks the
+   dispatch counter once per bucket-group decode.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cassmantle_tpu.config import (
+    test_config as _tiny_config,
+    test_sdxl_config as _tiny_sdxl_config,
+)
+from cassmantle_tpu.ops import quant, quant_matmul
+from cassmantle_tpu.parallel import calibrate
+
+
+def _fp_cfg():
+    """The fp arm: tiny geometry on the fused-conv tree (the w8a8
+    serving contract requires fused_conv, so both arms carry it — the
+    A/B isolates quantization)."""
+    base = _tiny_config()
+    m = base.models
+    return base.replace(models=dataclasses.replace(
+        m, unet=dataclasses.replace(m.unet, fused_conv=True)))
+
+
+def _w8a8_cfg():
+    base = _fp_cfg()
+    return base.replace(models=dataclasses.replace(
+        base.models, unet_w8a8=True, w8a8_min_size=0))
+
+
+# -- int8 kernel vs lax reference -------------------------------------------
+
+def _rand_q(key, shape):
+    return jax.random.randint(key, shape, -127, 128, jnp.int32) \
+        .astype(jnp.int8)
+
+
+@pytest.mark.parametrize("m,k,n", [
+    (8, 64, 128),     # aligned
+    (5, 70, 33),      # every dim needs MXU tile padding
+    (1, 64, 129),     # decode row + odd channel count
+])
+def test_int8_matmul_kernel_matches_reference(m, k, n):
+    """Interpret-mode kernel vs the pure-lax reference: identical
+    int32 accumulation and fp32 epilogue order, so the match is exact
+    — including zero-padding up to sublane/lane tiles (zero int8 pads
+    contribute zero to the dot) and the per-output-channel col_scale ×
+    per-token row_scale epilogue."""
+    kx, kw, kr, kc, kb = jax.random.split(jax.random.PRNGKey(m * n), 5)
+    x_q = _rand_q(kx, (m, k))
+    w_q = _rand_q(kw, (k, n))
+    row = jax.random.uniform(kr, (m, 1), jnp.float32, 0.01, 0.2)
+    col = jax.random.uniform(kc, (1, n), jnp.float32, 0.001, 0.05)
+    bias = jax.random.normal(kb, (n,), jnp.float32)
+    got = quant_matmul.int8_matmul(x_q, w_q, row, col, bias,
+                                   interpret=True)
+    want = quant_matmul.int8_matmul_reference(
+        x_q, w_q, row, col, bias.reshape(1, n))
+    assert got.shape == (m, n)
+    # int32 accumulation is exact regardless of blocking; the fp32
+    # epilogue is the only rounding freedom → near-bitwise
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_int8_conv3x3_kernel_matches_reference():
+    """Whole-image int8 conv vs the nine-shifted-dots lax reference
+    (SAME padding, int32 accumulation, per-channel epilogue)."""
+    kx, kw, kc, kb = jax.random.split(jax.random.PRNGKey(7), 4)
+    x_q = _rand_q(kx, (2, 8, 8, 16))
+    kern = _rand_q(kw, (3, 3, 16, 32))
+    col = jax.random.uniform(kc, (32,), jnp.float32, 0.001, 0.05)
+    bias = jax.random.normal(kb, (32,), jnp.float32)
+    assert quant_matmul.int8_conv_ok(x_q, kern)
+    got = quant_matmul.int8_conv3x3(x_q, kern, col, bias,
+                                    interpret=True)
+    want = quant_matmul.int8_conv3x3_reference(
+        x_q, kern, col.reshape(1, 32), bias.reshape(1, 32))
+    assert got.shape == (2, 8, 8, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_w8a8_dense_quantization_error_is_small():
+    """End-to-end dense path on a quantized leaf: int8 result tracks
+    the fp matmul within quantization error, static and per-token."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(3))
+    x = jax.random.normal(kx, (6, 64), jnp.float32)
+    w = jax.random.normal(kw, (64, 48), jnp.float32) * 0.1
+    ref = x @ w
+    for per_token in (False, True):
+        q = quant.quantize_tensor_act(w)
+        got = quant_matmul.w8a8_dense(x, q, per_token=per_token,
+                                      interpret=True)
+        err = float(jnp.abs(got - ref).max() / jnp.abs(ref).max())
+        assert err < 0.05, (per_token, err)
+
+
+def test_w8a8_dense_per_token_overrides_static_scale():
+    """The LM contract (models/gpt2.py act_per_token): per_token=True
+    always computes dynamic row scales — a stale static act_scale on
+    the leaf must not change the result."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(11))
+    x = jax.random.normal(kx, (4, 32), jnp.float32)
+    w = jax.random.normal(kw, (32, 16), jnp.float32) * 0.1
+    plain = quant.quantize_tensor_act(w)
+    stale = plain._replace(act_scale=jnp.float32(123.0))
+    a = quant_matmul.w8a8_dense(x, plain, per_token=True,
+                                interpret=True)
+    b = quant_matmul.w8a8_dense(x, stale, per_token=True,
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gn_silu_conv_w8a8_matches_reference():
+    kx, ka, kb2, kw, kb = jax.random.split(jax.random.PRNGKey(5), 5)
+    x = jax.random.normal(kx, (1, 8, 8, 16), jnp.float32)
+    a = jax.random.uniform(ka, (1, 16), jnp.float32, 0.5, 1.5)
+    b = jax.random.normal(kb2, (1, 16), jnp.float32) * 0.1
+    w = jax.random.normal(kw, (3, 3, 16, 32), jnp.float32) * 0.1
+    bias = jax.random.normal(kb, (32,), jnp.float32)
+    q = quant.quantize_tensor_act(w)
+    got = quant_matmul.gn_silu_conv3x3_w8a8(x, a, b, q, bias,
+                                            interpret=True)
+    want = quant_matmul.gn_silu_conv3x3_w8a8_reference(x, a, b, q, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_qdense_is_bit_identical_param_twin_of_nn_dense():
+    """QDense declares nn.Dense's exact param names/shapes/inits and
+    computes identically on fp leaves — one checkpoint layout, and the
+    reason the kill switch can revert bit-exactly by simply not
+    quantizing at load."""
+    import flax.linen as nn
+
+    from cassmantle_tpu.models.layers import QDense
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 16), jnp.float32)
+    rng = jax.random.PRNGKey(42)
+    pq = QDense(features=8).init(rng, x)
+    pd = nn.Dense(features=8).init(rng, x)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        pq, pd)
+    np.testing.assert_array_equal(
+        np.asarray(QDense(features=8).apply(pq, x)),
+        np.asarray(nn.Dense(features=8).apply(pd, x)))
+
+
+# -- tree transform + site keys ---------------------------------------------
+
+def test_site_key_strips_params_collection_root():
+    assert quant.site_key(("params", "down_0", "conv1", "kernel")) \
+        == "down_0/conv1"
+    assert quant.site_key(("down_0", "conv1", "kernel")) \
+        == "down_0/conv1"
+
+
+def test_w8a8_tree_host_selects_sites_and_keeps_layout():
+    """The transform swaps only predicate-selected kernel leaves for
+    ActQTensors; every other leaf (norms, biases, embeds) is untouched
+    and the tree's key structure (checkpoint layout) is unchanged."""
+    from functools import partial
+
+    from cassmantle_tpu.models.unet import UNet
+    from cassmantle_tpu.models.weights import init_params
+
+    cfg = _w8a8_cfg().models.unet
+    model = UNet(cfg)
+    lat = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8, 4))
+    t = jnp.array([5], jnp.int32)
+    ctx = jax.random.normal(jax.random.PRNGKey(1),
+                            (1, 6, cfg.context_dim))
+    params = init_params(model, 0, lat, t, ctx)
+    pred = partial(quant.w8a8_default_predicate, min_size=0)
+    qparams = quant.w8a8_tree_host(params, predicate=pred)
+    sites = quant.w8a8_site_count(qparams)
+    assert sites > 0
+    assert quant.w8a8_site_count(params) == 0
+
+    def paths(tree):
+        return {jax.tree_util.keystr(p)
+                for p, _ in jax.tree_util.tree_flatten_with_path(
+                    tree, is_leaf=lambda x: isinstance(
+                        x, quant.ActQTensor))[0]}
+
+    assert paths(params) == paths(qparams)
+    # quantized trees stream fewer bytes
+    assert quant.tree_nbytes(qparams) < quant.tree_nbytes(params)
+    # (numerics of applying the quantized tree are covered end-to-end
+    # by the pipeline quality-floor tests below — an eager apply here
+    # would route every site through interpret-mode Pallas, ~20s of
+    # tier-1 budget for no extra coverage)
+
+
+# -- calibration + committed artifact ---------------------------------------
+
+@pytest.mark.slow
+def test_calibration_pass_is_deterministic():
+    """Same (config, prompts, timesteps) → identical absmax maps: the
+    latents come from fixed PRNG keys and the recorder keeps a running
+    max, so --emit is reproducible."""
+    cfg = calibrate.calibration_config()
+    prompts = calibrate.calibration_prompts(2)
+    a = calibrate.collect_unet_stats(cfg, prompts=prompts,
+                                     timesteps=(981, 21))
+    b = calibrate.collect_unet_stats(cfg, prompts=prompts,
+                                     timesteps=(981, 21))
+    assert a and a.keys() == b.keys()
+    for k in a:
+        assert float(a[k]) == float(b[k]), k
+
+
+def test_committed_artifact_drift_gate():
+    """Tier-1 drift gate: the committed data/act_scales.json signature
+    must match what --emit would stamp for the current calibration
+    config + calibration prompt set."""
+    with open(calibrate.ACT_SCALES_PATH) as f:
+        artifact = json.load(f)
+    entry = artifact["entries"]["unet"]
+    expect = calibrate.calibration_signature(
+        calibrate.calibration_config().models,
+        calibrate.prompts_digest(calibrate.calibration_prompts()))
+    assert entry["signature"] == expect, (
+        f"data/act_scales.json signature {entry['signature']} != "
+        f"expected {expect} — the UNet/CLIP config or the calibration "
+        f"seed set changed; rebuild with `python -m "
+        f"cassmantle_tpu.parallel.calibrate --emit` and commit the "
+        f"artifact")
+    # the entry's own bookkeeping must agree with its inputs
+    assert entry["prompts_digest"] == calibrate.prompts_digest(
+        calibrate.calibration_prompts(entry["num_prompts"]))
+    scales = entry["scales"]
+    assert scales, "empty calibration entry"
+    assert all(np.isfinite(v) and v > 0 for v in scales.values())
+
+
+def test_load_act_scales_signature_gated():
+    """Serving loads static scales ONLY for a signature-matching
+    config; a drifted config falls back to dynamic (None), never
+    raises."""
+    m = calibrate.calibration_config().models
+    scales = calibrate.load_act_scales(m)
+    assert scales and all(isinstance(v, float)
+                          for v in scales.values())
+    drifted = dataclasses.replace(
+        m, unet=dataclasses.replace(m.unet, base_channels=48))
+    assert calibrate.load_act_scales(drifted) is None
+    assert calibrate.load_act_scales(m, path="/nonexistent.json") is None
+
+
+# -- serving: pipelines, kill switch, counters, recompiles ------------------
+
+@pytest.fixture(scope="module")
+def fp_pipe():
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    return Text2ImagePipeline(_fp_cfg())
+
+
+@pytest.fixture(scope="module")
+def w8a8_pipe(fp_pipe):
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    return Text2ImagePipeline(_w8a8_cfg(), share_params_with=fp_pipe)
+
+
+@pytest.fixture(scope="module")
+def clip_harness():
+    """One tiny CLIP harness shared by both pipelines' floor tests
+    (its vision-tower jits dominate the report cost)."""
+    from cassmantle_tpu.eval.clip_parity import ClipSimilarityHarness
+    from cassmantle_tpu.models.clip_vision import ClipVisionConfig
+
+    return ClipSimilarityHarness(
+        text_cfg=_tiny_config().models.clip_text,
+        vision_cfg=ClipVisionConfig(
+            image_size=32, patch_size=8, hidden_size=64,
+            intermediate_size=128, num_layers=2, num_heads=4,
+            projection_dim=64),
+        pad_len=16)
+
+
+def test_w8a8_pipeline_quantizes_counts_and_passes_floor(
+        fp_pipe, w8a8_pipe, clip_harness):
+    """The armed w8a8 pipeline: quantized sites with STATIC calibrated
+    scales (the committed artifact matches the tiny config), the
+    dispatch counter ticks steps × images, and the arm-vs-arm quality
+    report clears the 0.98 floor."""
+    from cassmantle_tpu.eval.clip_parity import (
+        W8A8_IMAGE_SIM_FLOOR,
+        w8a8_quality_report,
+    )
+    from cassmantle_tpu.utils.logging import metrics
+
+    assert quant.w8a8_site_count(w8a8_pipe.unet_params) > 0
+    assert quant.w8a8_calibrated(w8a8_pipe.unet_params)
+    # the donor fp tree is untouched by the share
+    assert quant.w8a8_site_count(fp_pipe.unet_params) == 0
+
+    prompts = ["a lighthouse over a stormy sea"]
+    before = metrics.counter_total("pipeline.w8a8_dispatches")
+    fp_imgs = fp_pipe.generate(prompts, seed=3)
+    assert metrics.counter_total("pipeline.w8a8_dispatches") == before
+    q_imgs = w8a8_pipe.generate(prompts, seed=3)
+    steps = _w8a8_cfg().sampler.num_steps
+    assert metrics.counter_total("pipeline.w8a8_dispatches") \
+        == before + steps * len(prompts)
+
+    report = w8a8_quality_report(clip_harness, q_imgs, fp_imgs,
+                                 prompts)
+    assert report["floor"] == W8A8_IMAGE_SIM_FLOOR == 0.98
+    assert report["image_sim_min"] >= report["floor"]
+    assert report["passes_floor"] is True
+    assert report["gate_enforced"] is False  # random init: advisory
+
+
+def test_warmed_w8a8_bucket_never_recompiles(w8a8_pipe):
+    """Jit sentinel pinned on the warmed w8a8 serving loop: the int8
+    kernels are internal scan structure, so a second same-bucket
+    generate must hit the jit cache with ZERO new compiles."""
+    from cassmantle_tpu.utils import jit_sentinel
+
+    w8a8_pipe.generate(["a quiet harbor at dawn"], seed=5)  # warmup
+    with jit_sentinel.no_new_compiles():
+        w8a8_pipe.generate(["a stormy night at sea"], seed=6)
+
+
+def test_kill_switch_build_is_structurally_fp(fp_pipe, monkeypatch):
+    """CASSMANTLE_NO_W8A8=1 at build time, tier-1 structural pin: zero
+    leaves quantize and the killed build's UNet tree is leaf-for-leaf
+    the SAME buffers as the fp pipeline's — combined with the
+    QDense-twin bit-parity pin above, identical tree in → identical
+    serving graph out. (The generate-level image comparison lives in
+    the slow-tier test below: it compiles a third whole pipeline for a
+    property the structural pin already forces.)"""
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+
+    monkeypatch.setenv("CASSMANTLE_NO_W8A8", "1")
+    assert quant_matmul.w8a8_disabled()
+    killed = Text2ImagePipeline(_w8a8_cfg(), share_params_with=fp_pipe)
+    assert quant.w8a8_site_count(killed.unet_params) == 0
+    ref_leaves = jax.tree_util.tree_leaves(fp_pipe.unet_params)
+    got_leaves = jax.tree_util.tree_leaves(killed.unet_params)
+    assert len(ref_leaves) == len(got_leaves)
+    for a, b in zip(ref_leaves, got_leaves):
+        assert a is b  # shared buffers, not copies
+
+
+@pytest.mark.slow
+def test_kill_switch_reverts_bit_exactly(fp_pipe, monkeypatch):
+    """CASSMANTLE_NO_W8A8=1 end-to-end: the killed w8a8 build's images
+    are BIT-identical to the fp pipeline's and the dispatch counter
+    stays silent (generate-level confirmation of the structural tier-1
+    pin; slow tier — it compiles a third full pipeline)."""
+    from cassmantle_tpu.serving.pipeline import Text2ImagePipeline
+    from cassmantle_tpu.utils.logging import metrics
+
+    monkeypatch.setenv("CASSMANTLE_NO_W8A8", "1")
+    killed = Text2ImagePipeline(_w8a8_cfg(), share_params_with=fp_pipe)
+    prompts = ["an orchard under two moons"]
+    before = metrics.counter_total("pipeline.w8a8_dispatches")
+    ref = fp_pipe.generate(prompts, seed=9)
+    got = killed.generate(prompts, seed=9)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert metrics.counter_total("pipeline.w8a8_dispatches") == before
+
+
+@pytest.mark.slow
+def test_sdxl_w8a8_quantizes_and_passes_floor(clip_harness):
+    """SDXL twin: two pipelines (the donor contract requires matching
+    quantization mode), dynamic activation scales (the committed
+    artifact is SD1.5-signature only), same 0.98 floor. Slow tier like
+    the rest of the SDXL pipeline suite (test_sdxl): it compiles two
+    dual-tower pipelines; the tier-1 floor runs on the SD1.5 twin
+    above and the SDXL cpu_smoke receipt rides BENCH_SUITE.json."""
+    from cassmantle_tpu.eval.clip_parity import w8a8_quality_report
+    from cassmantle_tpu.serving.sdxl import SDXLPipeline
+
+    base = _tiny_sdxl_config()
+    m = base.models
+    fp_cfg = base.replace(models=dataclasses.replace(
+        m, unet=dataclasses.replace(m.unet, fused_conv=True)))
+    q_cfg = fp_cfg.replace(models=dataclasses.replace(
+        fp_cfg.models, unet_w8a8=True, w8a8_min_size=0))
+
+    fp = SDXLPipeline(fp_cfg)
+    with pytest.raises(AssertionError, match="quantization mode"):
+        SDXLPipeline(q_cfg, share_params_with=fp)
+    qp = SDXLPipeline(q_cfg)
+    assert quant.w8a8_site_count(qp.unet_params) > 0
+    assert not quant.w8a8_calibrated(qp.unet_params)
+
+    prompts = ["a caravan crossing silver dunes"]
+    report = w8a8_quality_report(
+        clip_harness, qp.generate(prompts, seed=2),
+        fp.generate(prompts, seed=2), prompts)
+    assert report["passes_floor"] is True
+
+
+def test_lm_w8a8_decode_counter_and_kill_switch(monkeypatch):
+    """The prompt LM: lm_w8a8 quantizes the block projections
+    (per-token scales, no artifact), the counter ticks once per
+    bucket-group decode dispatch, and the kill switch reverts to
+    bit-identical tokens with a silent counter."""
+    from cassmantle_tpu.serving.pipeline import PromptGenerator
+    from cassmantle_tpu.utils.logging import metrics
+
+    base = _tiny_config()
+    q_cfg = base.replace(models=dataclasses.replace(
+        base.models, lm_w8a8=True, w8a8_min_size=0))
+
+    fp = PromptGenerator(base)
+    tok_fp, len_fp = fp.decode_ids_batch(["the storm rolled"],
+                                         max_new_tokens=4)
+
+    qgen = PromptGenerator(q_cfg)
+    assert quant.w8a8_site_count(qgen.params) > 0
+    before = metrics.counter_total("pipeline.w8a8_dispatches")
+    tok_q, _ = qgen.decode_ids_batch(["the storm rolled"],
+                                     max_new_tokens=4)
+    assert metrics.counter_total("pipeline.w8a8_dispatches") \
+        == before + 1  # one bucket group, one int8 dispatch
+    assert tok_q.shape == tok_fp.shape
+
+    monkeypatch.setenv("CASSMANTLE_NO_W8A8", "1")
+    killed = PromptGenerator(q_cfg)
+    assert quant.w8a8_site_count(killed.params) == 0
+    before = metrics.counter_total("pipeline.w8a8_dispatches")
+    tok_k, len_k = killed.decode_ids_batch(["the storm rolled"],
+                                           max_new_tokens=4)
+    assert metrics.counter_total("pipeline.w8a8_dispatches") == before
+    np.testing.assert_array_equal(np.asarray(tok_k),
+                                  np.asarray(tok_fp))
+    np.testing.assert_array_equal(np.asarray(len_k),
+                                  np.asarray(len_fp))
+
+
+def test_w8a8_and_int8_are_mutually_exclusive():
+    from cassmantle_tpu.serving.pipeline import w8a8_unet_tools
+
+    cfg = _w8a8_cfg()
+    both = dataclasses.replace(cfg.models, unet_int8=True)
+    with pytest.raises(AssertionError, match="mutually exclusive"):
+        w8a8_unet_tools(both)

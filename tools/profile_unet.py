@@ -228,13 +228,23 @@ def _image_cost_entry(kind: str, cfg) -> dict:
     vae_f, vae_b = costmodel.trace_cost(
         lambda p, z: vae.apply(p, z), vae_params, lat)
 
+    # W8A8 serving (ISSUE 20): the fp trace above is still the FLOPs
+    # proxy (the int8 kernels run the same dot/conv math on the MXU's
+    # doubled int8 rate — a throughput factor, not an op-count change),
+    # but weight-side HBM traffic halves at every quantized site: the
+    # param read streams int8 instead of param_dtype per forward.
+    w8a8 = _image_w8a8_armed(m)
+    w8a8_elems = _w8a8_site_elements(unet_params, m.w8a8_min_size) \
+        if w8a8 else 0
+    unet_saved = w8a8_elems * (jnp.dtype(m.param_dtype).itemsize - 1)
     stages = {
         # cond + uncond conditioning per image
         "clip_encode": {"flops": int(2 * enc_f),
                         "hbm_bytes": int(2 * enc_b)},
         # CFG doubles every denoise forward
         "denoise": {"flops": int(2 * s.num_steps * unet_f),
-                    "hbm_bytes": int(2 * s.num_steps * unet_b)},
+                    "hbm_bytes": int(2 * s.num_steps
+                                     * (unet_b - unet_saved))},
         "vae_decode": {"flops": int(vae_f), "hbm_bytes": int(vae_b)},
     }
     total_f = sum(st["flops"] for st in stages.values())
@@ -249,12 +259,42 @@ def _image_cost_entry(kind: str, cfg) -> dict:
         # forwards of the same UNet — the denoise math above already
         # covers it (2·num_steps CFG forwards)
         "consistency": bool(s.consistency),
+        "w8a8": w8a8,
         "stages": stages,
         "flops_per_item": total_f,
         "hbm_bytes_per_item": total_b,
         # batch-linear (dot/conv flops scale with B): per-bucket totals
         "buckets": {str(b): total_f * b for b in buckets},
     }
+
+
+def _image_w8a8_armed(models_cfg) -> bool:
+    from cassmantle_tpu.serving.pipeline import unet_w8a8_armed
+
+    return unet_w8a8_armed(models_cfg)
+
+
+def _w8a8_site_elements(params, min_size: int) -> int:
+    """Total weight-element count of w8a8-quantizable kernel sites in
+    an eval_shape'd tree — the elements that stream int8 (1 byte)
+    instead of param_dtype under W8A8 serving."""
+    import math
+
+    from cassmantle_tpu.ops.quant import w8a8_default_predicate
+
+    total = 0
+
+    def walk(tree, path=()):
+        nonlocal total
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, path + (k,))
+        elif hasattr(tree, "shape") and w8a8_default_predicate(
+                path, tree, min_size=min_size):
+            total += math.prod(tree.shape)
+
+    walk(params)
+    return total
 
 
 def _lm_cost_entry(cfg) -> dict:
@@ -273,12 +313,20 @@ def _lm_cost_entry(cfg) -> dict:
     n = costmodel.params_count(params)
     per_token = 2 * n
     itemsize = jnp.dtype(cfg.models.param_dtype).itemsize
+    # W8A8 (ISSUE 20): quantized matmul sites stream int8 weights —
+    # same 2·N FLOPs per token, fewer weight-read bytes
+    from cassmantle_tpu.serving.pipeline import lm_w8a8_armed
+
+    w8a8 = lm_w8a8_armed(cfg.models)
+    saved = _w8a8_site_elements(
+        params, cfg.models.w8a8_min_size) * (itemsize - 1) if w8a8 else 0
     return {
-        "signature": costmodel.lm_signature(m),
+        "signature": costmodel.lm_signature(m, w8a8=w8a8),
         "model": "gpt2",
         "params": n,
+        "w8a8": w8a8,
         "flops_per_item": per_token,           # per token processed
-        "hbm_bytes_per_item": n * itemsize,    # weight read per token
+        "hbm_bytes_per_item": n * itemsize - saved,
         "prompt_buckets": list(PromptGenerator.PROMPT_BUCKETS),
         "batch_buckets": list(PromptGenerator.BATCH_BUCKETS),
         "buckets": {str(b): per_token * b
@@ -319,13 +367,25 @@ def emit_cost_model(path: str) -> dict:
     deterministic integers, no weights, runs on any backend in seconds —
     so the committed ``data/cost_model.json`` doubles as a drift gate
     (tests/test_obs_device.py regenerates and compares)."""
+    import dataclasses
+
     from cassmantle_tpu.config import (
         FrameworkConfig,
         lcm_serving_config,
         sdxl_config,
+        w8a8_serving_config,
     )
     from cassmantle_tpu.obs import costmodel
 
+    # the SDXL W8A8 arm: production SDXL geometry with the quantized
+    # UNet path armed (same knobs w8a8_serving_config sets for SD1.5)
+    sdxl_base = sdxl_config()
+    sdxl_w8a8 = dataclasses.replace(
+        sdxl_base, models=dataclasses.replace(
+            sdxl_base.models,
+            unet=dataclasses.replace(sdxl_base.models.unet,
+                                     fused_conv=True, conv_pad_to=128),
+            unet_w8a8=True))
     model = {
         "version": 1,
         "generated_by": "python tools/profile_unet.py --emit-cost-model",
@@ -343,6 +403,13 @@ def emit_cost_model(path: str) -> dict:
             "sdxl": _image_cost_entry("sdxl", sdxl_config()),
             "prompt": _lm_cost_entry(FrameworkConfig()),
             "scorer": _scorer_cost_entry(FrameworkConfig()),
+            # W8A8 serving variants (ISSUE 20): same analytic FLOPs,
+            # weight-side HBM bytes halved at quantized sites — their
+            # signatures differ (the armed w8a8 state digests in), so
+            # quantized pipelines resolve these entries by scan
+            "t2i_w8a8": _image_cost_entry("t2i", w8a8_serving_config()),
+            "sdxl_w8a8": _image_cost_entry("sdxl", sdxl_w8a8),
+            "prompt_w8a8": _lm_cost_entry(w8a8_serving_config()),
         },
     }
     import json
